@@ -1,0 +1,22 @@
+"""risingwave_tpu — a TPU-native streaming SQL engine.
+
+A ground-up re-design of RisingWave's capabilities (reference at
+/root/reference, see /root/repo/SURVEY.md) for JAX/XLA on TPU: Postgres-dialect
+SQL in, incrementally-maintained materialized views out, with Chandy-Lamport
+barrier checkpointing, vnode-hash data parallelism over a device mesh, and
+epoch-versioned durable operator state.
+
+Layer map (mirrors SURVEY.md §1, re-hosted):
+  core/        L0 columnar kernel: chunks, types, vnode hash, epochs, encodings
+  expr/        L4 vectorized expression & aggregate function layer
+  ops/         L5 stream executors (generator protocol over Message streams)
+  state/       L2/L3 state tables + storage backends + checkpoints
+  device/      Pallas/XLA per-epoch kernels and HBM-resident operator state
+  parallel/    vnode→mesh sharding, shard_map steps, exchange collectives
+  runtime/     actors, barrier manager, dataflow assembly, recovery
+  sql/         L9 parser/binder/planner (Postgres dialect subset)
+  connectors/  L6 sources (nexmark, datagen) and sinks
+  meta/        L8 control plane: catalog, DDL, checkpoint coordination
+"""
+
+__version__ = "0.1.0"

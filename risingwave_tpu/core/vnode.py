@@ -1,0 +1,240 @@
+"""Virtual-node consistent hashing.
+
+Re-design of `src/common/src/hash/consistent_hash/vnode.rs:30-151`: rows are
+partitioned by CRC32(distribution key) % vnode_count; vnodes map onto
+parallel units. Here the parallel units are TPU mesh shards
+(`risingwave_tpu/parallel/`), and the per-chunk vnode computation
+(`VirtualNode::compute_chunk`, vnode.rs:151) is vectorized two ways:
+
+* numpy table-driven CRC32 on host (bit-identical to zlib/crc32fast IEEE), and
+* a jnp variant usable inside jitted dispatch steps (table lookups on device).
+"""
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .chunk import Column, DataChunk
+from .dtypes import TypeKind
+
+# Default vnode count (reference: 256 for backwards compat, max 2^15).
+VNODE_COUNT = 256
+MAX_VNODE_COUNT = 1 << 15
+
+# ---------------------------------------------------------------------------
+# CRC32 (IEEE, reflected — matches zlib.crc32 / Rust crc32fast)
+# ---------------------------------------------------------------------------
+
+def _make_crc32_table() -> np.ndarray:
+    poly = np.uint32(0xEDB88320)
+    table = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = np.uint32(i)
+        for _ in range(8):
+            c = (c >> np.uint32(1)) ^ (poly if (c & np.uint32(1)) else np.uint32(0))
+        table[i] = c
+    return table
+
+
+CRC32_TABLE = _make_crc32_table()
+
+
+def crc32_bytes_matrix(data: np.ndarray,
+                       init: Optional[np.ndarray] = None) -> np.ndarray:
+    """CRC32 of each row of a (n, k) uint8 matrix, vectorized across n.
+    Matches zlib.crc32(row_bytes) bit-for-bit."""
+    assert data.dtype == np.uint8 and data.ndim == 2
+    n, k = data.shape
+    crc = (np.full(n, 0xFFFFFFFF, dtype=np.uint32) if init is None
+           else (init ^ np.uint32(0xFFFFFFFF)))
+    for j in range(k):
+        idx = (crc ^ data[:, j]) & np.uint32(0xFF)
+        crc = (crc >> np.uint32(8)) ^ CRC32_TABLE[idx]
+    return crc ^ np.uint32(0xFFFFFFFF)
+
+
+def _int_key_bytes(values: np.ndarray) -> np.ndarray:
+    """Serialize integral key values to (n, 8) big-endian bytes — the key
+    serialization contract for hashing (value-encoding analog of the
+    reference's HashKey, `src/common/src/hash/key_v2.rs:221`)."""
+    v = values.astype(np.int64, copy=False).astype(np.uint64)
+    out = np.empty((len(v), 8), dtype=np.uint8)
+    for b in range(8):
+        out[:, b] = ((v >> np.uint64(8 * (7 - b))) & np.uint64(0xFF)).astype(np.uint8)
+    return out
+
+
+_NULL_SENTINEL_BYTES = b"\x00null\x00"
+FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+FNV_PRIME = np.uint64(0x100000001B3)
+
+
+def _fnv1a64_bytes_matrix(data: np.ndarray, lengths: Optional[np.ndarray] = None,
+                          init: Optional[np.ndarray] = None) -> np.ndarray:
+    """FNV-1a 64 over each row of an (n, k) uint8 matrix."""
+    n, k = data.shape
+    h = np.full(n, FNV_OFFSET, dtype=np.uint64) if init is None else init.copy()
+    with np.errstate(over="ignore"):
+        for j in range(k):
+            if lengths is not None:
+                active = j < lengths
+                h = np.where(active, (h ^ data[:, j].astype(np.uint64)) * FNV_PRIME, h)
+            else:
+                h = (h ^ data[:, j].astype(np.uint64)) * FNV_PRIME
+    return h
+
+
+def column_hash64(col: Column) -> np.ndarray:
+    """Stable null-aware 64-bit hash per row (FNV-1a over the serialized
+    value). For host-only dtypes this is the device-side key projection."""
+    n = len(col)
+    kind = col.dtype.kind
+    if col.dtype.is_fixed_width:
+        if kind == TypeKind.BOOLEAN:
+            data = col.values.astype(np.uint8).reshape(n, 1)
+        elif kind == TypeKind.FLOAT32 or kind == TypeKind.FLOAT64:
+            # normalize -0.0 to 0.0 so equal SQL values hash equal
+            v = col.values.astype(np.float64, copy=True)
+            v[v == 0.0] = 0.0
+            data = v.view(np.uint64).reshape(n, 1)
+            data = _int_key_bytes(data.view(np.int64).ravel())
+        else:
+            data = _int_key_bytes(col.values)
+        h = _fnv1a64_bytes_matrix(data)
+    else:
+        h = np.empty(n, dtype=np.uint64)
+        for i in range(n):
+            v = col.values[i]
+            if v is None:
+                h[i] = 0
+                continue
+            if isinstance(v, str):
+                b = v.encode("utf-8")
+            elif isinstance(v, bytes):
+                b = v
+            else:
+                b = repr(v).encode("utf-8")
+            acc = FNV_OFFSET
+            with np.errstate(over="ignore"):
+                for byte in b:
+                    acc = (acc ^ np.uint64(byte)) * FNV_PRIME
+            h[i] = acc
+    # null → fixed sentinel hash
+    null_h = np.uint64(0x9E3779B97F4A7C15)
+    return np.where(col.validity, h, null_h)
+
+
+def hash_columns64(cols: Sequence[Column]) -> np.ndarray:
+    """Combine per-column hash64s into one 64-bit key hash (boost-style mix)."""
+    assert cols
+    h = column_hash64(cols[0])
+    with np.errstate(over="ignore"):
+        for c in cols[1:]:
+            h2 = column_hash64(c)
+            h = h ^ (h2 + np.uint64(0x9E3779B97F4A7C15)
+                     + (h << np.uint64(6)) + (h >> np.uint64(2)))
+    return h
+
+
+def compute_vnodes(key_cols: Sequence[Column], n: Optional[int] = None,
+                   vnode_count: int = VNODE_COUNT) -> np.ndarray:
+    """Per-row vnode for a chunk's distribution-key columns
+    (`VirtualNode::compute_chunk`, vnode.rs:151).
+
+    Contract: CRC32 over the concatenated big-endian key serialization
+    (nulls contribute a sentinel), mod vnode_count. All shards/processes must
+    agree on this function — it defines the state layout.
+    """
+    if not key_cols:
+        # Singleton distribution: everything on vnode 0.
+        assert n is not None
+        return np.zeros(n, dtype=np.int32)
+    n = len(key_cols[0])
+    crc = None
+    for col in key_cols:
+        if col.dtype.is_fixed_width:
+            kind = col.dtype.kind
+            if kind == TypeKind.BOOLEAN:
+                data = col.values.astype(np.uint8).reshape(n, 1)
+            elif kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+                v = col.values.astype(np.float64, copy=True)
+                v[v == 0.0] = 0.0
+                data = _int_key_bytes(v.view(np.int64))
+            else:
+                data = _int_key_bytes(col.values)
+            # null handling: splice in sentinel bytes per-row where invalid
+            if not col.validity.all():
+                crc_part_valid = crc32_bytes_matrix(data, init=crc)
+                sent = np.frombuffer(_NULL_SENTINEL_BYTES, dtype=np.uint8)
+                sent_mat = np.broadcast_to(sent, (n, len(sent))).copy()
+                crc_part_null = crc32_bytes_matrix(sent_mat, init=crc)
+                crc = np.where(col.validity, crc_part_valid, crc_part_null)
+            else:
+                crc = crc32_bytes_matrix(data, init=crc)
+        else:
+            out = np.empty(n, dtype=np.uint32)
+            for i in range(n):
+                v = col.values[i]
+                if not col.validity[i]:
+                    b = _NULL_SENTINEL_BYTES
+                elif isinstance(v, str):
+                    b = v.encode("utf-8")
+                elif isinstance(v, bytes):
+                    b = v
+                else:
+                    b = repr(v).encode("utf-8")
+                # zlib.crc32(data, prev) chains CRCs exactly like our
+                # table-driven matrix version with init=prev.
+                out[i] = zlib.crc32(b, int(crc[i])) if crc is not None else zlib.crc32(b)
+            crc = out.astype(np.uint32)
+    return (crc % np.uint32(vnode_count)).astype(np.int32)
+
+
+def vnode_of_row(key: Sequence, vnode_count: int = VNODE_COUNT) -> int:
+    """Single-row vnode (must agree with compute_vnodes)."""
+    crc = 0
+    started = False
+    for v in key:
+        if v is None:
+            b = _NULL_SENTINEL_BYTES
+        elif isinstance(v, bool):
+            b = bytes([int(v)])
+        elif isinstance(v, (int, np.integer)):
+            b = int(v).to_bytes(8, "big", signed=True)
+        elif isinstance(v, (float, np.floating)):
+            fv = 0.0 if v == 0.0 else float(v)
+            b = np.array([fv]).view(np.int64)[0].item().to_bytes(8, "big", signed=True)
+        elif isinstance(v, str):
+            b = v.encode("utf-8")
+        elif isinstance(v, bytes):
+            b = v
+        else:
+            b = repr(v).encode("utf-8")
+        crc = zlib.crc32(b, crc) if started else zlib.crc32(b)
+        started = True
+    return crc % vnode_count
+
+
+# ---------------------------------------------------------------------------
+# Device-side (jnp) vnode computation for jitted dispatch
+# ---------------------------------------------------------------------------
+
+def crc32_u64_jnp(values):
+    """CRC32 of big-endian 8-byte serialization of int64 values, on device.
+    Used inside jitted exchange/dispatch steps; agrees with compute_vnodes for
+    single-int64 keys."""
+    import jax.numpy as jnp
+    table = jnp.asarray(CRC32_TABLE.astype(np.int64))
+    v = values.astype(jnp.uint64)
+    crc = jnp.full(values.shape, 0xFFFFFFFF, dtype=jnp.uint32)
+    for b in range(8):
+        byte = ((v >> np.uint64(8 * (7 - b))) & np.uint64(0xFF)).astype(jnp.uint32)
+        idx = ((crc ^ byte) & np.uint32(0xFF)).astype(jnp.int32)
+        crc = (crc >> np.uint32(8)) ^ jnp.take(table, idx).astype(jnp.uint32)
+    return crc ^ np.uint32(0xFFFFFFFF)
+
+
+def compute_vnodes_jnp(values, vnode_count: int = VNODE_COUNT):
+    return (crc32_u64_jnp(values) % np.uint32(vnode_count)).astype("int32")

@@ -1,0 +1,170 @@
+"""L0 kernel tests: chunks, vnode hashing, epochs, encodings."""
+import zlib
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.core import (
+    Column, DataChunk, Op, StreamChunk, StreamChunkBuilder, compute_vnodes,
+    dtypes as T, now_epoch, to_device_chunk, vnode_of_row,
+)
+from risingwave_tpu.core.encoding import (
+    SortKey, decode_row, encode_datum_memcomparable, encode_key, encode_row,
+)
+from risingwave_tpu.core.epoch import EpochPair, epoch_from_physical, physical_time_ms
+from risingwave_tpu.core.vnode import (
+    column_hash64, crc32_bytes_matrix, hash_columns64,
+)
+
+
+class TestChunk:
+    def test_column_nulls(self):
+        c = Column.from_list(T.INT64, [1, None, 3])
+        assert c.to_list() == [1, None, 3]
+        assert list(c.validity) == [True, False, True]
+
+    def test_varchar_column(self):
+        c = Column.from_list(T.VARCHAR, ["a", None, "ccc"])
+        assert c.to_list() == ["a", None, "ccc"]
+
+    def test_datachunk_rows_visibility(self):
+        ch = DataChunk.from_rows([T.INT64, T.VARCHAR],
+                                 [(1, "a"), (2, "b"), (3, "c")])
+        assert ch.cardinality == 3
+        vis = ch.with_visibility(np.array([True, False, True]))
+        assert vis.rows() == [(1, "a"), (3, "c")]
+        assert vis.compact().cardinality == 2
+
+    def test_stream_chunk_ops_signs(self):
+        ch = StreamChunk.from_rows(
+            [T.INT64],
+            [(Op.INSERT, (1,)), (Op.DELETE, (2,)),
+             (Op.UPDATE_DELETE, (3,)), (Op.UPDATE_INSERT, (4,))])
+        assert list(ch.signs()) == [1, -1, -1, 1]
+        assert ch.op_rows()[1] == (Op.DELETE, (2,))
+
+    def test_builder_update_pair_not_split(self):
+        b = StreamChunkBuilder([T.INT64], max_chunk_size=2)
+        assert b.append_row(Op.INSERT, (1,)) is None
+        # U- at the boundary must NOT flush until U+ arrives
+        assert b.append_row(Op.UPDATE_DELETE, (2,)) is None
+        out = b.append_row(Op.UPDATE_INSERT, (3,))
+        assert out is not None and out.capacity == 3
+
+    def test_device_chunk_padding(self):
+        ch = StreamChunk.from_rows([T.INT64, T.VARCHAR],
+                                   [(Op.INSERT, (7, "x")), (Op.DELETE, (8, "y"))])
+        d = to_device_chunk(ch)
+        assert d.capacity == 16 and d.n_rows == 2
+        assert d.cols[0].shape == (16,)
+        assert list(np.asarray(d.mask))[:3] == [True, True, False]
+        assert list(np.asarray(d.signs))[:3] == [1, -1, 0]
+
+
+class TestVnode:
+    def test_crc32_matrix_matches_zlib(self):
+        rows = np.frombuffer(b"hello123worldxyz", dtype=np.uint8).reshape(2, 8)
+        out = crc32_bytes_matrix(rows)
+        assert out[0] == zlib.crc32(b"hello123")
+        assert out[1] == zlib.crc32(b"worldxyz")
+
+    def test_vectorized_matches_scalar_int(self):
+        col = Column.from_list(T.INT64, [0, 1, -5, 123456789, None])
+        vn = compute_vnodes([col])
+        for i, v in enumerate([0, 1, -5, 123456789, None]):
+            assert vn[i] == vnode_of_row([v])
+
+    def test_vectorized_matches_scalar_str(self):
+        col = Column.from_list(T.VARCHAR, ["alpha", "beta", None])
+        vn = compute_vnodes([col])
+        for i, v in enumerate(["alpha", "beta", None]):
+            assert vn[i] == vnode_of_row([v])
+
+    def test_multicolumn(self):
+        c1 = Column.from_list(T.INT64, [1, 2])
+        c2 = Column.from_list(T.VARCHAR, ["a", "b"])
+        vn = compute_vnodes([c1, c2])
+        assert vn[0] == vnode_of_row([1, "a"])
+        assert vn[1] == vnode_of_row([2, "b"])
+
+    def test_bool_float_parity(self):
+        cb = Column.from_list(T.BOOLEAN, [True, False])
+        vnb = compute_vnodes([cb])
+        assert vnb[0] == vnode_of_row([True])
+        assert vnb[1] == vnode_of_row([False])
+        cf = Column.from_list(T.FLOAT64, [1.5, -0.0])
+        vnf = compute_vnodes([cf])
+        assert vnf[0] == vnode_of_row([1.5])
+        assert vnf[1] == vnode_of_row([0.0])  # -0.0 == 0.0 must agree
+
+    def test_device_crc_matches_host(self):
+        from risingwave_tpu.core.vnode import compute_vnodes_jnp
+        col = Column.from_list(T.INT64, [0, 42, -7, 999999])
+        host = compute_vnodes([col])
+        dev = np.asarray(compute_vnodes_jnp(np.array([0, 42, -7, 999999],
+                                                     dtype=np.int64)))
+        assert list(host) == list(dev)
+
+    def test_hash64_null_aware(self):
+        c1 = Column.from_list(T.INT64, [1, None])
+        c2 = Column.from_list(T.INT64, [1, None])
+        assert list(column_hash64(c1)) == list(column_hash64(c2))
+        h = hash_columns64([c1, Column.from_list(T.VARCHAR, ["x", "y"])])
+        assert len(h) == 2 and h[0] != h[1]
+
+
+class TestEpoch:
+    def test_epoch_roundtrip(self):
+        e = epoch_from_physical(1234567, 3)
+        assert physical_time_ms(e) == 1234567
+        assert e & 0xFFFF == 3
+
+    def test_monotonic(self):
+        e1 = now_epoch()
+        e2 = now_epoch(e1)
+        assert e2 > e1
+
+    def test_pair(self):
+        p = EpochPair.new_initial(100 << 16)
+        p2 = p.next(200 << 16)
+        assert p2.prev == p.curr
+
+
+class TestEncoding:
+    def test_memcomparable_int_order(self):
+        vals = [-100, -1, 0, 1, 100, None]
+        encs = [encode_datum_memcomparable(v, T.INT64) for v in vals]
+        assert encs == sorted(encs)  # nulls last under ASC
+
+    def test_memcomparable_desc(self):
+        vals = [3, 1, 2]
+        encs = {v: encode_datum_memcomparable(v, T.INT32, desc=True) for v in vals}
+        assert encs[3] < encs[2] < encs[1]
+
+    def test_memcomparable_float_order(self):
+        vals = [-1.5, -0.5, 0.0, 0.25, 2.0]
+        encs = [encode_datum_memcomparable(v, T.FLOAT64) for v in vals]
+        assert encs == sorted(encs)
+
+    def test_memcomparable_string_prefix(self):
+        a = encode_datum_memcomparable("ab", T.VARCHAR)
+        b = encode_datum_memcomparable("abc", T.VARCHAR)
+        c = encode_datum_memcomparable("ac", T.VARCHAR)
+        assert a < b < c
+
+    def test_value_roundtrip(self):
+        from decimal import Decimal
+        dtypes = [T.INT64, T.VARCHAR, T.FLOAT64, T.BOOLEAN, T.DECIMAL, T.TIMESTAMP]
+        row = (42, "hello", 3.5, True, Decimal("1.25"), 1700000000000000)
+        buf = encode_row(row, dtypes)
+        assert decode_row(buf, dtypes) == row
+
+    def test_value_roundtrip_nulls(self):
+        dtypes = [T.INT64, T.VARCHAR]
+        assert decode_row(encode_row((None, None), dtypes), dtypes) == (None, None)
+
+    def test_sort_key_mixed(self):
+        dtypes = [T.INT64, T.VARCHAR]
+        rows = [(1, "b"), (1, "a"), (0, "z"), (2, None)]
+        ordered = sorted(rows, key=lambda r: SortKey(r, dtypes))
+        assert ordered == [(0, "z"), (1, "a"), (1, "b"), (2, None)]
