@@ -20,3 +20,17 @@ Layer map (mirrors SURVEY.md §1, re-hosted):
 """
 
 __version__ = "0.1.0"
+
+
+def _configure_jax() -> None:
+    """SQL BIGINT/TIMESTAMP require 64-bit device integers; enable x64 before
+    any array is created. Hot kernels still downcast to int32/bf16 where the
+    value range allows (see risingwave_tpu/device/)."""
+    try:
+        import jax
+        jax.config.update("jax_enable_x64", True)
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        pass
+
+
+_configure_jax()

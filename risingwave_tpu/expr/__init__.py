@@ -1,0 +1,10 @@
+"""Expression & aggregate function layer (reference: `src/expr/`)."""
+from .agg import AGG_KINDS, AggCall, AggState, DistinctDedup, create_agg_state
+from .expression import Case, Coalesce, Expr, FunctionCall, InputRef, IsNull, Literal
+from .functions import build_func, cast
+
+__all__ = [
+    "AGG_KINDS", "AggCall", "AggState", "DistinctDedup", "create_agg_state",
+    "Case", "Coalesce", "Expr", "FunctionCall", "InputRef", "IsNull", "Literal",
+    "build_func", "cast",
+]

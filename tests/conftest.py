@@ -1,13 +1,22 @@
 """Test configuration: force an 8-virtual-device CPU platform so multi-chip
 sharding paths are exercised without TPU hardware (the driver validates the
-real multi-chip path separately via __graft_entry__.dryrun_multichip)."""
-import os
+real multi-chip path separately via __graft_entry__.dryrun_multichip).
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+The ambient environment registers a real-TPU 'axon' backend via sitecustomize
+and pins JAX_PLATFORMS=axon; env vars alone don't win over that, so we also
+override the jax config directly before any backend is initialized.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

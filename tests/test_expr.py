@@ -1,0 +1,187 @@
+"""Expression layer tests: host/device parity, SQL semantics."""
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.core import Column, DataChunk, dtypes as T
+from risingwave_tpu.expr import (
+    AggCall, Case, DistinctDedup, InputRef, Literal, build_func, cast,
+    create_agg_state,
+)
+
+
+def chunk_i64(*cols):
+    return DataChunk([Column.from_list(T.INT64, list(c)) for c in cols])
+
+
+class TestScalar:
+    def test_add_ints(self):
+        e = build_func("add", [InputRef(0, T.INT64), InputRef(1, T.INT64)])
+        out = e.eval(chunk_i64([1, 2, None], [10, 20, 30]))
+        assert out.to_list() == [11, 22, None]
+
+    def test_int_division_truncates_toward_zero(self):
+        e = build_func("divide", [InputRef(0, T.INT64), InputRef(1, T.INT64)])
+        out = e.eval(chunk_i64([7, -7, 7, -7], [2, 2, -2, -2]))
+        assert out.to_list() == [3, -3, -3, 3]
+
+    def test_division_by_zero_yields_null(self):
+        e = build_func("divide", [InputRef(0, T.INT64), InputRef(1, T.INT64)])
+        out = e.eval(chunk_i64([1], [0]))
+        assert out.to_list() == [None]
+
+    def test_modulus_sign(self):
+        e = build_func("modulus", [InputRef(0, T.INT64), InputRef(1, T.INT64)])
+        out = e.eval(chunk_i64([7, -7, 7, -7], [3, 3, -3, -3]))
+        assert out.to_list() == [1, -1, 1, -1]  # PG: sign of dividend
+
+    def test_decimal_multiply_exact(self):
+        e = build_func("multiply", [InputRef(0, T.INT64), Literal(Decimal("0.908"), T.DECIMAL)])
+        out = e.eval(chunk_i64([100, 25]))
+        assert out.to_list() == [Decimal("90.800"), Decimal("22.700")]
+
+    def test_mixed_promotion(self):
+        e = build_func("add", [InputRef(0, T.INT32), InputRef(1, T.FLOAT64)])
+        ch = DataChunk([Column.from_list(T.INT32, [1]), Column.from_list(T.FLOAT64, [0.5])])
+        assert e.return_type.kind == T.TypeKind.FLOAT64
+        assert e.eval(ch).to_list() == [1.5]
+
+    def test_comparison_strings(self):
+        e = build_func("less_than", [InputRef(0, T.VARCHAR), InputRef(1, T.VARCHAR)])
+        ch = DataChunk([Column.from_list(T.VARCHAR, ["a", "c", None]),
+                        Column.from_list(T.VARCHAR, ["b", "b", "x"])])
+        assert e.eval(ch).to_list() == [True, False, None]
+
+    def test_three_valued_logic(self):
+        a = InputRef(0, T.BOOLEAN)
+        b = InputRef(1, T.BOOLEAN)
+        ch = DataChunk([Column.from_list(T.BOOLEAN, [True, False, None, None]),
+                        Column.from_list(T.BOOLEAN, [None, None, None, True])])
+        and_out = build_func("and", [a, b]).eval(ch)
+        assert and_out.to_list() == [None, False, None, None]
+        or_out = build_func("or", [a, b]).eval(ch)
+        assert or_out.to_list() == [True, None, None, True]  # TRUE OR NULL = TRUE
+
+    def test_case(self):
+        cond = build_func("greater_than", [InputRef(0, T.INT64), Literal(0, T.INT64)])
+        e = Case([(cond, Literal("pos", T.VARCHAR))], Literal("neg", T.VARCHAR), T.VARCHAR)
+        out = e.eval(chunk_i64([5, -5, 0]))
+        assert out.to_list() == ["pos", "neg", "neg"]
+
+    def test_cast_str_int(self):
+        e = cast(InputRef(0, T.VARCHAR), T.INT64)
+        ch = DataChunk([Column.from_list(T.VARCHAR, ["42", " 7 ", "bad"])])
+        assert e.eval(ch).to_list() == [42, 7, None]
+
+    def test_cast_timestamp_str(self):
+        e = cast(InputRef(0, T.VARCHAR), T.TIMESTAMP)
+        ch = DataChunk([Column.from_list(T.VARCHAR, ["2024-01-01 00:00:01"])])
+        (v,) = e.eval(ch).to_list()
+        assert v == 1704067201000000
+
+    def test_like(self):
+        e = build_func("like", [InputRef(0, T.VARCHAR), Literal("%rule%", T.VARCHAR)])
+        ch = DataChunk([Column.from_list(T.VARCHAR, ["hard rules", "soft", None])])
+        assert e.eval(ch).to_list() == [True, False, None]
+
+    def test_substr_split_part(self):
+        e = build_func("split_part", [InputRef(0, T.VARCHAR),
+                                      Literal(",", T.VARCHAR), Literal(2, T.INT32)])
+        ch = DataChunk([Column.from_list(T.VARCHAR, ["a,b,c"])])
+        assert e.eval(ch).to_list() == ["b"]
+
+    def test_extract_date_trunc(self):
+        ts = 1704067201000000  # 2024-01-01 00:00:01
+        e = build_func("extract", [Literal("year", T.VARCHAR), InputRef(0, T.TIMESTAMP)])
+        ch = DataChunk([Column.from_list(T.TIMESTAMP, [ts])])
+        assert e.eval(ch).to_list() == [Decimal(2024)]
+        e2 = build_func("date_trunc", [Literal("day", T.VARCHAR), InputRef(0, T.TIMESTAMP)])
+        assert e2.eval(ch).to_list() == [1704067200000000]
+
+    def test_ts_plus_interval(self):
+        from risingwave_tpu.core import parse_interval
+        e = build_func("add", [InputRef(0, T.TIMESTAMP),
+                               Literal(parse_interval("10 seconds"), T.INTERVAL)])
+        ch = DataChunk([Column.from_list(T.TIMESTAMP, [1000000])])
+        assert e.eval(ch).to_list() == [11000000]
+
+
+class TestDeviceParity:
+    def _both(self, e, ch):
+        import jax.numpy as jnp
+        host = e.eval(ch)
+        cols = [jnp.asarray(c.values) for c in ch.columns]
+        dv, dok = e.eval_device(cols)
+        return host, np.asarray(dv), np.asarray(dok)
+
+    def test_arith_parity(self):
+        e = build_func("multiply", [
+            build_func("add", [InputRef(0, T.INT64), Literal(5, T.INT64)]),
+            InputRef(1, T.INT64)])
+        assert e.supports_device()
+        ch = chunk_i64([1, 2, 3], [4, 5, 6])
+        host, dv, dok = self._both(e, ch)
+        assert host.to_list() == list(dv)
+
+    def test_division_null_parity(self):
+        e = build_func("divide", [InputRef(0, T.INT64), InputRef(1, T.INT64)])
+        ch = chunk_i64([10, 6], [0, 2])
+        host, dv, dok = self._both(e, ch)
+        assert list(dok) == [False, True]
+        assert host.to_list() == [None, 3]
+
+    def test_cmp_and_case_parity(self):
+        cond = build_func("greater_than_or_equal",
+                          [InputRef(0, T.INT64), Literal(2, T.INT64)])
+        e = Case([(cond, InputRef(1, T.INT64))], Literal(0, T.INT64), T.INT64)
+        assert e.supports_device()
+        ch = chunk_i64([1, 2, 3], [10, 20, 30])
+        host, dv, _ = self._both(e, ch)
+        assert host.to_list() == list(dv)
+
+    def test_float_parity(self):
+        e = build_func("multiply", [InputRef(0, T.FLOAT64), Literal(0.908, T.FLOAT64)])
+        ch = DataChunk([Column.from_list(T.FLOAT64, [1.0, 2.5])])
+        host, dv, _ = self._both(e, ch)
+        np.testing.assert_allclose(host.values, dv)
+
+
+class TestAgg:
+    def _run(self, call, pairs):
+        st = create_agg_state(call)
+        for sign, v in pairs:
+            st.apply(sign, v)
+        return st.output()
+
+    def test_count_retract(self):
+        c = AggCall("count")
+        assert self._run(c, [(1, 1), (1, 1), (-1, 1)]) == 1
+
+    def test_sum_bigint_is_decimal(self):
+        c = AggCall("sum", InputRef(0, T.INT64))
+        assert c.return_type.kind == T.TypeKind.DECIMAL
+        assert self._run(c, [(1, 5), (1, 7), (-1, 2)]) == Decimal(10)
+
+    def test_sum_empty_is_null(self):
+        c = AggCall("sum", InputRef(0, T.INT32))
+        assert self._run(c, [(1, 5), (-1, 5)]) is None
+
+    def test_min_retract_recovers_next(self):
+        c = AggCall("min", InputRef(0, T.INT64))
+        assert self._run(c, [(1, 5), (1, 3), (1, 7), (-1, 3)]) == 5
+
+    def test_avg(self):
+        c = AggCall("avg", InputRef(0, T.INT64))
+        assert self._run(c, [(1, 4), (1, 8)]) == Decimal(6)
+
+    def test_first_last_value(self):
+        c = AggCall("last_value", InputRef(0, T.INT64))
+        assert self._run(c, [(1, 1), (1, 2), (1, 3)]) == 3
+
+    def test_distinct_dedup(self):
+        d = DistinctDedup()
+        assert d.apply(1, "x") == 1
+        assert d.apply(1, "x") == 0
+        assert d.apply(-1, "x") == 0
+        assert d.apply(-1, "x") == -1
