@@ -1,0 +1,9 @@
+"""Connectors: sources & sinks (reference: `src/connector/`)."""
+from .datagen import DatagenReader, FieldGen, ListReader
+from .nexmark import (AUCTION_SCHEMA, BID_SCHEMA, PERSON_SCHEMA, NexmarkConfig,
+                      NexmarkGenerator, NexmarkReader)
+
+__all__ = [
+    "DatagenReader", "FieldGen", "ListReader", "AUCTION_SCHEMA", "BID_SCHEMA",
+    "PERSON_SCHEMA", "NexmarkConfig", "NexmarkGenerator", "NexmarkReader",
+]

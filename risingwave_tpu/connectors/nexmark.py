@@ -1,0 +1,273 @@
+"""Nexmark event generator — the benchmark workload source.
+
+Reference: `src/connector/src/source/nexmark/` (which wraps the external
+`nexmark` crate) and the e2e source definitions at
+`e2e_test/nexmark/create_sources.slt.part`. This is an independent,
+vectorized re-implementation of the standard Nexmark generator semantics:
+
+* one global event sequence; event n is a Person if n % 50 == 0, an Auction
+  if n % 50 in 1..=3, else a Bid (1:3:46 proportions);
+* ids are dense per entity type with the standard offsets;
+* bids reference recent "hot" auctions/people with the standard 90% skew;
+* event timestamps advance at a configurable inter-event gap.
+
+Fully deterministic given a seed; all columns generated with numpy
+(vectorized splitmix64) so the generator itself never bottlenecks the
+benchmark.
+
+Schemas (matching the reference's CREATE SOURCE):
+  person(id, name, email_address, credit_card, city, state, date_time, extra)
+  auction(id, item_name, description, initial_bid, reserve, date_time,
+          expires, seller, category, extra)
+  bid(auction, bidder, price, channel, url, date_time, extra)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.chunk import Column, StreamChunk
+from ..core.schema import Field, Schema
+from ..core import dtypes as T
+from ..ops.source import SourceReader
+from .datagen import splitmix64
+
+PERSON_PROPORTION = 1
+AUCTION_PROPORTION = 3
+TOTAL_PROPORTION = 50  # 46 bids per 50 events
+
+FIRST_PERSON_ID = 1000
+FIRST_AUCTION_ID = 1000
+FIRST_CATEGORY_ID = 10
+
+HOT_AUCTION_RATIO = 100
+HOT_BIDDER_RATIO = 100
+HOT_SELLER_RATIO = 100
+
+US_STATES = ["az", "ca", "id", "or", "wa", "wy"]
+US_CITIES = ["phoenix", "los angeles", "san francisco", "boise", "portland",
+             "bend", "redmond", "seattle", "kent", "cheyenne"]
+FIRST_NAMES = ["peter", "paul", "luke", "john", "saul", "vicky", "kate",
+               "julie", "sarah", "deiter", "walter"]
+LAST_NAMES = ["shultz", "abrams", "spencer", "white", "bartels", "walton",
+              "smith", "jones", "noris"]
+CHANNELS = ["apple", "google", "facebook", "baidu"]
+
+PERSON_SCHEMA = Schema.of(
+    ("id", T.INT64), ("name", T.VARCHAR), ("email_address", T.VARCHAR),
+    ("credit_card", T.VARCHAR), ("city", T.VARCHAR), ("state", T.VARCHAR),
+    ("date_time", T.TIMESTAMP), ("extra", T.VARCHAR))
+
+AUCTION_SCHEMA = Schema.of(
+    ("id", T.INT64), ("item_name", T.VARCHAR), ("description", T.VARCHAR),
+    ("initial_bid", T.INT64), ("reserve", T.INT64), ("date_time", T.TIMESTAMP),
+    ("expires", T.TIMESTAMP), ("seller", T.INT64), ("category", T.INT64),
+    ("extra", T.VARCHAR))
+
+BID_SCHEMA = Schema.of(
+    ("auction", T.INT64), ("bidder", T.INT64), ("price", T.INT64),
+    ("channel", T.VARCHAR), ("url", T.VARCHAR), ("date_time", T.TIMESTAMP),
+    ("extra", T.VARCHAR))
+
+
+@dataclass
+class NexmarkConfig:
+    seed: int = 42
+    base_time_usecs: int = 1_500_000_000_000_000  # 2017-07-14-ish, like nexmark
+    inter_event_gap_usecs: int = 100  # matches min.event.gap.in.ns=100 in e2e
+    # auctions stay open for this many events' worth of time
+    auction_duration_events: int = 200
+    strings_on: bool = True  # generating varchar columns costs host time
+
+
+def _event_kinds(event_ids: np.ndarray) -> np.ndarray:
+    """0=person, 1=auction, 2=bid."""
+    m = event_ids % TOTAL_PROPORTION
+    return np.where(m == 0, 0, np.where(m <= AUCTION_PROPORTION, 1, 2))
+
+
+def _person_count_before(event_ids: np.ndarray) -> np.ndarray:
+    """Number of person events among events [0, n)."""
+    full, rem = np.divmod(event_ids, TOTAL_PROPORTION)
+    return full * PERSON_PROPORTION + (rem > 0)
+
+
+def _auction_count_before(event_ids: np.ndarray) -> np.ndarray:
+    full, rem = np.divmod(event_ids, TOTAL_PROPORTION)
+    return full * AUCTION_PROPORTION + np.clip(rem - PERSON_PROPORTION, 0,
+                                               AUCTION_PROPORTION)
+
+
+class NexmarkGenerator:
+    """Vectorized generator over a contiguous range of event ids."""
+
+    def __init__(self, config: Optional[NexmarkConfig] = None):
+        self.cfg = config or NexmarkConfig()
+
+    def _rand(self, ids: np.ndarray, salt: int) -> np.ndarray:
+        return splitmix64(ids.astype(np.uint64)
+                          + np.uint64((self.cfg.seed << 20) + salt))
+
+    def _timestamps(self, event_ids: np.ndarray) -> np.ndarray:
+        return (self.cfg.base_time_usecs
+                + event_ids * self.cfg.inter_event_gap_usecs).astype(np.int64)
+
+    def _strings(self, r: np.ndarray, pool: List[str]) -> List[str]:
+        idx = (r % np.uint64(len(pool))).astype(np.int64)
+        return [pool[i] for i in idx]
+
+    def gen_persons(self, event_ids: np.ndarray) -> StreamChunk:
+        n = len(event_ids)
+        person_idx = _person_count_before(event_ids)  # dense person ordinal
+        ids = (FIRST_PERSON_ID + person_idx).astype(np.int64)
+        ts = self._timestamps(event_ids)
+        cols = [Column(T.INT64, ids)]
+        if self.cfg.strings_on:
+            first = self._strings(self._rand(ids, 1), FIRST_NAMES)
+            last = self._strings(self._rand(ids, 2), LAST_NAMES)
+            names = [f"{a} {b}" for a, b in zip(first, last)]
+            emails = [f"{a}@{b}.com" for a, b in zip(first, last)]
+            cc = [format(int(v) % 10**16, "016d") for v in self._rand(ids, 3)]
+            city = self._strings(self._rand(ids, 4), US_CITIES)
+            state = self._strings(self._rand(ids, 5), US_STATES)
+            extra = ["" for _ in range(n)]
+            cols += [Column.from_list(T.VARCHAR, names),
+                     Column.from_list(T.VARCHAR, emails),
+                     Column.from_list(T.VARCHAR, cc),
+                     Column.from_list(T.VARCHAR, city),
+                     Column.from_list(T.VARCHAR, state)]
+        else:
+            empty = Column.from_list(T.VARCHAR, [""] * n)
+            cols += [empty] * 5
+        cols.append(Column(T.TIMESTAMP, ts))
+        cols.append(Column.from_list(T.VARCHAR, [""] * n))
+        return StreamChunk(np.zeros(n, dtype=np.int8), cols)
+
+    def gen_auctions(self, event_ids: np.ndarray) -> StreamChunk:
+        n = len(event_ids)
+        auction_idx = _auction_count_before(event_ids)
+        ids = (FIRST_AUCTION_ID + auction_idx).astype(np.int64)
+        ts = self._timestamps(event_ids)
+        n_person = np.maximum(_person_count_before(event_ids), 1)
+        r_seller = self._rand(ids, 10)
+        # hot sellers: 90% pick from the most recent 1/HOT_SELLER_RATIO people
+        hot = (r_seller % np.uint64(10)) != 0
+        hot_span = np.maximum(n_person // HOT_SELLER_RATIO, 1)
+        r2 = self._rand(ids, 11)
+        seller_ord = np.where(
+            hot,
+            n_person - 1 - (r2 % hot_span.astype(np.uint64)).astype(np.int64),
+            (r2 % n_person.astype(np.uint64)).astype(np.int64))
+        seller = (FIRST_PERSON_ID + seller_ord).astype(np.int64)
+        category = (FIRST_CATEGORY_ID
+                    + (self._rand(ids, 12) % np.uint64(5)).astype(np.int64))
+        initial_bid = 100 + (self._rand(ids, 13) % np.uint64(1000)).astype(np.int64)
+        reserve = initial_bid + (self._rand(ids, 14) % np.uint64(1000)).astype(np.int64)
+        expires = ts + (self.cfg.auction_duration_events
+                        * self.cfg.inter_event_gap_usecs)
+        cols = [Column(T.INT64, ids)]
+        if self.cfg.strings_on:
+            item = ["item-" + str(int(i)) for i in ids]
+            desc = ["desc-" + str(int(v) % 1000) for v in self._rand(ids, 15)]
+            cols += [Column.from_list(T.VARCHAR, item),
+                     Column.from_list(T.VARCHAR, desc)]
+        else:
+            empty = Column.from_list(T.VARCHAR, [""] * n)
+            cols += [empty, empty]
+        cols += [Column(T.INT64, initial_bid), Column(T.INT64, reserve),
+                 Column(T.TIMESTAMP, ts), Column(T.TIMESTAMP, expires),
+                 Column(T.INT64, seller), Column(T.INT64, category),
+                 Column.from_list(T.VARCHAR, [""] * n)]
+        return StreamChunk(np.zeros(n, dtype=np.int8), cols)
+
+    def gen_bids(self, event_ids: np.ndarray) -> StreamChunk:
+        n = len(event_ids)
+        ts = self._timestamps(event_ids)
+        n_auction = np.maximum(_auction_count_before(event_ids), 1)
+        n_person = np.maximum(_person_count_before(event_ids), 1)
+        r = self._rand(event_ids, 20)
+        hot_a = (r % np.uint64(100)) < np.uint64(90)
+        r2 = self._rand(event_ids, 21)
+        hot_span = np.maximum(n_auction // HOT_AUCTION_RATIO, 1)
+        auction_ord = np.where(
+            hot_a,
+            n_auction - 1 - (r2 % hot_span.astype(np.uint64)).astype(np.int64),
+            (r2 % n_auction.astype(np.uint64)).astype(np.int64))
+        auction = (FIRST_AUCTION_ID + auction_ord).astype(np.int64)
+        r3 = self._rand(event_ids, 22)
+        hot_b = (r3 % np.uint64(100)) < np.uint64(90)
+        r4 = self._rand(event_ids, 23)
+        bspan = np.maximum(n_person // HOT_BIDDER_RATIO, 1)
+        bidder_ord = np.where(
+            hot_b,
+            n_person - 1 - (r4 % bspan.astype(np.uint64)).astype(np.int64),
+            (r4 % n_person.astype(np.uint64)).astype(np.int64))
+        bidder = (FIRST_PERSON_ID + bidder_ord).astype(np.int64)
+        price = 100 + (self._rand(event_ids, 24) % np.uint64(10_000)).astype(np.int64)
+        cols = [Column(T.INT64, auction), Column(T.INT64, bidder),
+                Column(T.INT64, price)]
+        if self.cfg.strings_on:
+            channel = self._strings(self._rand(event_ids, 25), CHANNELS)
+            url = [f"https://www.nexmark.com/{c}/item.htm?query=1" for c in channel]
+            cols += [Column.from_list(T.VARCHAR, channel),
+                     Column.from_list(T.VARCHAR, url)]
+        else:
+            empty = Column.from_list(T.VARCHAR, [""] * n)
+            cols += [empty, empty]
+        cols += [Column(T.TIMESTAMP, ts),
+                 Column.from_list(T.VARCHAR, [""] * n)]
+        return StreamChunk(np.zeros(n, dtype=np.int8), cols)
+
+    def gen_range(self, start_event: int, end_event: int
+                  ) -> Dict[str, StreamChunk]:
+        """All events in [start, end), split per entity stream."""
+        ids = np.arange(start_event, end_event, dtype=np.int64)
+        kinds = _event_kinds(ids)
+        out = {}
+        p = ids[kinds == 0]
+        a = ids[kinds == 1]
+        b = ids[kinds == 2]
+        if len(p):
+            out["person"] = self.gen_persons(p)
+        if len(a):
+            out["auction"] = self.gen_auctions(a)
+        if len(b):
+            out["bid"] = self.gen_bids(b)
+        return out
+
+
+class NexmarkReader(SourceReader):
+    """Reader for one entity stream; all three readers share one event clock
+    (same event-id sequence) so cross-stream joins line up like the reference's
+    single nexmark datagen."""
+
+    def __init__(self, table: str, generator: NexmarkGenerator,
+                 events_per_poll: int = 8192, max_events: Optional[int] = None):
+        assert table in ("person", "auction", "bid")
+        self.table = table
+        self.gen = generator
+        self.events_per_poll = events_per_poll
+        self.max_events = max_events
+        self.next_event = 0
+        self.schema = {"person": PERSON_SCHEMA, "auction": AUCTION_SCHEMA,
+                       "bid": BID_SCHEMA}[table]
+
+    def poll(self) -> Optional[StreamChunk]:
+        if self.max_events is not None and self.next_event >= self.max_events:
+            return None
+        end = self.next_event + self.events_per_poll
+        if self.max_events is not None:
+            end = min(end, self.max_events)
+        chunks = self.gen.gen_range(self.next_event, end)
+        self.next_event = end
+        return chunks.get(self.table)
+
+    def split_states(self) -> Dict[str, Any]:
+        return {f"nexmark-{self.table}": self.next_event}
+
+    def seek(self, states: Dict[str, Any]) -> None:
+        k = f"nexmark-{self.table}"
+        if k in states:
+            self.next_event = int(states[k])

@@ -1,0 +1,15 @@
+"""Stream executors (reference: `src/stream/src/executor/`)."""
+from .executor import Executor, SharedStream, UnaryExecutor
+from .materialize import BatchScan, ConflictBehavior, MaterializeExecutor
+from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Watermark
+from .simple import (ExpandExecutor, FilterExecutor, ProjectExecutor,
+                     RowIdGenExecutor, UnionExecutor, ValuesExecutor)
+from .source import BarrierInjector, SourceExecutor, SourceReader
+
+__all__ = [
+    "Executor", "SharedStream", "UnaryExecutor", "BatchScan",
+    "ConflictBehavior", "MaterializeExecutor", "Barrier", "BarrierKind",
+    "Message", "Mutation", "MutationKind", "Watermark", "ExpandExecutor",
+    "FilterExecutor", "ProjectExecutor", "RowIdGenExecutor", "UnionExecutor",
+    "ValuesExecutor", "BarrierInjector", "SourceExecutor", "SourceReader",
+]

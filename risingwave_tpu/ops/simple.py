@@ -1,0 +1,184 @@
+"""Stateless executors: Project, Filter, Union, Values, RowIdGen, Expand.
+
+Reference: `src/stream/src/executor/{project.rs,filter.rs,union.rs,values.rs,
+row_id_gen.rs,expand.rs}`. These are the vmap-analog layer: per-chunk
+vectorized transforms with no cross-chunk state.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.chunk import Column, Op, StreamChunk
+from ..core.schema import Field, Schema
+from ..core import dtypes as T
+from ..expr.expression import Expr, InputRef
+from .executor import Executor, UnaryExecutor
+from .message import Barrier, Message, Watermark
+
+
+class ProjectExecutor(UnaryExecutor):
+    """Evaluate expressions over each chunk (`project.rs`)."""
+
+    def __init__(self, input: Executor, exprs: Sequence[Expr],
+                 names: Optional[Sequence[str]] = None):
+        names = names or [f"expr#{i}" for i in range(len(exprs))]
+        schema = Schema([Field(n, e.return_type) for n, e in zip(names, exprs)])
+        super().__init__(input, schema)
+        self.exprs = list(exprs)
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        chunk = chunk.compact()
+        data = chunk.data_chunk()
+        cols = [e.eval(data) for e in self.exprs]
+        yield StreamChunk(chunk.ops, cols)
+
+    def on_watermark(self, wm: Watermark) -> Iterator[Message]:
+        # pass through only if some output expr is a direct ref of the col
+        for out_idx, e in enumerate(self.exprs):
+            if isinstance(e, InputRef) and e.index == wm.col_idx:
+                yield Watermark(out_idx, wm.dtype, wm.value)
+                return
+
+
+class FilterExecutor(UnaryExecutor):
+    """Predicate filter with U-/U+ pair fixing (`filter.rs`): when a predicate
+    flips across an update pair, the pair degrades to a single DELETE or
+    INSERT so downstream state stays consistent."""
+
+    def __init__(self, input: Executor, predicate: Expr):
+        super().__init__(input, input.schema)
+        self.predicate = predicate
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        chunk = chunk.compact()
+        pred = self.predicate.eval(chunk.data_chunk())
+        passes = pred.values.astype(np.bool_) & pred.validity
+        ops = chunk.ops.copy()
+        vis = passes.copy()
+        i = 0
+        n = chunk.capacity
+        while i < n:
+            if ops[i] == Op.UPDATE_DELETE and i + 1 < n and ops[i + 1] == Op.UPDATE_INSERT:
+                old_p, new_p = passes[i], passes[i + 1]
+                if old_p and not new_p:
+                    ops[i] = Op.DELETE
+                    vis[i], vis[i + 1] = True, False
+                elif not old_p and new_p:
+                    ops[i + 1] = Op.INSERT
+                    vis[i], vis[i + 1] = False, True
+                i += 2
+            else:
+                i += 1
+        if vis.any():
+            yield StreamChunk(ops, chunk.columns, vis)
+
+
+class UnionExecutor(Executor):
+    """Merge N inputs with barrier alignment (`union.rs` + the alignment that
+    `MergeExecutor` (merge.rs:235) performs): chunks interleave freely between
+    barriers; a barrier is forwarded only once ALL inputs yielded it."""
+
+    def __init__(self, inputs: Sequence[Executor]):
+        super().__init__(inputs[0].schema, "Union")
+        self.inputs = list(inputs)
+
+    def execute(self) -> Iterator[Message]:
+        iters = [inp.execute() for inp in self.inputs]
+        alive = [True] * len(iters)
+        while any(alive):
+            barrier: Optional[Barrier] = None
+            # drain each input up to its barrier
+            for idx, it in enumerate(iters):
+                if not alive[idx]:
+                    continue
+                while True:
+                    try:
+                        msg = next(it)
+                    except StopIteration:
+                        alive[idx] = False
+                        break
+                    if isinstance(msg, Barrier):
+                        barrier = msg
+                        break
+                    if isinstance(msg, Watermark):
+                        continue  # per-input watermarks need min-tracking; TODO
+                    yield msg
+            if barrier is not None:
+                yield barrier.with_trace(self.name)
+            else:
+                return
+
+
+class ValuesExecutor(Executor):
+    """Emit a fixed set of rows once, then pass barriers (`values.rs`)."""
+
+    def __init__(self, schema: Schema, rows: Sequence[Sequence],
+                 barrier_source: "Executor"):
+        super().__init__(schema, "Values")
+        self.rows = list(rows)
+        self.barrier_source = barrier_source
+
+    def execute(self) -> Iterator[Message]:
+        emitted = False
+        for msg in self.barrier_source.execute():
+            if not emitted and isinstance(msg, Barrier):
+                yield msg
+                if self.rows:
+                    from ..core.chunk import StreamChunk as SC
+                    yield SC.from_rows(self.schema.dtypes,
+                                       [(Op.INSERT, r) for r in self.rows])
+                emitted = True
+            else:
+                yield msg
+
+
+class RowIdGenExecutor(UnaryExecutor):
+    """Fill a serial row-id column (`row_id_gen.rs`): ids embed the vnode so
+    generation is conflict-free across parallel shards."""
+
+    def __init__(self, input: Executor, row_id_index: int, shard: int = 0):
+        super().__init__(input, input.schema)
+        self.row_id_index = row_id_index
+        self._next = 0
+        self.shard = shard
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        chunk = chunk.compact()
+        n = chunk.capacity
+        ids = (np.arange(self._next, self._next + n, dtype=np.int64) << 16) | self.shard
+        self._next += n
+        cols = list(chunk.columns)
+        cols[self.row_id_index] = Column(T.SERIAL, ids)
+        yield StreamChunk(chunk.ops, cols)
+
+
+class ExpandExecutor(UnaryExecutor):
+    """Row → multiple subset rows with a flag column (`expand.rs`), used for
+    grouping sets / distinct agg rewrites."""
+
+    def __init__(self, input: Executor, subsets: Sequence[Sequence[int]]):
+        in_schema = input.schema
+        fields = [Field(f.name, f.dtype) for f in in_schema.fields]
+        fields.append(Field("flag", T.INT64))
+        super().__init__(input, Schema(fields), "Expand")
+        self.subsets = [list(s) for s in subsets]
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        chunk = chunk.compact()
+        n = chunk.capacity
+        for flag, subset in enumerate(self.subsets):
+            cols = []
+            for i, c in enumerate(chunk.columns):
+                if i in subset:
+                    cols.append(c)
+                else:
+                    vals = (np.empty(n, dtype=object)
+                            if c.dtype.np_dtype == np.dtype(object)
+                            else np.zeros(n, dtype=c.dtype.np_dtype))
+                    if c.dtype.np_dtype == np.dtype(object):
+                        vals[:] = None
+                    cols.append(Column(c.dtype, vals, np.zeros(n, dtype=np.bool_)))
+            cols.append(Column(T.INT64, np.full(n, flag, dtype=np.int64)))
+            yield StreamChunk(chunk.ops, cols)

@@ -1,0 +1,143 @@
+"""Source executor + barrier injection.
+
+Reference: `src/stream/src/executor/source/source_executor.rs:53` — a source
+actor owns a split reader and a barrier channel; barriers interleave with data
+chunks and split offsets are persisted in a split state table at each barrier.
+
+Here `BarrierInjector` plays the role of the meta barrier RPC fan-out
+(`ControlStreamManager::inject_barrier`, `src/meta/src/barrier/rpc.rs:598`):
+every registered source gets a copy of each barrier; Merge/Join alignment
+downstream reconverges them.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, Optional, Sequence
+
+from ..core.chunk import StreamChunk
+from ..core.epoch import EpochPair, now_epoch
+from ..core.schema import Schema
+from ..core import dtypes as T
+from ..state.state_table import StateTable
+from .executor import Executor
+from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Watermark
+
+
+class SourceReader:
+    """Connector-side reader protocol (`SplitReader` analog,
+    `src/connector/src/source/base.rs:474`)."""
+
+    def poll(self) -> Optional[StreamChunk]:
+        """Next chunk, or None if no data is currently available."""
+        raise NotImplementedError
+
+    def split_states(self) -> Dict[str, Any]:
+        """split_id -> offset, persisted at each barrier."""
+        return {}
+
+    def seek(self, states: Dict[str, Any]) -> None:
+        """Restore split offsets on recovery."""
+
+
+class BarrierInjector:
+    """Creates barriers and fans them out to every registered source."""
+
+    def __init__(self, checkpoint_frequency: int = 1,
+                 start_epoch: Optional[int] = None):
+        self.queues: List[Deque[Barrier]] = []
+        self.checkpoint_frequency = max(1, checkpoint_frequency)
+        self._tick = 0
+        curr = start_epoch if start_epoch is not None else now_epoch()
+        self.epoch = EpochPair.new_initial(curr)
+        self._initial_sent = False
+
+    def register(self) -> Deque[Barrier]:
+        q: Deque[Barrier] = deque()
+        self.queues.append(q)
+        return q
+
+    def inject(self, kind: Optional[BarrierKind] = None,
+               mutation: Optional[Mutation] = None) -> Barrier:
+        if not self._initial_sent:
+            k = BarrierKind.INITIAL
+            self._initial_sent = True
+        elif kind is not None:
+            k = kind
+        else:
+            self._tick += 1
+            k = (BarrierKind.CHECKPOINT
+                 if self._tick % self.checkpoint_frequency == 0
+                 else BarrierKind.BARRIER)
+            self.epoch = self.epoch.next(now_epoch(self.epoch.curr))
+        b = Barrier(self.epoch, k, mutation)
+        for q in self.queues:
+            q.append(b)
+        return b
+
+    def inject_stop(self) -> Barrier:
+        return self.inject(BarrierKind.CHECKPOINT, Mutation(MutationKind.STOP))
+
+    @property
+    def any_pending(self) -> bool:
+        return any(q for q in self.queues)
+
+
+class SourceExecutor(Executor):
+    def __init__(self, schema: Schema, reader: SourceReader,
+                 injector: BarrierInjector,
+                 split_state_table: Optional[StateTable] = None,
+                 name: str = "Source"):
+        super().__init__(schema, name)
+        self.reader = reader
+        self.injector = injector
+        self.queue = injector.register()
+        self.split_state_table = split_state_table
+        self._recovered = False
+
+    def _persist_splits(self, epoch: int) -> None:
+        if self.split_state_table is None:
+            return
+        for split_id, offset in self.reader.split_states().items():
+            self.split_state_table.insert((split_id, repr(offset)))
+        self.split_state_table.commit(epoch)
+
+    def _recover_splits(self) -> None:
+        if self.split_state_table is None or self._recovered:
+            return
+        self._recovered = True
+        states = {}
+        for row in self.split_state_table.iter_all():
+            import ast
+            states[row[0]] = ast.literal_eval(row[1])
+        if states:
+            self.reader.seek(states)
+
+    def execute(self) -> Iterator[Message]:
+        paused = False
+        while True:
+            if self.queue:
+                b = self.queue.popleft()
+                if b.kind == BarrierKind.INITIAL:
+                    self._recover_splits()
+                if b.is_checkpoint:
+                    self._persist_splits(b.epoch.curr)
+                if b.mutation is not None:
+                    if b.mutation.kind == MutationKind.PAUSE:
+                        paused = True
+                    elif b.mutation.kind == MutationKind.RESUME:
+                        paused = False
+                yield b.with_trace(self.name)
+                if b.is_stop():
+                    return
+                continue
+            if paused:
+                # no data while paused; force the runner to tick barriers
+                self.injector.inject()
+                continue
+            chunk = self.reader.poll()
+            if chunk is not None and chunk.cardinality > 0:
+                yield chunk
+            else:
+                # idle: auto-tick a barrier for ALL sources so bounded inputs
+                # drain deterministically and alignment never deadlocks
+                self.injector.inject()

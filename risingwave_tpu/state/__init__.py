@@ -1,0 +1,6 @@
+"""State layer: stores + relational state tables (reference: `src/storage/`,
+`src/stream/src/common/table/`)."""
+from .state_table import StateTable
+from .store import MemoryStateStore, StateStore
+
+__all__ = ["StateTable", "MemoryStateStore", "StateStore"]
