@@ -5,6 +5,11 @@ from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Wate
 from .simple import (ExpandExecutor, FilterExecutor, ProjectExecutor,
                      RowIdGenExecutor, UnionExecutor, ValuesExecutor)
 from .source import BarrierInjector, SourceExecutor, SourceReader
+from .agg import (HashAggExecutor, SimpleAggExecutor,
+                  StatelessSimpleAggExecutor)
+from .join import HashJoinExecutor, JoinType
+from .topn import AppendOnlyDedupExecutor, TopNExecutor
+from .window import HopWindowExecutor, OverWindowExecutor, WindowFuncCall
 
 __all__ = [
     "Executor", "SharedStream", "UnaryExecutor", "BatchScan",
@@ -12,4 +17,7 @@ __all__ = [
     "Message", "Mutation", "MutationKind", "Watermark", "ExpandExecutor",
     "FilterExecutor", "ProjectExecutor", "RowIdGenExecutor", "UnionExecutor",
     "ValuesExecutor", "BarrierInjector", "SourceExecutor", "SourceReader",
+    "HashAggExecutor", "SimpleAggExecutor", "StatelessSimpleAggExecutor",
+    "HashJoinExecutor", "JoinType", "AppendOnlyDedupExecutor", "TopNExecutor",
+    "HopWindowExecutor", "OverWindowExecutor", "WindowFuncCall",
 ]

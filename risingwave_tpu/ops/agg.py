@@ -1,0 +1,279 @@
+"""Aggregation executors: HashAgg, SimpleAgg, StatelessSimpleAgg.
+
+Reference: `src/stream/src/executor/aggregate/{hash_agg.rs,simple_agg.rs,
+stateless_simple_agg.rs,agg_group.rs,distinct.rs}`. Chunk application updates
+in-memory group states; at each barrier the executor emits a change chunk
+(insert / retract / update pairs) for groups whose outputs changed
+(`hash_agg.rs:331,411`), then commits state.
+
+The first implicit aggregate is always row_count (`agg_group.rs` does the
+same): count(*) decides group liveness — a group whose row count reaches 0
+emits a DELETE and drops its state.
+
+The TPU device path for the int-keyed sum/count/min/max subset lives in
+`risingwave_tpu/device/hash_table.py`; this host implementation is the exact
+path and the fallback for retracting min/max, decimals, and strings.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.chunk import Column, Op, StreamChunk, StreamChunkBuilder
+from ..core.schema import Field, Schema
+from ..core import dtypes as T
+from ..expr.agg import AggCall, AggState, DistinctDedup, create_agg_state
+from ..expr.expression import Expr
+from ..state.state_table import StateTable
+from .executor import Executor, UnaryExecutor
+from .message import Barrier, Message, Watermark
+
+_NOT_NULL = object()  # count(*) sentinel value
+
+
+class AggGroup:
+    """Per-group state: row_count + one AggState per call
+    (`agg_group.rs` analog)."""
+
+    __slots__ = ("states", "dedups", "prev_output", "row_count")
+
+    def __init__(self, calls: Sequence[AggCall]):
+        self.states: List[AggState] = [create_agg_state(c) for c in calls]
+        self.dedups: List[Optional[DistinctDedup]] = [
+            DistinctDedup() if c.distinct else None for c in calls]
+        self.prev_output: Optional[Tuple] = None  # None = never emitted
+        self.row_count = 0
+
+    def apply(self, sign: int, values: Sequence[Any]) -> None:
+        self.row_count += sign
+        for i, st in enumerate(self.states):
+            v = values[i]
+            if v is _NOT_NULL:
+                st.apply(sign, v)
+                continue
+            if v is None:
+                continue  # strict aggregates skip NULL inputs
+            d = self.dedups[i]
+            if d is not None:
+                fs = d.apply(sign, v)
+                if fs != 0:
+                    st.apply(fs, v)
+            else:
+                st.apply(sign, v)
+
+    def output(self) -> Tuple:
+        return tuple(st.output() for st in self.states)
+
+
+def _eval_agg_inputs(calls: Sequence[AggCall], chunk: StreamChunk
+                     ) -> List[Optional[np.ndarray]]:
+    """Evaluate each call's arg expression + filter over the chunk once
+    (vectorized); returns per-call value arrays with None for filtered/NULL."""
+    data = chunk.data_chunk()
+    n = chunk.capacity
+    out = []
+    for c in calls:
+        if c.arg is None:
+            vals = np.empty(n, dtype=object)
+            vals[:] = _NOT_NULL
+        else:
+            col = c.arg.eval(data)
+            vals = np.empty(n, dtype=object)
+            for i in range(n):
+                vals[i] = col.get(i)
+        if c.filter is not None:
+            f = c.filter.eval(data)
+            keep = f.values.astype(np.bool_) & f.validity
+            for i in range(n):
+                if not keep[i]:
+                    vals[i] = None
+        out.append(vals)
+    return out
+
+
+class HashAggExecutor(UnaryExecutor):
+    """Group-by aggregation (`hash_agg.rs`)."""
+
+    def __init__(self, input: Executor, group_key_indices: Sequence[int],
+                 calls: Sequence[AggCall],
+                 state_table: Optional[StateTable] = None,
+                 emit_on_window_close: bool = False,
+                 window_col_in_group: Optional[int] = None):
+        in_schema = input.schema
+        fields = [in_schema.fields[i] for i in group_key_indices]
+        fields += [Field(f"agg#{i}", c.return_type) for i, c in enumerate(calls)]
+        super().__init__(input, Schema(fields), "HashAgg")
+        self.group_key_indices = list(group_key_indices)
+        self.calls = list(calls)
+        self.groups: Dict[Tuple, AggGroup] = {}
+        self.dirty: Dict[Tuple, AggGroup] = {}
+        self.state_table = state_table
+        self._recovered = state_table is None
+        # EOWC: buffer change emission until the watermark passes the window
+        # column (`hash_agg.rs:420-429` SortBuffer semantics).
+        self.emit_on_window_close = emit_on_window_close
+        self.window_col_in_group = window_col_in_group
+        self.window_watermark: Optional[Any] = None
+        self._emitted_windows_upto: Optional[Any] = None
+
+    # ---- state persistence (pickled AggGroup per group key) ----
+    def _recover(self) -> None:
+        if self._recovered:
+            return
+        self._recovered = True
+        for row in self.state_table.iter_all():
+            key = tuple(row[: len(self.group_key_indices)])
+            g: AggGroup = pickle.loads(row[-1])
+            self.groups[key] = g
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        self._recover()
+        chunk = chunk.compact()
+        agg_vals = _eval_agg_inputs(self.calls, chunk)
+        signs = chunk.signs()
+        n = chunk.capacity
+        gki = self.group_key_indices
+        for i in range(n):
+            key = tuple(chunk.columns[j].get(i) for j in gki)
+            g = self.groups.get(key)
+            if g is None:
+                g = self.groups[key] = AggGroup(self.calls)
+            g.apply(int(signs[i]), [v[i] for v in agg_vals])
+            self.dirty[key] = g
+        return iter(())
+
+    def _emit_group(self, out: StreamChunkBuilder, key: Tuple, g: AggGroup
+                    ) -> None:
+        new_out = g.output()
+        if g.row_count == 0:
+            if g.prev_output is not None:
+                out.append_row(Op.DELETE, key + g.prev_output)
+            del self.groups[key]
+            if self.state_table is not None:
+                self.state_table.delete(key + (pickle.dumps(g),))
+            return
+        if g.prev_output is None:
+            out.append_row(Op.INSERT, key + new_out)
+        elif g.prev_output != new_out:
+            out.append_update(key + g.prev_output, key + new_out)
+        g.prev_output = new_out
+        if self.state_table is not None:
+            self.state_table.insert(key + (pickle.dumps(g),))
+
+    def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
+        self._recover()
+        out = StreamChunkBuilder(self.schema.dtypes)
+        if self.emit_on_window_close:
+            yield from self._emit_eowc(out)
+        else:
+            for key, g in self.dirty.items():
+                self._emit_group(out, key, g)
+            self.dirty.clear()
+        chunk = out.take()
+        if chunk is not None:
+            yield chunk
+        if self.state_table is not None:
+            self.state_table.commit(barrier.epoch.curr)
+
+    def _emit_eowc(self, out: StreamChunkBuilder) -> Iterator[Message]:
+        """Emit only groups whose window column is closed by the watermark;
+        emitted groups are final (append-only output)."""
+        if self.window_watermark is None:
+            return
+        wm = self.window_watermark
+        wc = self.window_col_in_group
+        ready = [k for k in self.dirty if k[wc] is not None and k[wc] <= wm]
+        for key in sorted(ready, key=lambda k: (k[wc],)):
+            g = self.dirty.pop(key)
+            if g.row_count > 0 and g.prev_output is None:
+                out.append_row(Op.INSERT, key + g.output())
+                g.prev_output = g.output()
+            # closed groups: free state
+            self.groups.pop(key, None)
+        return
+        yield  # pragma: no cover (generator form)
+
+    def on_watermark(self, wm: Watermark) -> Iterator[Message]:
+        if (self.emit_on_window_close and self.window_col_in_group is not None
+                and self.group_key_indices[self.window_col_in_group] == wm.col_idx):
+            self.window_watermark = wm.value
+            yield Watermark(self.window_col_in_group, wm.dtype, wm.value)
+        elif wm.col_idx in self.group_key_indices:
+            yield Watermark(self.group_key_indices.index(wm.col_idx), wm.dtype,
+                            wm.value)
+
+
+class SimpleAggExecutor(UnaryExecutor):
+    """Global aggregation — exactly one group, always emits a row (even for
+    zero input rows, matching SQL `SELECT count(*) FROM t` = 0)
+    (`simple_agg.rs`)."""
+
+    def __init__(self, input: Executor, calls: Sequence[AggCall],
+                 state_table: Optional[StateTable] = None):
+        fields = [Field(f"agg#{i}", c.return_type) for i, c in enumerate(calls)]
+        super().__init__(input, Schema(fields), "SimpleAgg")
+        self.calls = list(calls)
+        self.group = AggGroup(self.calls)
+        self.state_table = state_table
+        self._recovered = state_table is None
+        self.dirty = True  # first barrier emits the initial row
+
+    def _recover(self) -> None:
+        if self._recovered:
+            return
+        self._recovered = True
+        for row in self.state_table.iter_all():
+            self.group = pickle.loads(row[-1])
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        self._recover()
+        chunk = chunk.compact()
+        agg_vals = _eval_agg_inputs(self.calls, chunk)
+        signs = chunk.signs()
+        for i in range(chunk.capacity):
+            self.group.apply(int(signs[i]), [v[i] for v in agg_vals])
+        self.dirty = True
+        return iter(())
+
+    def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
+        self._recover()
+        if self.dirty:
+            new_out = self.group.output()
+            # SQL semantics for the empty group: count()=0, sum()=NULL
+            if self.group.prev_output is None:
+                yield StreamChunk.from_rows(self.schema.dtypes,
+                                            [(Op.INSERT, new_out)])
+            elif new_out != self.group.prev_output:
+                b = StreamChunkBuilder(self.schema.dtypes)
+                b.append_update(self.group.prev_output, new_out)
+                yield b.take()
+            self.group.prev_output = new_out
+            self.dirty = False
+            if self.state_table is not None:
+                self.state_table.insert((0, pickle.dumps(self.group)))
+        if self.state_table is not None:
+            self.state_table.commit(barrier.epoch.curr)
+
+
+class StatelessSimpleAggExecutor(UnaryExecutor):
+    """Per-chunk partial aggregation emitted immediately — the pre-shuffle
+    local agg (`stateless_simple_agg.rs`). Output rows are partial states
+    (e.g. partial sums + counts) to be merged downstream."""
+
+    def __init__(self, input: Executor, calls: Sequence[AggCall]):
+        fields = [Field(f"agg#{i}", c.return_type) for i, c in enumerate(calls)]
+        super().__init__(input, Schema(fields), "StatelessSimpleAgg")
+        self.calls = list(calls)
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        chunk = chunk.compact()
+        g = AggGroup(self.calls)
+        agg_vals = _eval_agg_inputs(self.calls, chunk)
+        signs = chunk.signs()
+        for i in range(chunk.capacity):
+            g.apply(int(signs[i]), [v[i] for v in agg_vals])
+        if g.row_count != 0 or any(s.output() is not None for s in g.states):
+            yield StreamChunk.from_rows(self.schema.dtypes,
+                                        [(Op.INSERT, g.output())])

@@ -114,8 +114,21 @@ class SourceExecutor(Executor):
 
     def execute(self) -> Iterator[Message]:
         paused = False
+        # Data available when a barrier is pending still belongs to the epoch
+        # the barrier seals — drain it first (bounded, so an unbounded reader
+        # cannot starve barriers; reference bounds this with channel capacity).
+        max_chunks_before_barrier = 64
+        drained = 0
         while True:
             if self.queue:
+                if (not paused and drained < max_chunks_before_barrier
+                        and self.queue[0].kind != BarrierKind.INITIAL):
+                    chunk = self.reader.poll()
+                    if chunk is not None and chunk.cardinality > 0:
+                        drained += 1
+                        yield chunk
+                        continue
+                drained = 0
                 b = self.queue.popleft()
                 if b.kind == BarrierKind.INITIAL:
                     self._recover_splits()

@@ -1,0 +1,390 @@
+"""Stateful executor tests: HashAgg, HashJoin, TopN, OverWindow, HopWindow,
+Dedup — including the MV-result oracle: streaming result == batch recompute
+over the same input (the reference's core correctness oracle, SURVEY.md §4)."""
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.core import Column, Op, Schema, StreamChunk, dtypes as T
+from risingwave_tpu.core.dtypes import parse_interval
+from risingwave_tpu.connectors import ListReader
+from risingwave_tpu.expr import AggCall, InputRef, Literal, build_func
+from risingwave_tpu.ops import (
+    AppendOnlyDedupExecutor, BarrierInjector, BatchScan, ConflictBehavior,
+    HashAggExecutor, HashJoinExecutor, HopWindowExecutor, JoinType,
+    MaterializeExecutor, OverWindowExecutor, SimpleAggExecutor, SourceExecutor,
+    TopNExecutor, WindowFuncCall,
+)
+from risingwave_tpu.runtime import StreamJob
+from risingwave_tpu.state import MemoryStateStore, StateTable
+
+
+def run_pipeline(chunks, schema, build, pk, conflict=ConflictBehavior.OVERWRITE):
+    """source(chunks) -> build(source) -> materialize; returns MV rows."""
+    store = MemoryStateStore()
+    injector = BarrierInjector()
+    src = SourceExecutor(schema, ListReader(chunks), injector)
+    node = build(src, store)
+    table = StateTable(store, 1, node.schema.dtypes, list(pk))
+    mat = MaterializeExecutor(node, table, conflict)
+    job = StreamJob(mat, injector, store)
+    job.run_until_idle()
+    return sorted(BatchScan(table, None).rows()), job
+
+
+SCHEMA_KV = Schema.of(("k", T.INT64), ("v", T.INT64))
+
+
+def chunks_kv(*op_rows_lists):
+    return [StreamChunk.from_rows(SCHEMA_KV.dtypes, list(orl))
+            for orl in op_rows_lists]
+
+
+class TestHashAgg:
+    def test_count_sum_grouped(self):
+        chunks = chunks_kv(
+            [(Op.INSERT, (1, 10)), (Op.INSERT, (1, 20)), (Op.INSERT, (2, 5))],
+            [(Op.INSERT, (2, 7)), (Op.DELETE, (1, 10))],
+        )
+        def build(src, store):
+            return HashAggExecutor(
+                src, [0],
+                [AggCall("count"), AggCall("sum", InputRef(1, T.INT64)),
+                 AggCall("min", InputRef(1, T.INT64))],
+                state_table=StateTable(store, 10, [T.INT64, T.BYTEA], [0]))
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        assert rows == [(1, 1, Decimal(20), 20), (2, 2, Decimal(12), 5)]
+
+    def test_group_deletion_emits_delete(self):
+        chunks = chunks_kv(
+            [(Op.INSERT, (1, 10))],
+            [(Op.DELETE, (1, 10))],
+        )
+        def build(src, store):
+            return HashAggExecutor(src, [0], [AggCall("count")])
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        assert rows == []
+
+    def test_updates_collapse(self):
+        chunks = chunks_kv(
+            [(Op.INSERT, (1, 10))],
+            [(Op.UPDATE_DELETE, (1, 10)), (Op.UPDATE_INSERT, (1, 99))],
+        )
+        def build(src, store):
+            return HashAggExecutor(src, [0], [AggCall("sum", InputRef(1, T.INT64)),
+                                              AggCall("max", InputRef(1, T.INT64))])
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        assert rows == [(1, Decimal(99), 99)]
+
+    def test_distinct_agg(self):
+        chunks = chunks_kv(
+            [(Op.INSERT, (1, 7)), (Op.INSERT, (1, 7)), (Op.INSERT, (1, 9))])
+        def build(src, store):
+            return HashAggExecutor(
+                src, [0], [AggCall("count", InputRef(1, T.INT64), distinct=True),
+                           AggCall("count")])
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        assert rows == [(1, 2, 3)]
+
+    def test_agg_state_recovery(self):
+        """Kill-and-restart: rebuild groups from the state table."""
+        store = MemoryStateStore()
+        injector = BarrierInjector()
+        src = SourceExecutor(SCHEMA_KV, ListReader(chunks_kv(
+            [(Op.INSERT, (1, 10)), (Op.INSERT, (1, 5))])), injector)
+        st = StateTable(store, 10, [T.INT64, T.BYTEA], [0])
+        agg = HashAggExecutor(src, [0], [AggCall("sum", InputRef(1, T.INT64))],
+                              state_table=st)
+        table = StateTable(store, 1, agg.schema.dtypes, [0])
+        job = StreamJob(MaterializeExecutor(agg, table,
+                                            ConflictBehavior.OVERWRITE),
+                        injector, store)
+        job.run_until_idle()
+        # "restart": new executor over the same store + more data
+        injector2 = BarrierInjector()
+        src2 = SourceExecutor(SCHEMA_KV, ListReader(chunks_kv(
+            [(Op.INSERT, (1, 1))])), injector2)
+        st2 = StateTable(store, 10, [T.INT64, T.BYTEA], [0])
+        agg2 = HashAggExecutor(src2, [0], [AggCall("sum", InputRef(1, T.INT64))],
+                               state_table=st2)
+        table2 = StateTable(store, 1, agg2.schema.dtypes, [0])
+        job2 = StreamJob(MaterializeExecutor(agg2, table2,
+                                             ConflictBehavior.OVERWRITE),
+                         injector2, store)
+        job2.run_until_idle()
+        assert sorted(BatchScan(table2, None).rows()) == [(1, Decimal(16))]
+
+
+class TestSimpleAgg:
+    def test_global_count_empty_and_updates(self):
+        chunks = chunks_kv([(Op.INSERT, (1, 10)), (Op.INSERT, (2, 20))])
+        def build(src, store):
+            return SimpleAggExecutor(src, [AggCall("count"),
+                                           AggCall("sum", InputRef(1, T.INT64))])
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=())
+        assert rows == [(2, Decimal(30))]
+
+    def test_zero_rows_emits_initial(self):
+        def build(src, store):
+            return SimpleAggExecutor(src, [AggCall("count")])
+        rows, _ = run_pipeline([], SCHEMA_KV, build, pk=())
+        assert rows == [(0,)]
+
+
+AB_SCHEMA = Schema.of(("a_k", T.INT64), ("a_v", T.VARCHAR))
+CD_SCHEMA = Schema.of(("b_k", T.INT64), ("b_v", T.VARCHAR))
+
+
+def run_join(l_chunks, r_chunks, jt, pk, l_keys=(0,), r_keys=(0,), cond=None):
+    store = MemoryStateStore()
+    injector = BarrierInjector()
+    lsrc = SourceExecutor(AB_SCHEMA, ListReader(l_chunks), injector)
+    rsrc = SourceExecutor(CD_SCHEMA, ListReader(r_chunks), injector)
+    join = HashJoinExecutor(lsrc, rsrc, list(l_keys), list(r_keys), jt,
+                            condition=cond)
+    table = StateTable(store, 1, join.schema.dtypes, list(pk))
+    mat = MaterializeExecutor(join, table, ConflictBehavior.OVERWRITE)
+    job = StreamJob(mat, injector, store)
+    job.run_until_idle()
+    return sorted(BatchScan(table, None).rows(),
+                  key=lambda r: tuple((x is None, x) for x in r))
+
+
+def ab(*rows):
+    return [StreamChunk.from_rows(AB_SCHEMA.dtypes, [(op, r) for op, r in rows])]
+
+
+class TestHashJoin:
+    def test_inner_join(self):
+        out = run_join(ab((Op.INSERT, (1, "l1")), (Op.INSERT, (2, "l2"))),
+                       ab((Op.INSERT, (1, "r1")), (Op.INSERT, (3, "r3"))),
+                       JoinType.INNER, pk=(0, 1, 2, 3))
+        assert out == [(1, "l1", 1, "r1")]
+
+    def test_inner_join_retraction(self):
+        l = [StreamChunk.from_rows(AB_SCHEMA.dtypes,
+                                   [(Op.INSERT, (1, "l1"))]),
+             StreamChunk.from_rows(AB_SCHEMA.dtypes,
+                                   [(Op.DELETE, (1, "l1"))])]
+        out = run_join(l, ab((Op.INSERT, (1, "r1"))), JoinType.INNER,
+                       pk=(0, 1, 2, 3))
+        assert out == []
+
+    def test_left_outer_null_padding_and_retract(self):
+        # left arrives first -> null-padded row; right match retracts it
+        l = ab((Op.INSERT, (1, "l1")), (Op.INSERT, (2, "l2")))
+        r = ab((Op.INSERT, (1, "r1")))
+        out = run_join(l, r, JoinType.LEFT_OUTER, pk=(0, 1, 2, 3))
+        assert out == [(1, "l1", 1, "r1"), (2, "l2", None, None)]
+
+    def test_full_outer(self):
+        out = run_join(ab((Op.INSERT, (1, "l1"))),
+                       ab((Op.INSERT, (2, "r2"))),
+                       JoinType.FULL_OUTER, pk=(0, 1, 2, 3))
+        assert out == [(1, "l1", None, None), (None, None, 2, "r2")]
+
+    def test_left_semi(self):
+        out = run_join(ab((Op.INSERT, (1, "l1")), (Op.INSERT, (2, "l2"))),
+                       ab((Op.INSERT, (1, "r1")), (Op.INSERT, (1, "r1b"))),
+                       JoinType.LEFT_SEMI, pk=(0, 1))
+        assert out == [(1, "l1")]
+
+    def test_left_anti_with_flip(self):
+        l = ab((Op.INSERT, (1, "l1")), (Op.INSERT, (2, "l2")))
+        r = [StreamChunk.from_rows(CD_SCHEMA.dtypes, [(Op.INSERT, (1, "r1"))]),
+             StreamChunk.from_rows(CD_SCHEMA.dtypes, [(Op.DELETE, (1, "r1"))])]
+        out = run_join(l, r, JoinType.LEFT_ANTI, pk=(0, 1))
+        # r1 deleted again -> both left rows unmatched
+        assert out == [(1, "l1"), (2, "l2")]
+
+    def test_join_condition(self):
+        cond = build_func("not_equal", [InputRef(1, T.VARCHAR),
+                                        InputRef(3, T.VARCHAR)])
+        out = run_join(ab((Op.INSERT, (1, "x")), (Op.INSERT, (1, "y"))),
+                       ab((Op.INSERT, (1, "x"))),
+                       JoinType.INNER, pk=(0, 1, 2, 3), cond=cond)
+        assert out == [(1, "y", 1, "x")]
+
+
+class TestTopN:
+    def test_top2(self):
+        chunks = chunks_kv(
+            [(Op.INSERT, (1, 50)), (Op.INSERT, (2, 30)), (Op.INSERT, (3, 70))],
+            [(Op.INSERT, (4, 90))],
+            [(Op.DELETE, (3, 70))],
+        )
+        def build(src, store):
+            return TopNExecutor(src, order_by=[(1, True)], limit=2)
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        # final data: 50,30,90 -> top2 by v desc: 90, 50
+        assert sorted(rows) == [(1, 50), (4, 90)]
+
+    def test_topn_offset(self):
+        chunks = chunks_kv([(Op.INSERT, (i, i * 10)) for i in range(1, 6)])
+        def build(src, store):
+            return TopNExecutor(src, order_by=[(1, True)], limit=2, offset=1)
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        # sorted desc: 50,40,30,20,10 -> skip 1, take 2 -> 40,30
+        assert sorted(rows) == [(3, 30), (4, 40)]
+
+    def test_group_topn(self):
+        schema = Schema.of(("g", T.INT64), ("k", T.INT64), ("v", T.INT64))
+        chunks = [StreamChunk.from_rows(schema.dtypes, [
+            (Op.INSERT, (1, 1, 10)), (Op.INSERT, (1, 2, 30)),
+            (Op.INSERT, (1, 3, 20)), (Op.INSERT, (2, 4, 5))])]
+        store = MemoryStateStore()
+        injector = BarrierInjector()
+        src = SourceExecutor(schema, ListReader(chunks), injector)
+        topn = TopNExecutor(src, order_by=[(2, True)], limit=1, group_key=[0])
+        table = StateTable(store, 1, schema.dtypes, [0, 1])
+        job = StreamJob(MaterializeExecutor(topn, table,
+                                            ConflictBehavior.OVERWRITE),
+                        injector, store)
+        job.run_until_idle()
+        assert sorted(BatchScan(table, None).rows()) == [(1, 2, 30), (2, 4, 5)]
+
+
+class TestDedup:
+    def test_append_only_dedup(self):
+        chunks = chunks_kv(
+            [(Op.INSERT, (1, 10)), (Op.INSERT, (1, 99)), (Op.INSERT, (2, 20))])
+        def build(src, store):
+            return AppendOnlyDedupExecutor(src, [0])
+        rows, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        assert rows == [(1, 10), (2, 20)]
+
+
+class TestHopWindow:
+    def test_expansion(self):
+        schema = Schema.of(("id", T.INT64), ("ts", T.TIMESTAMP))
+        # 10s window, 5s hop -> each row in 2 windows
+        chunks = [StreamChunk.from_rows(schema.dtypes,
+                                        [(Op.INSERT, (1, 7_000_000))])]
+        store = MemoryStateStore()
+        injector = BarrierInjector()
+        src = SourceExecutor(schema, ListReader(chunks), injector)
+        hop = HopWindowExecutor(src, 1, parse_interval("5 seconds"),
+                                parse_interval("10 seconds"))
+        table = StateTable(store, 1, hop.schema.dtypes, [0, 2])
+        job = StreamJob(MaterializeExecutor(hop, table,
+                                            ConflictBehavior.OVERWRITE),
+                        injector, store)
+        job.run_until_idle()
+        rows = sorted(BatchScan(table, None).rows())
+        assert rows == [
+            (1, 7_000_000, 0, 10_000_000),
+            (1, 7_000_000, 5_000_000, 15_000_000),
+        ]
+
+
+class TestOverWindow:
+    def test_row_number_rank(self):
+        schema = Schema.of(("g", T.INT64), ("v", T.INT64))
+        chunks = [StreamChunk.from_rows(schema.dtypes, [
+            (Op.INSERT, (1, 30)), (Op.INSERT, (1, 10)), (Op.INSERT, (1, 30)),
+            (Op.INSERT, (2, 5))])]
+        store = MemoryStateStore()
+        injector = BarrierInjector()
+        src = SourceExecutor(schema, ListReader(chunks), injector)
+        ow = OverWindowExecutor(
+            src, partition_by=[0], order_by=[(1, False)],
+            calls=[WindowFuncCall("row_number"), WindowFuncCall("rank"),
+                   WindowFuncCall("sum", InputRef(1, T.INT64))])
+        table = StateTable(store, 1, ow.schema.dtypes, [0, 1, 2])
+        job = StreamJob(MaterializeExecutor(ow, table,
+                                            ConflictBehavior.OVERWRITE),
+                        injector, store)
+        job.run_until_idle()
+        rows = sorted(BatchScan(table, None).rows())
+        # g=1 ordered: 10,30,30 -> rn 1,2,3; rank 1,2,2; running sums 10,40,70
+        assert rows == [
+            (1, 10, 1, 1, Decimal(10)),
+            (1, 30, 2, 2, Decimal(40)),
+            (1, 30, 3, 2, Decimal(70)),
+            (2, 5, 1, 1, Decimal(5)),
+        ]
+
+    def test_lag_and_retraction(self):
+        schema = Schema.of(("g", T.INT64), ("v", T.INT64))
+        chunks = [
+            StreamChunk.from_rows(schema.dtypes, [
+                (Op.INSERT, (1, 10)), (Op.INSERT, (1, 20)), (Op.INSERT, (1, 30))]),
+            StreamChunk.from_rows(schema.dtypes, [(Op.DELETE, (1, 20))]),
+        ]
+        store = MemoryStateStore()
+        injector = BarrierInjector()
+        src = SourceExecutor(schema, ListReader(chunks), injector)
+        ow = OverWindowExecutor(src, [0], [(1, False)],
+                                [WindowFuncCall("lag", InputRef(1, T.INT64))])
+        table = StateTable(store, 1, ow.schema.dtypes, [0, 1])
+        job = StreamJob(MaterializeExecutor(ow, table,
+                                            ConflictBehavior.OVERWRITE),
+                        injector, store)
+        job.run_until_idle()
+        rows = sorted(BatchScan(table, None).rows())
+        assert rows == [(1, 10, None), (1, 30, 10)]
+
+
+class TestMvParityOracle:
+    """Streaming MV == batch recompute — the core oracle (SURVEY.md §4)."""
+
+    def test_agg_parity_random_stream(self):
+        rng = np.random.default_rng(0)
+        rows = []
+        live = []
+        op_rows = []
+        for _ in range(500):
+            if live and rng.random() < 0.3:
+                i = rng.integers(0, len(live))
+                op_rows.append((Op.DELETE, live.pop(i)))
+            else:
+                r = (int(rng.integers(0, 10)), int(rng.integers(0, 100)))
+                live.append(r)
+                op_rows.append((Op.INSERT, r))
+        chunks = [StreamChunk.from_rows(SCHEMA_KV.dtypes, op_rows[i:i + 97])
+                  for i in range(0, len(op_rows), 97)]
+        def build(src, store):
+            return HashAggExecutor(
+                src, [0], [AggCall("count"), AggCall("sum", InputRef(1, T.INT64)),
+                           AggCall("min", InputRef(1, T.INT64)),
+                           AggCall("max", InputRef(1, T.INT64))])
+        rows_out, _ = run_pipeline(chunks, SCHEMA_KV, build, pk=(0,))
+        # batch recompute from surviving rows
+        expect = {}
+        for k, v in live:
+            c, s, mn, mx = expect.get(k, (0, Decimal(0), None, None))
+            expect[k] = (c + 1, s + v,
+                         v if mn is None else min(mn, v),
+                         v if mx is None else max(mx, v))
+        assert rows_out == sorted((k,) + t for k, t in expect.items())
+
+    def test_join_parity_random_stream(self):
+        rng = np.random.default_rng(1)
+        l_live, r_live = [], []
+        l_ops, r_ops = [], []
+        seen = set()  # the stream-key contract: rows are pk-unique per side
+        for _ in range(300):
+            side = rng.random() < 0.5
+            live, ops = (l_live, l_ops) if side else (r_live, r_ops)
+            if live and rng.random() < 0.25:
+                i = rng.integers(0, len(live))
+                row = live.pop(i)
+                seen.discard((side, row))
+                ops.append((Op.DELETE, row))
+            else:
+                r = (int(rng.integers(0, 8)), f"s{int(rng.integers(0, 1000))}")
+                if (side, r) in seen:
+                    continue
+                seen.add((side, r))
+                live.append(r)
+                ops.append((Op.INSERT, r))
+        lc = [StreamChunk.from_rows(AB_SCHEMA.dtypes, l_ops[i:i + 53])
+              for i in range(0, len(l_ops), 53)]
+        rc = [StreamChunk.from_rows(CD_SCHEMA.dtypes, r_ops[i:i + 53])
+              for i in range(0, len(r_ops), 53)]
+        out = run_join(lc, rc, JoinType.INNER, pk=(0, 1, 2, 3))
+        expect = sorted((lk, lv, rk, rv) for lk, lv in l_live
+                        for rk, rv in r_live if lk == rk)
+        # dedup: identical rows collapse in the MV (pk covers all cols)
+        assert out == sorted(set(expect))
