@@ -1,0 +1,97 @@
+"""Benchmark: Nexmark-q4-style streaming group-by aggregation throughput.
+
+Workload: bid events (auction id zipf-ish, price), GROUP BY auction ->
+count(*) / sum(price) / max(price), applied epoch-by-epoch with change-chunk
+emission — the reference's `hash_agg.rs` hot path. Baseline = the exact host
+(numpy/dict) path of this framework on the same rows, i.e. the "single-node
+CPU" of BASELINE.json; value = device-path events/sec on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+
+EPOCHS = 20
+ROWS = 200_000          # events per epoch
+KEYSPACE = 10_000       # live auctions
+
+
+def gen_epochs(seed=42):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(EPOCHS):
+        # skewed auction popularity (zipf tail clipped into keyspace)
+        keys = (rng.zipf(1.3, size=ROWS) % KEYSPACE).astype(np.int64)
+        prices = rng.integers(1, 10_000, size=ROWS).astype(np.int64)
+        out.append((keys, prices))
+    return out
+
+
+def run_device(epochs):
+    from risingwave_tpu.device.agg_step import DeviceAggSpec, DeviceHashAgg
+
+    spec = DeviceAggSpec.build(["count_star", "sum", "max"],
+                               [np.int64, np.int64, np.int64])
+    agg = DeviceHashAgg(spec, capacity=1 << 14)
+    valid = np.ones(ROWS, dtype=bool)
+    ones = np.ones(ROWS, dtype=np.int32)
+    # warmup epoch (compile) on epoch-shaped data, fresh state afterwards
+    k, p = epochs[0]
+    agg.push_rows(k, ones, [(p, valid)] * 3)
+    agg.flush_epoch()
+    agg = DeviceHashAgg(spec, capacity=agg.state.capacity)
+    t0 = time.perf_counter()
+    for k, p in epochs:
+        agg.push_rows(k, ones, [(p, valid)] * 3)
+        agg.flush_epoch()
+    dt = time.perf_counter() - t0
+    return EPOCHS * ROWS / dt, agg
+
+
+def run_host(epochs, limit_epochs=4):
+    """Exact host path: AggGroup dict loop (HashAggExecutor's hot loop)."""
+    from risingwave_tpu.expr.agg import AggCall, create_agg_state
+    from risingwave_tpu.expr.expression import InputRef
+    from risingwave_tpu.core import dtypes as T
+
+    price = InputRef(1, T.INT64)
+    calls = [AggCall("count"), AggCall("sum", price), AggCall("max", price)]
+    groups = {}
+    t0 = time.perf_counter()
+    for k, p in epochs[:limit_epochs]:
+        for i in range(len(k)):
+            g = groups.get(k[i])
+            if g is None:
+                g = groups[k[i]] = [create_agg_state(c) for c in calls]
+            g[0].apply(1, 1)
+            g[1].apply(1, p[i])
+            g[2].apply(1, p[i])
+    dt = time.perf_counter() - t0
+    return limit_epochs * ROWS / dt
+
+
+def main():
+    epochs = gen_epochs()
+    device_eps, agg = run_device(epochs)
+    host_eps = run_host(epochs)
+    import jax
+    result = {
+        "metric": "nexmark_q4_agg_throughput",
+        "value": round(device_eps),
+        "unit": "events/s",
+        "vs_baseline": round(device_eps / host_eps, 3),
+        "detail": {
+            "host_baseline_eps": round(host_eps),
+            "epochs": EPOCHS, "rows_per_epoch": ROWS,
+            "groups": int(np.asarray(agg.state.count)),
+            "platform": jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

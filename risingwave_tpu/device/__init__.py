@@ -1,0 +1,30 @@
+"""Device (TPU) execution path.
+
+State lives in HBM as *sorted runs* (`sorted_state.py`) — the TPU-idiomatic
+re-design of the reference's hash-keyed state
+(`src/stream/src/executor/join/hash_join.rs:181` JoinHashMap,
+`src/stream/src/executor/aggregate/hash_agg.rs:52` AggGroup LRU over
+StateTables): instead of pointer-chasing hash tables (scatter-conflict-hostile
+on a vector machine), per-vnode-shard state is a sorted key/payload array and
+every epoch's delta is applied as a sort + segment-reduce + merge + compact —
+all XLA-native primitives that tile cleanly. This is an in-HBM LSM memtable:
+the same shape as the reference's Hummock shared buffer
+(`src/storage/src/hummock/shared_buffer/shared_buffer_batch.rs`), applied at
+barrier granularity.
+
+64-bit keys/accumulators need x64 — enabled here, before any array is made.
+"""
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .sorted_state import (  # noqa: E402,F401
+    EMPTY_KEY,
+    ReduceKind,
+    SortedState,
+    batch_reduce,
+    grow_state,
+    lookup,
+    make_state,
+    merge,
+)

@@ -1,0 +1,226 @@
+"""Jitted hash-aggregation epoch step over sorted-run state.
+
+Device analog of `HashAggExecutor::apply_chunk` + barrier `flush_data`
+(`src/stream/src/executor/aggregate/hash_agg.rs:331,411`), re-shaped for XLA:
+the whole epoch's rows are applied as ONE traced program —
+
+    rows -> per-key deltas -> (lookup old outputs) -> merge -> (lookup new)
+         -> change set (insert / delete / update-pair material)
+
+so the device never sees data-dependent control flow, and barrier-granular
+batching (parity is defined at barrier boundaries; intra-epoch order is free)
+is the optimization license, exactly the reference's shared-buffer trick.
+
+Supported device aggregates: count / count(col) / sum / avg (retractable),
+min / max (append-only — the same restriction the reference's value-state agg
+has before falling back to MaterializedInput, `aggregate/minput.rs`). The
+host executor keeps the exact path for everything else.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sorted_state import (EMPTY_KEY, ReduceKind, SortedState, batch_reduce,
+                           grow_state, lookup, make_state, merge)
+
+# Aggregate kinds the device step supports.
+DEVICE_AGG_KINDS = ("count", "count_star", "sum", "avg", "min", "max")
+
+
+@dataclass(frozen=True)
+class DeviceCall:
+    """One aggregate call, lowered: which payload columns it owns and how to
+    turn them into an output."""
+    kind: str                   # one of DEVICE_AGG_KINDS
+    acc_dtype: Any              # jnp dtype of the accumulator / output
+    cols: Tuple[int, ...]       # payload column indices (in state.vals)
+
+
+@dataclass(frozen=True)
+class DeviceAggSpec:
+    """Static layout of the state payload.
+
+    Payload column 0 is always row_count (SUM of signs) — group liveness,
+    as in `agg_group.rs`. Each call then owns 1-2 columns:
+      count      -> [valid_count SUM]
+      sum        -> [sum SUM, valid_count SUM]     (NULL when no valid rows)
+      avg        -> [sum SUM, valid_count SUM]
+      min / max  -> [extreme MIN/MAX, valid_count SUM]  (append-only)
+    """
+    calls: Tuple[DeviceCall, ...]
+    kinds: Tuple[ReduceKind, ...]
+    dtypes: Tuple[Any, ...]
+    append_only: bool
+
+    @staticmethod
+    def build(call_kinds: Sequence[str], in_dtypes: Sequence[Any]
+              ) -> "DeviceAggSpec":
+        kinds: List[ReduceKind] = [ReduceKind.SUM]       # row_count
+        dtypes: List[Any] = [jnp.int64]
+        calls: List[DeviceCall] = []
+        append_only = False
+        for k, dt in zip(call_kinds, in_dtypes):
+            if k not in DEVICE_AGG_KINDS:
+                raise ValueError(f"agg kind {k!r} has no device path")
+            dt = jnp.dtype(dt)
+            acc = (jnp.dtype(jnp.float64)
+                   if jnp.issubdtype(dt, jnp.floating) else jnp.dtype(jnp.int64))
+            if k in ("count", "count_star"):
+                c0 = len(kinds)
+                kinds.append(ReduceKind.SUM); dtypes.append(jnp.int64)
+                calls.append(DeviceCall(k, jnp.dtype(jnp.int64), (c0,)))
+            elif k in ("sum", "avg"):
+                c0 = len(kinds)
+                kinds += [ReduceKind.SUM, ReduceKind.SUM]
+                dtypes += [acc, jnp.int64]
+                calls.append(DeviceCall(k, acc, (c0, c0 + 1)))
+            else:  # min / max
+                append_only = True
+                c0 = len(kinds)
+                kinds += [ReduceKind.MIN if k == "min" else ReduceKind.MAX,
+                          ReduceKind.SUM]
+                dtypes += [acc, jnp.int64]
+                calls.append(DeviceCall(k, acc, (c0, c0 + 1)))
+        return DeviceAggSpec(tuple(calls), tuple(kinds), tuple(dtypes),
+                             append_only)
+
+    def make_state(self, capacity: int) -> SortedState:
+        return make_state(capacity, self.dtypes, self.kinds)
+
+
+def _row_deltas(spec: DeviceAggSpec, signs, mask,
+                inputs: Sequence[Tuple[Any, Any]]) -> List[jax.Array]:
+    """Per-row payload delta columns from raw rows.
+    inputs[i] = (values[B], valid[B]) for call i (count_star passes anything).
+    """
+    s64 = jnp.where(mask, signs, 0).astype(jnp.int64)
+    deltas: List[Optional[jax.Array]] = [None] * len(spec.kinds)
+    deltas[0] = s64
+    for call, (vals, valid) in zip(spec.calls, inputs):
+        sv = s64 * valid.astype(jnp.int64)
+        if call.kind == "count_star":
+            deltas[call.cols[0]] = s64
+        elif call.kind == "count":
+            deltas[call.cols[0]] = sv
+        elif call.kind in ("sum", "avg"):
+            v = jnp.where(valid & mask, vals, 0).astype(call.acc_dtype)
+            deltas[call.cols[0]] = v * sv.astype(call.acc_dtype)
+            deltas[call.cols[1]] = sv
+        else:  # min / max — append-only: neutral where invalid
+            kind = spec.kinds[call.cols[0]]
+            from .sorted_state import _neutral
+            v = jnp.where(valid & mask, vals.astype(call.acc_dtype),
+                          _neutral(kind, call.acc_dtype))
+            deltas[call.cols[0]] = v
+            deltas[call.cols[1]] = sv
+    return deltas  # type: ignore[return-value]
+
+
+def _outputs(spec: DeviceAggSpec, vals: Sequence[jax.Array]
+             ) -> Tuple[List[jax.Array], List[jax.Array]]:
+    """Payload columns -> (per-call output arrays, per-call NULL masks)."""
+    outs, nulls = [], []
+    for call in spec.calls:
+        if call.kind in ("count", "count_star"):
+            outs.append(vals[call.cols[0]])
+            nulls.append(jnp.zeros_like(vals[call.cols[0]], dtype=bool))
+        elif call.kind == "sum":
+            outs.append(vals[call.cols[0]])
+            nulls.append(vals[call.cols[1]] == 0)
+        elif call.kind == "avg":
+            cnt = vals[call.cols[1]]
+            denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
+            outs.append(vals[call.cols[0]].astype(jnp.float64) / denom)
+            nulls.append(cnt == 0)
+        else:
+            outs.append(vals[call.cols[0]])
+            nulls.append(vals[call.cols[1]] == 0)
+    return outs, nulls
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
+                   keys: jax.Array, signs: jax.Array, mask: jax.Array,
+                   inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """Apply one epoch of rows; return (new_state, needed, change set).
+
+    Change set arrays are sized [B] (unique touched keys); host assembles the
+    barrier change chunk from them (insert/delete/update-pair per key).
+    """
+    deltas = _row_deltas(spec, signs, mask, inputs)
+    ukeys, udeltas, ucount = batch_reduce(keys, mask, deltas, spec.kinds)
+    old_found, old_vals = lookup(state, ukeys)
+    new_state, needed = merge(state, ukeys, udeltas, spec.kinds)
+    new_found, new_vals = lookup(new_state, ukeys)
+    old_out, old_null = _outputs(spec, old_vals)
+    new_out, new_null = _outputs(spec, new_vals)
+    changes = {
+        "keys": ukeys, "count": ucount,
+        "old_found": old_found, "new_found": new_found,
+        "old_out": tuple(old_out), "old_null": tuple(old_null),
+        "new_out": tuple(new_out), "new_null": tuple(new_null),
+    }
+    return new_state, needed, changes
+
+
+def _bucket(n: int, lo: int = 256) -> int:
+    return max(lo, 1 << (max(1, n) - 1).bit_length())
+
+
+class DeviceHashAgg:
+    """Host wrapper: owns the state, buffers the epoch's rows, applies at
+    barrier, grows capacity on overflow (recompile per pow2 bucket)."""
+
+    def __init__(self, spec: DeviceAggSpec, capacity: int = 1024):
+        self.spec = spec
+        self.state = spec.make_state(capacity)
+        self._keys: List[np.ndarray] = []
+        self._signs: List[np.ndarray] = []
+        self._inputs: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+
+    def push_rows(self, keys: np.ndarray, signs: np.ndarray,
+                  inputs: Sequence[Tuple[np.ndarray, np.ndarray]]) -> None:
+        self._keys.append(keys.astype(np.int64))
+        self._signs.append(signs.astype(np.int32))
+        self._inputs.append([(np.asarray(v), np.asarray(m)) for v, m in inputs])
+
+    def flush_epoch(self) -> Optional[Dict[str, Any]]:
+        """Run the epoch step; returns the change set (host numpy) or None."""
+        if not self._keys:
+            return None
+        keys = np.concatenate(self._keys)
+        signs = np.concatenate(self._signs)
+        ncalls = len(self.spec.calls)
+        ins = []
+        for i in range(ncalls):
+            vs = np.concatenate([b[i][0] for b in self._inputs])
+            ms = np.concatenate([b[i][1] for b in self._inputs])
+            ins.append((vs, ms))
+        self._keys, self._signs, self._inputs = [], [], []
+        b = _bucket(len(keys))
+        pad = b - len(keys)
+        mask = np.zeros(b, dtype=bool); mask[: len(keys)] = True
+        keys = np.pad(keys, (0, pad))
+        signs = np.pad(signs, (0, pad))
+        ins = tuple((jnp.asarray(np.pad(v.astype(np.float64)
+                                        if v.dtype == np.float64 else
+                                        v.astype(np.int64), (0, pad))),
+                     jnp.asarray(np.pad(m.astype(bool), (0, pad))))
+                    for v, m in ins)
+        while True:
+            new_state, needed, changes = agg_epoch_step(
+                self.spec, self.state, jnp.asarray(keys), jnp.asarray(signs),
+                jnp.asarray(mask), ins)
+            n = int(needed)
+            if n <= self.state.capacity:
+                self.state = new_state
+                break
+            cap = _bucket(n, lo=self.state.capacity * 2)
+            self.state = grow_state(self.state, cap, self.spec.kinds)
+        return jax.tree_util.tree_map(np.asarray, changes)

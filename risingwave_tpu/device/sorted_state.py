@@ -1,0 +1,177 @@
+"""Sorted-run keyed state in HBM + functional epoch-merge ops.
+
+The device analog of `StateTable` + executor caches
+(`src/stream/src/common/table/state_table.rs:91`,
+`src/stream/src/executor/aggregate/hash_agg.rs:52`): a fixed-capacity,
+key-sorted set of (key, payload...) slots. All ops are pure functions of
+jax arrays with static shapes, so an epoch apply is one jitted XLA program:
+
+    delta rows --batch_reduce--> unique per-key deltas
+               --merge--------> new state (+ needed-slot count for resize)
+    queries    --lookup-------> gathered payloads
+
+Empty slots hold EMPTY_KEY (int64 max) so they sort past every live key and
+binary search stays valid. Capacity growth is host-driven: `merge` reports
+how many slots it *needed*; when that exceeds capacity the host re-pads the
+old state to 2x and re-runs (one recompile per capacity bucket).
+"""
+from __future__ import annotations
+
+import enum
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
+
+
+class ReduceKind(enum.IntEnum):
+    """How a payload column combines across rows of the same key."""
+    SUM = 0   # additive (counts, sums; retraction = sign-weighted add)
+    MIN = 1   # append-only min
+    MAX = 2   # append-only max
+
+
+def _neutral(kind: ReduceKind, dtype) -> jnp.ndarray:
+    if kind == ReduceKind.SUM:
+        return jnp.zeros((), dtype=dtype)
+    big = (jnp.iinfo(dtype).max if jnp.issubdtype(dtype, jnp.integer)
+           else jnp.asarray(jnp.inf, dtype=dtype))
+    small = (jnp.iinfo(dtype).min if jnp.issubdtype(dtype, jnp.integer)
+             else jnp.asarray(-jnp.inf, dtype=dtype))
+    return jnp.asarray(big if kind == ReduceKind.MIN else small, dtype=dtype)
+
+
+def _combine(kind: ReduceKind, a, b):
+    if kind == ReduceKind.SUM:
+        return a + b
+    return jnp.minimum(a, b) if kind == ReduceKind.MIN else jnp.maximum(a, b)
+
+
+class SortedState(NamedTuple):
+    """keys sorted ascending; slots >= count hold EMPTY_KEY / neutral vals."""
+    keys: jax.Array                  # int64 (C,)
+    count: jax.Array                 # int32 scalar — live slots
+    vals: Tuple[jax.Array, ...]      # each (C,), payload columns
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+
+def make_state(capacity: int, val_dtypes: Sequence, kinds: Sequence[ReduceKind]
+               ) -> SortedState:
+    keys = jnp.full((capacity,), EMPTY_KEY, dtype=jnp.int64)
+    vals = tuple(jnp.full((capacity,), _neutral(k, jnp.dtype(d)), dtype=d)
+                 for d, k in zip(val_dtypes, kinds))
+    return SortedState(keys=keys, count=jnp.zeros((), jnp.int32), vals=vals)
+
+
+def grow_state(state: SortedState, new_capacity: int,
+               kinds: Sequence[ReduceKind]) -> SortedState:
+    """Host-side re-pad (not jitted); sorted order is preserved because pads
+    are EMPTY_KEY at the tail."""
+    c = state.capacity
+    assert new_capacity >= c
+    pad = new_capacity - c
+    keys = jnp.concatenate([state.keys,
+                            jnp.full((pad,), EMPTY_KEY, dtype=jnp.int64)])
+    vals = tuple(
+        jnp.concatenate([v, jnp.full((pad,), _neutral(k, v.dtype),
+                                     dtype=v.dtype)])
+        for v, k in zip(state.vals, kinds))
+    return SortedState(keys=keys, count=state.count, vals=vals)
+
+
+def batch_reduce(keys: jax.Array, mask: jax.Array,
+                 vals: Sequence[jax.Array], kinds: Sequence[ReduceKind]
+                 ) -> Tuple[jax.Array, Tuple[jax.Array, ...], jax.Array]:
+    """Pre-reduce a row batch to unique per-key deltas.
+
+    Masked-out rows are neutralized (key -> EMPTY_KEY, value -> neutral).
+    Returns (ukeys[B], uvals[B each], ucount) where only the first `ucount`
+    slots are live; the rest are EMPTY_KEY. Output is key-sorted.
+    """
+    b = keys.shape[0]
+    keys = jnp.where(mask, keys, EMPTY_KEY)
+    vals = [jnp.where(mask, v, _neutral(k, v.dtype))
+            for v, k in zip(vals, kinds)]
+    order = jnp.argsort(keys)
+    keys = keys[order]
+    vals = [v[order] for v in vals]
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), keys[1:] != keys[:-1]])
+    seg = jnp.cumsum(boundary) - 1                      # segment id per row
+    ukeys = jnp.full((b,), EMPTY_KEY, dtype=jnp.int64).at[seg].set(keys)
+    out = []
+    for v, k in zip(vals, kinds):
+        if k == ReduceKind.SUM:
+            r = jax.ops.segment_sum(v, seg, num_segments=b)
+        elif k == ReduceKind.MIN:
+            r = jax.ops.segment_min(v, seg, num_segments=b)
+        else:
+            r = jax.ops.segment_max(v, seg, num_segments=b)
+        # untouched segments get segment-op defaults; force neutral dtype-wise
+        live = jnp.arange(b) <= seg[-1]
+        r = jnp.where(live, r.astype(v.dtype), _neutral(k, v.dtype))
+        out.append(r)
+    ucount = jnp.sum(boundary & (keys != EMPTY_KEY)).astype(jnp.int32)
+    # EMPTY_KEY rows sorted last => their segment is the final one; clear it
+    out = [jnp.where(ukeys == EMPTY_KEY, _neutral(k, v.dtype), v)
+           for v, k in zip(out, kinds)]
+    return ukeys, tuple(out), ucount
+
+
+def merge(state: SortedState, dkeys: jax.Array,
+          dvals: Sequence[jax.Array], kinds: Sequence[ReduceKind],
+          drop_dead: bool = True, dead_col: int = 0
+          ) -> Tuple[SortedState, jax.Array]:
+    """Merge unique per-key deltas (from `batch_reduce`) into the state.
+
+    Every key appears at most once in `state` and at most once in the delta,
+    so after the merge-sort each key forms a run of length <= 2 — combining is
+    a single shifted compare, no segment scan. With `drop_dead`, rows whose
+    combined `dead_col` payload (row_count) hits 0 are compacted away — group
+    death (`hash_agg.rs` emits DELETE and drops state when count reaches 0).
+
+    Returns (new_state, needed) — `needed` > capacity means the merge was
+    truncated and must be retried on a grown state.
+    """
+    c = state.capacity
+    keys = jnp.concatenate([state.keys, dkeys])
+    vals = [jnp.concatenate([sv, dv]) for sv, dv in zip(state.vals, dvals)]
+    order = jnp.argsort(keys)
+    keys = keys[order]
+    vals = [v[order] for v in vals]
+    same_next = jnp.concatenate([keys[:-1] == keys[1:], jnp.zeros((1,), bool)])
+    same_prev = jnp.concatenate([jnp.zeros((1,), bool), keys[1:] == keys[:-1]])
+    merged = []
+    for v, k in zip(vals, kinds):
+        nxt = jnp.concatenate([v[1:], v[-1:]])
+        merged.append(jnp.where(same_next, _combine(k, v, nxt), v))
+    alive = ~same_prev & (keys != EMPTY_KEY)
+    if drop_dead:
+        alive &= merged[dead_col] != 0
+    dest = jnp.cumsum(alive) - 1
+    needed = jnp.sum(alive).astype(jnp.int32)
+    scatter_idx = jnp.where(alive, dest, c + dkeys.shape[0])  # OOB => dropped
+    new_keys = jnp.full((c,), EMPTY_KEY, dtype=jnp.int64
+                        ).at[scatter_idx].set(keys, mode='drop')
+    new_vals = tuple(
+        jnp.full((c,), _neutral(k, v.dtype), dtype=v.dtype
+                 ).at[scatter_idx].set(v, mode='drop')
+        for v, k in zip(merged, kinds))
+    new_count = jnp.minimum(needed, c)
+    return SortedState(new_keys, new_count, new_vals), needed
+
+
+def lookup(state: SortedState, qkeys: jax.Array
+           ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+    """Binary-search gather. Returns (found[B], vals at match — neutral-ish
+    garbage where not found; gate on `found`)."""
+    idx = jnp.searchsorted(state.keys, qkeys)
+    idx = jnp.minimum(idx, state.capacity - 1)
+    found = (state.keys[idx] == qkeys) & (qkeys != EMPTY_KEY)
+    return found, tuple(v[idx] for v in state.vals)

@@ -1,0 +1,48 @@
+"""Device mesh + vnode -> shard mapping.
+
+Analog of the reference's WorkerSlotMapping / vnode mapping
+(`src/common/src/hash/consistent_hash/vnode_mapping/`, `hash/
+table_distribution.rs`): vnodes are assigned to parallel units in contiguous
+blocks. Contiguous blocks (not round-robin) keep a shard's key-range compact,
+which is what the sorted-run state wants, and make rescale a block-boundary
+move (`scale.rs:2329` analog) rather than a full reshuffle.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.vnode import VNODE_COUNT
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over the shard axis. Multi-host meshes come from passing the
+    global device list; the shape is (n,) either way — streaming dataflow
+    parallelism is one-dimensional (vnodes), unlike ML TP x DP grids."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def vnode_block_bounds(n_shards: int, vnode_count: int = VNODE_COUNT
+                       ) -> np.ndarray:
+    """start vnode of each shard's contiguous block, plus end sentinel."""
+    return (np.arange(n_shards + 1) * vnode_count) // n_shards
+
+
+def shard_of_vnode(vnodes, n_shards: int, vnode_count: int = VNODE_COUNT):
+    """Works on numpy or jnp arrays (pure arithmetic, jit-safe)."""
+    return (vnodes * n_shards) // vnode_count
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """State arrays are [n_shards, ...] sharded on the leading axis."""
+    return NamedSharding(mesh, P(SHARD_AXIS))

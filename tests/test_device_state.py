@@ -1,0 +1,150 @@
+"""Device sorted-run state + agg epoch step, vs a dict-based oracle."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from risingwave_tpu.device import (EMPTY_KEY, ReduceKind, batch_reduce,
+                                   lookup, make_state, merge)
+from risingwave_tpu.device.agg_step import DeviceAggSpec, DeviceHashAgg
+
+
+def np_state(state):
+    n = int(state.count)
+    return {int(k): tuple(float(v[i]) for v in state.vals)
+            for i, k in enumerate(np.asarray(state.keys)[:n])}
+
+
+def test_batch_reduce_unique_sums():
+    keys = jnp.asarray([5, 3, 5, 3, 5, 9], dtype=jnp.int64)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 0], dtype=bool)
+    vals = [jnp.asarray([1, 10, 2, 20, 3, 99], dtype=jnp.int64)]
+    uk, uv, uc = batch_reduce(keys, mask, vals, [ReduceKind.SUM])
+    assert int(uc) == 2
+    got = {int(k): int(v) for k, v in zip(np.asarray(uk), np.asarray(uv[0]))
+           if k != EMPTY_KEY}
+    assert got == {3: 30, 5: 6}
+
+
+def test_merge_insert_update_delete():
+    st = make_state(8, [jnp.int64, jnp.int64], [ReduceKind.SUM, ReduceKind.SUM])
+    # insert keys 1,2 with row_count 2,1
+    dk = jnp.asarray([1, 2] + [int(EMPTY_KEY)] * 2, dtype=jnp.int64)
+    dv = [jnp.asarray([2, 1, 0, 0], dtype=jnp.int64),
+          jnp.asarray([20, 10, 0, 0], dtype=jnp.int64)]
+    st, needed = merge(st, dk, dv, [ReduceKind.SUM, ReduceKind.SUM])
+    assert int(needed) == 2 and np_state(st) == {1: (2, 20), 2: (1, 10)}
+    # retract key 2 fully, update key 1, insert 7
+    dk = jnp.asarray([2, 1, 7, int(EMPTY_KEY)], dtype=jnp.int64)
+    dv = [jnp.asarray([-1, 1, 3, 0], dtype=jnp.int64),
+          jnp.asarray([-10, 5, 7, 0], dtype=jnp.int64)]
+    st, needed = merge(st, dk, dv, [ReduceKind.SUM, ReduceKind.SUM])
+    assert np_state(st) == {1: (3, 25), 7: (3, 7)}
+    found, vals = lookup(st, jnp.asarray([1, 2, 7], dtype=jnp.int64))
+    assert list(np.asarray(found)) == [True, False, True]
+    assert int(vals[1][0]) == 25 and int(vals[1][2]) == 7
+
+
+def test_merge_overflow_reports_needed():
+    st = make_state(4, [jnp.int64], [ReduceKind.SUM])
+    dk = jnp.asarray([1, 2, 3, 4, 5, 6], dtype=jnp.int64)
+    dv = [jnp.ones(6, dtype=jnp.int64)]
+    st, needed = merge(st, dk, dv, [ReduceKind.SUM])
+    assert int(needed) == 6  # > capacity: caller must grow and retry
+
+
+def random_oracle_run(seed, kinds, n_epochs=6, rows=200, keyspace=17):
+    rng = np.random.default_rng(seed)
+    spec = DeviceAggSpec.build(kinds, [np.int64] * len(kinds))
+    agg = DeviceHashAgg(spec, capacity=8)  # force growth
+    oracle = {}  # key -> list of multisets? maintain sums/counts
+    out_oracle = {}
+    for _ in range(n_epochs):
+        keys = rng.integers(0, keyspace, size=rows).astype(np.int64)
+        vals = rng.integers(-50, 50, size=rows).astype(np.int64)
+        valid = rng.random(rows) > 0.1
+        if any(k in ("min", "max") for k in kinds):
+            signs = np.ones(rows, dtype=np.int32)
+        else:
+            signs = np.where(rng.random(rows) > 0.3, 1, -1).astype(np.int32)
+            # keep oracle row counts non-negative: flip deletes of absent keys
+            cnt = dict.fromkeys(range(keyspace), 0)
+            for i in range(rows):
+                k = int(keys[i])
+                c = cnt.get(k, 0) + oracle.get(k, {"rc": 0})["rc"]
+                if signs[i] < 0 and c <= 0:
+                    signs[i] = 1
+                cnt[k] = cnt.get(k, 0) + int(signs[i])
+        agg.push_rows(keys, signs,
+                      [(vals, valid) for _ in kinds])
+        # oracle update
+        for i in range(rows):
+            k = int(keys[i]); s = int(signs[i])
+            e = oracle.setdefault(k, {"rc": 0, "sum": 0, "cnt": 0,
+                                      "min": None, "max": None})
+            e["rc"] += s
+            if valid[i]:
+                e["sum"] += s * int(vals[i]); e["cnt"] += s
+                v = int(vals[i])
+                e["min"] = v if e["min"] is None else min(e["min"], v)
+                e["max"] = v if e["max"] is None else max(e["max"], v)
+        # group death is a barrier-time event (hash_agg.rs flush_data), not a
+        # mid-epoch one: additive state survives transient row_count == 0
+        for k in [k for k, e in oracle.items() if e["rc"] == 0]:
+            del oracle[k]
+        changes = agg.flush_epoch()
+        assert changes is not None
+        # apply change set to materialized output oracle
+        n = int(changes["count"])
+        for i in range(n):
+            k = int(changes["keys"][i])
+            if bool(changes["new_found"][i]):
+                row = []
+                for c, kind in enumerate(kinds):
+                    if bool(changes["new_null"][c][i]):
+                        row.append(None)
+                    else:
+                        row.append(changes["new_out"][c][i])
+                out_oracle[k] = row
+            elif bool(changes["old_found"][i]):
+                out_oracle.pop(k, None)
+    # final: materialized outputs must match oracle
+    assert set(out_oracle) == set(oracle)
+    for k, row in out_oracle.items():
+        e = oracle[k]
+        for kind, got in zip(kinds, row):
+            if kind == "count_star":
+                assert int(got) == e["rc"], (k, kind)
+            elif kind == "count":
+                assert int(got) == e["cnt"], (k, kind)
+            elif kind == "sum":
+                exp = e["sum"] if e["cnt"] != 0 else None
+                assert (got is None) == (exp is None)
+                if exp is not None:
+                    assert int(got) == exp, (k, kind)
+            elif kind == "avg":
+                if e["cnt"]:
+                    assert abs(float(got) - e["sum"] / e["cnt"]) < 1e-9
+            elif kind == "min":
+                assert (got is None and e["min"] is None) or int(got) == e["min"]
+            elif kind == "max":
+                assert (got is None and e["max"] is None) or int(got) == e["max"]
+
+
+def test_agg_retractable_vs_oracle():
+    random_oracle_run(1, ["count_star", "sum", "count", "avg"])
+
+
+def test_agg_append_only_minmax_vs_oracle():
+    random_oracle_run(2, ["min", "max", "sum"])
+
+
+def test_capacity_growth():
+    spec = DeviceAggSpec.build(["sum"], [np.int64])
+    agg = DeviceHashAgg(spec, capacity=8)
+    keys = np.arange(1000, dtype=np.int64)
+    agg.push_rows(keys, np.ones(1000, dtype=np.int32),
+                  [(keys * 2, np.ones(1000, dtype=bool))])
+    ch = agg.flush_epoch()
+    assert int(ch["count"]) == 1000
+    assert agg.state.capacity >= 1000 and int(agg.state.count) == 1000
