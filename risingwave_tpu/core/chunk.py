@@ -275,32 +275,49 @@ class StreamChunkBuilder:
         self.max_chunk_size = max_chunk_size
         self._ops: List[int] = []
         self._rows: List[Sequence[Any]] = []
+        self._pending: List[StreamChunk] = []
 
-    def append_row(self, op: Op, row: Sequence[Any]) -> Optional[StreamChunk]:
+    def append_row(self, op: Op, row: Sequence[Any]) -> None:
         self._ops.append(int(op))
         self._rows.append(row)
         # Keep U-/U+ pairs in one chunk: never split right after UPDATE_DELETE.
         if (len(self._rows) >= self.max_chunk_size
                 and op != Op.UPDATE_DELETE):
-            return self.take()
-        return None
+            self._flush()
 
     def append_update(self, old_row: Sequence[Any],
-                      new_row: Sequence[Any]) -> Optional[StreamChunk]:
+                      new_row: Sequence[Any]) -> None:
         self.append_row(Op.UPDATE_DELETE, old_row)
-        return self.append_row(Op.UPDATE_INSERT, new_row)
+        self.append_row(Op.UPDATE_INSERT, new_row)
 
     def __len__(self) -> int:
         return len(self._rows)
 
-    def take(self) -> Optional[StreamChunk]:
+    def _flush(self) -> None:
         if not self._rows:
-            return None
+            return
         ops = np.array(self._ops, dtype=np.int8)
         cols = [Column.from_list(dt, [r[j] for r in self._rows])
                 for j, dt in enumerate(self.dtypes)]
         self._ops, self._rows = [], []
-        return StreamChunk(ops, cols)
+        self._pending.append(StreamChunk(ops, cols))
+
+    def drain(self) -> List[StreamChunk]:
+        """All completed chunks + the current buffer; resets the builder."""
+        self._flush()
+        out, self._pending = self._pending, []
+        return out
+
+    def take(self) -> Optional[StreamChunk]:
+        """Single-chunk convenience: concatenation of everything appended.
+        Use `drain()` on paths that may exceed max_chunk_size."""
+        chunks = self.drain()
+        if not chunks:
+            return None
+        out = chunks[0]
+        for c in chunks[1:]:
+            out = out.concat(c)
+        return out
 
 
 # ---------------------------------------------------------------------------

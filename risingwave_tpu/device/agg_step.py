@@ -144,15 +144,11 @@ def _outputs(spec: DeviceAggSpec, vals: Sequence[jax.Array]
     return outs, nulls
 
 
-@partial(jax.jit, static_argnames=("spec",))
-def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
-                   keys: jax.Array, signs: jax.Array, mask: jax.Array,
-                   inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
-    """Apply one epoch of rows; return (new_state, needed, change set).
-
-    Change set arrays are sized [B] (unique touched keys); host assembles the
-    barrier change chunk from them (insert/delete/update-pair per key).
-    """
+def epoch_core(spec: DeviceAggSpec, state: SortedState,
+               keys: jax.Array, signs: jax.Array, mask: jax.Array,
+               inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """The (un-jitted) epoch pipeline, shared by the single-chip step below
+    and the shard-local body of parallel/sharded_agg.py."""
     deltas = _row_deltas(spec, signs, mask, inputs)
     ukeys, udeltas, ucount = batch_reduce(keys, mask, deltas, spec.kinds)
     old_found, old_vals = lookup(state, ukeys)
@@ -169,8 +165,26 @@ def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
     return new_state, needed, changes
 
 
+@partial(jax.jit, static_argnames=("spec",))
+def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
+                   keys: jax.Array, signs: jax.Array, mask: jax.Array,
+                   inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """Apply one epoch of rows; return (new_state, needed, change set).
+
+    Change set arrays are sized [B] (unique touched keys); host assembles the
+    barrier change chunk from them (insert/delete/update-pair per key).
+    """
+    return epoch_core(spec, state, keys, signs, mask, inputs)
+
+
 def _bucket(n: int, lo: int = 256) -> int:
     return max(lo, 1 << (max(1, n) - 1).bit_length())
+
+
+def _acc_cast(v: np.ndarray) -> np.ndarray:
+    """Host -> device accumulator dtype: floats widen to f64, ints to i64."""
+    return v.astype(np.float64 if np.issubdtype(v.dtype, np.floating)
+                    else np.int64)
 
 
 class DeviceHashAgg:
@@ -186,6 +200,10 @@ class DeviceHashAgg:
 
     def push_rows(self, keys: np.ndarray, signs: np.ndarray,
                   inputs: Sequence[Tuple[np.ndarray, np.ndarray]]) -> None:
+        if self.spec.append_only and (np.asarray(signs) < 0).any():
+            raise ValueError(
+                "retraction through an append-only (min/max) device agg — "
+                "use the exact host path (aggregate/minput.rs analog)")
         self._keys.append(keys.astype(np.int64))
         self._signs.append(signs.astype(np.int32))
         self._inputs.append([(np.asarray(v), np.asarray(m)) for v, m in inputs])
@@ -208,9 +226,7 @@ class DeviceHashAgg:
         mask = np.zeros(b, dtype=bool); mask[: len(keys)] = True
         keys = np.pad(keys, (0, pad))
         signs = np.pad(signs, (0, pad))
-        ins = tuple((jnp.asarray(np.pad(v.astype(np.float64)
-                                        if v.dtype == np.float64 else
-                                        v.astype(np.int64), (0, pad))),
+        ins = tuple((jnp.asarray(np.pad(_acc_cast(v), (0, pad))),
                      jnp.asarray(np.pad(m.astype(bool), (0, pad))))
                     for v, m in ins)
         while True:

@@ -16,6 +16,7 @@ path and the fallback for retracting min/max, decimals, and strings.
 """
 from __future__ import annotations
 
+import heapq
 import pickle
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -117,6 +118,11 @@ class HashAggExecutor(UnaryExecutor):
         self.window_col_in_group = window_col_in_group
         self.window_watermark: Optional[Any] = None
         self._emitted_windows_upto: Optional[Any] = None
+        self._wm_dtype: Optional[Any] = None
+        # min-heap of (window_value, seq, group_key): closed windows pop in
+        # order without scanning all live groups (SortBuffer analog)
+        self._window_heap: List[Tuple[Any, int, Tuple]] = []
+        self._heap_seq = 0
 
     # ---- state persistence (pickled AggGroup per group key) ----
     def _recover(self) -> None:
@@ -127,6 +133,11 @@ class HashAggExecutor(UnaryExecutor):
             key = tuple(row[: len(self.group_key_indices)])
             g: AggGroup = pickle.loads(row[-1])
             self.groups[key] = g
+            wc = self.window_col_in_group
+            if self.emit_on_window_close and key[wc] is not None:
+                heapq.heappush(self._window_heap,
+                               (key[wc], self._heap_seq, key))
+                self._heap_seq += 1
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
         self._recover()
@@ -135,11 +146,23 @@ class HashAggExecutor(UnaryExecutor):
         signs = chunk.signs()
         n = chunk.capacity
         gki = self.group_key_indices
+        wc = self.window_col_in_group
         for i in range(n):
             key = tuple(chunk.columns[j].get(i) for j in gki)
+            if self.emit_on_window_close:
+                # late-data guard: rows for already-emitted windows are
+                # dropped — emitted EOWC output is final
+                if (self._emitted_windows_upto is not None
+                        and key[wc] is not None
+                        and key[wc] <= self._emitted_windows_upto):
+                    continue
             g = self.groups.get(key)
             if g is None:
                 g = self.groups[key] = AggGroup(self.calls)
+                if self.emit_on_window_close and key[wc] is not None:
+                    heapq.heappush(self._window_heap,
+                                   (key[wc], self._heap_seq, key))
+                    self._heap_seq += 1
             g.apply(int(signs[i]), [v[i] for v in agg_vals])
             self.dirty[key] = g
         return iter(())
@@ -165,41 +188,57 @@ class HashAggExecutor(UnaryExecutor):
     def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
         self._recover()
         out = StreamChunkBuilder(self.schema.dtypes)
+        wm_out: Optional[Watermark] = None
         if self.emit_on_window_close:
-            yield from self._emit_eowc(out)
+            self._emit_eowc(out)
+            # persist still-open windows so recovery does not lose them
+            if self.state_table is not None:
+                for key, g in self.dirty.items():
+                    self.state_table.insert(key + (pickle.dumps(g),))
+            self.dirty.clear()
+            # the watermark is released only AFTER the rows it closes
+            # (`hash_agg.rs` SortBuffer contract: output respects watermarks)
+            if (self.window_watermark is not None
+                    and self.window_watermark != self._emitted_windows_upto):
+                self._emitted_windows_upto = self.window_watermark
+                wm_out = Watermark(self.window_col_in_group, self._wm_dtype,
+                                   self.window_watermark)
         else:
             for key, g in self.dirty.items():
                 self._emit_group(out, key, g)
             self.dirty.clear()
-        chunk = out.take()
-        if chunk is not None:
+        for chunk in out.drain():
             yield chunk
+        if wm_out is not None:
+            yield wm_out
         if self.state_table is not None:
             self.state_table.commit(barrier.epoch.curr)
 
-    def _emit_eowc(self, out: StreamChunkBuilder) -> Iterator[Message]:
+    def _emit_eowc(self, out: StreamChunkBuilder) -> None:
         """Emit only groups whose window column is closed by the watermark;
-        emitted groups are final (append-only output)."""
+        emitted groups are final (append-only output). Closed windows pop
+        from the heap in window order — O(closed log n), not O(live)."""
         if self.window_watermark is None:
             return
         wm = self.window_watermark
-        wc = self.window_col_in_group
-        ready = [k for k in self.dirty if k[wc] is not None and k[wc] <= wm]
-        for key in sorted(ready, key=lambda k: (k[wc],)):
-            g = self.dirty.pop(key)
+        while self._window_heap and self._window_heap[0][0] <= wm:
+            _, _, key = heapq.heappop(self._window_heap)
+            g = self.groups.pop(key, None)
+            if g is None:
+                continue  # already closed (recovery rebuilt the heap)
+            self.dirty.pop(key, None)
             if g.row_count > 0 and g.prev_output is None:
                 out.append_row(Op.INSERT, key + g.output())
                 g.prev_output = g.output()
-            # closed groups: free state
-            self.groups.pop(key, None)
-        return
-        yield  # pragma: no cover (generator form)
+            if self.state_table is not None:
+                self.state_table.delete(key + (pickle.dumps(g),))
 
     def on_watermark(self, wm: Watermark) -> Iterator[Message]:
         if (self.emit_on_window_close and self.window_col_in_group is not None
                 and self.group_key_indices[self.window_col_in_group] == wm.col_idx):
+            # buffer: released at the barrier after closed windows are emitted
             self.window_watermark = wm.value
-            yield Watermark(self.window_col_in_group, wm.dtype, wm.value)
+            self._wm_dtype = wm.dtype
         elif wm.col_idx in self.group_key_indices:
             yield Watermark(self.group_key_indices.index(wm.col_idx), wm.dtype,
                             wm.value)

@@ -151,17 +151,21 @@ class HashJoinExecutor(Executor):
         }
         self.max_chunk_size = max_chunk_size
 
-    # ---- condition eval on a joined row ----
-    def _cond_ok(self, lrow: Tuple, rrow: Tuple) -> bool:
-        if self.condition is None:
-            return True
+    # ---- condition eval, vectorized over all candidates of one input row ----
+    def _filter_matches(self, side: str, row: Tuple,
+                        cands: List[JoinEntry]) -> List[JoinEntry]:
+        if self.condition is None or not cands:
+            return cands
         from ..core.chunk import DataChunk
-        joined = lrow + rrow
+        if side == "l":
+            rows = [row + e.row for e in cands]
+        else:
+            rows = [e.row + row for e in cands]
         ch = DataChunk.from_rows(
-            self.left_exec.schema.dtypes + self.right_exec.schema.dtypes,
-            [joined])
+            self.left_exec.schema.dtypes + self.right_exec.schema.dtypes, rows)
         c = self.condition.eval(ch)
-        return bool(c.validity[0] and c.values[0])
+        return [e for e, ok, valid in zip(cands, c.values, c.validity)
+                if valid and ok]
 
     def _joined(self, side: str, this_row: Tuple, other_row: Tuple) -> Tuple:
         return (this_row + other_row) if side == "l" else (other_row + this_row)
@@ -175,15 +179,21 @@ class HashJoinExecutor(Executor):
         me = self.sides[side]
         other = self.sides["r" if side == "l" else "l"]
         key = me.key_of(row)
-        matches = [e for e in other.matches(key)
-                   if self._cond_match(side, row, e.row)]
+        # SQL NULL semantics: a NULL key equals nothing, including another
+        # NULL — such rows match nothing and are not stored (the reference
+        # null-checks key columns in hash_join.rs before probing)
+        has_null_key = any(v is None for v in key)
+        matches = [] if has_null_key else \
+            self._filter_matches(side, row, other.matches(key))
         null_other = _null_row(len(other.schema))
         null_me = _null_row(len(me.schema))
         is_insert = op.is_insert
         d = 1 if is_insert else -1
 
         # update state + degrees first
-        if is_insert:
+        if has_null_key:
+            pass
+        elif is_insert:
             me.upsert_state(me.insert(row, len(matches)))
         else:
             e = me.remove(row)
@@ -234,13 +244,6 @@ class HashJoinExecutor(Executor):
                 elif not is_insert and m.degree == 0:
                     out.append_row(Op.INSERT if is_anti else Op.DELETE, m.row)
 
-    def _cond_match(self, side: str, this_row: Tuple, other_row: Tuple) -> bool:
-        if self.condition is None:
-            return True
-        if side == "l":
-            return self._cond_ok(this_row, other_row)
-        return self._cond_ok(other_row, this_row)
-
     def _process_chunk(self, side: str, chunk: StreamChunk
                        ) -> Iterator[StreamChunk]:
         out = StreamChunkBuilder(self.schema.dtypes, self.max_chunk_size)
@@ -248,13 +251,7 @@ class HashJoinExecutor(Executor):
             # updates decay to delete+insert; RW preserves pairs when the key
             # is unchanged — semantically equivalent downstream
             self._process_row(side, op, row, out)
-            if len(out) >= self.max_chunk_size:
-                c = out.take()
-                if c is not None:
-                    yield c
-        c = out.take()
-        if c is not None:
-            yield c
+        yield from out.drain()
 
     def execute(self) -> Iterator[Message]:
         for s in self.sides.values():

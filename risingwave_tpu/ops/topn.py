@@ -125,9 +125,7 @@ class TopNExecutor(UnaryExecutor):
                     entering = ms.at(hi - 1)
                     if entering is not None and len(ms) >= hi:
                         out.append_row(Op.INSERT, entering[1])
-        c = out.take()
-        if c is not None:
-            yield c
+        yield from out.drain()
 
     def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
         if self.state_table is not None:

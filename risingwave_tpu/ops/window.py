@@ -175,8 +175,7 @@ class OverWindowExecutor(UnaryExecutor):
                 for i in range(n):
                     lo = 0 if lo_off is None else max(0, i + lo_off)
                     hi = n - 1 if hi_off is None else min(n - 1, i + hi_off)
-                    st = create_agg_state(AggCall(k if k != "count" else "count",
-                                                  call.arg))
+                    st = create_agg_state(AggCall(k, call.arg))
                     for j in range(lo, hi + 1):
                         v = vals[j]
                         if v is not None:
@@ -247,9 +246,7 @@ class OverWindowExecutor(UnaryExecutor):
             if not rows:
                 del self.partitions[p]
                 self.prev_out.pop(p, None)
-        c = out.take()
-        if c is not None:
-            yield c
+        yield from out.drain()
 
     def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
         if self.state_table is not None:

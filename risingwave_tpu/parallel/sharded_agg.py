@@ -24,9 +24,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.vnode import VNODE_COUNT, compute_vnodes_jnp
-from ..device.agg_step import DeviceAggSpec, _bucket, _outputs, _row_deltas
-from ..device.sorted_state import (EMPTY_KEY, SortedState, batch_reduce,
-                                   grow_state, lookup, merge)
+from ..device.agg_step import DeviceAggSpec, _acc_cast, _bucket, epoch_core
+from ..device.sorted_state import EMPTY_KEY, SortedState
 from .mesh import SHARD_AXIS, shard_of_vnode
 
 
@@ -93,24 +92,13 @@ def make_sharded_agg_step(spec: DeviceAggSpec, mesh: Mesh,
                          recv[3 + 2 * i].reshape(rb))
                         for i in range(ncalls))
 
-        # ---- per-shard agg epoch apply (agg_step.agg_epoch_step body) ----
-        deltas = _row_deltas(spec, rsigns, rmask, rinputs)
-        ukeys, udeltas, ucount = batch_reduce(rkeys, rmask, deltas, spec.kinds)
-        old_found, old_vals = lookup(st, ukeys)
-        new_st, needed = merge(st, ukeys, udeltas, spec.kinds)
-        new_found, new_vals = lookup(new_st, ukeys)
-        old_out, old_null = _outputs(spec, old_vals)
-        new_out, new_null = _outputs(spec, new_vals)
+        # ---- per-shard agg epoch apply (shared core with agg_step) ----
+        new_st, needed, ch = epoch_core(spec, st, rkeys, rsigns, rmask,
+                                        rinputs)
 
         ex = lambda x: x[None]    # re-add the mesh axis for out_specs
-        changes = {
-            "keys": ex(ukeys), "count": ex(ucount[None]),
-            "old_found": ex(old_found), "new_found": ex(new_found),
-            "old_out": tuple(ex(o) for o in old_out),
-            "old_null": tuple(ex(o) for o in old_null),
-            "new_out": tuple(ex(o) for o in new_out),
-            "new_null": tuple(ex(o) for o in new_null),
-        }
+        changes = jax.tree_util.tree_map(
+            ex, {**ch, "count": ch["count"][None]})
         out_state = SortedState(ex(new_st.keys), ex(new_st.count),
                                 tuple(ex(v) for v in new_st.vals))
         return out_state, ex(needed[None]), changes
@@ -172,6 +160,10 @@ class ShardedHashAgg:
 
     def push_rows(self, keys: np.ndarray, signs: np.ndarray,
                   inputs: Sequence[Tuple[np.ndarray, np.ndarray]]) -> None:
+        if self.spec.append_only and (np.asarray(signs) < 0).any():
+            raise ValueError(
+                "retraction through an append-only (min/max) device agg — "
+                "use the exact host path (aggregate/minput.rs analog)")
         self._rows.append((keys.astype(np.int64), signs.astype(np.int32),
                            [(np.asarray(v), np.asarray(m)) for v, m in inputs]))
 
@@ -211,8 +203,7 @@ class ShardedHashAgg:
         gkeys = shard2d(keys, EMPTY_KEY)
         gsigns = shard2d(signs, 0)
         mask = shard2d(np.ones(total, bool), False)
-        gins = tuple((shard2d(v.astype(np.float64) if v.dtype == np.float64
-                              else v.astype(np.int64), 0),
+        gins = tuple((shard2d(_acc_cast(v), 0),
                       shard2d(m.astype(bool), False)) for v, m in ins)
         while True:
             new_state, needed, changes = self._step(

@@ -45,11 +45,22 @@ class TestChunk:
 
     def test_builder_update_pair_not_split(self):
         b = StreamChunkBuilder([T.INT64], max_chunk_size=2)
-        assert b.append_row(Op.INSERT, (1,)) is None
+        b.append_row(Op.INSERT, (1,))
         # U- at the boundary must NOT flush until U+ arrives
-        assert b.append_row(Op.UPDATE_DELETE, (2,)) is None
-        out = b.append_row(Op.UPDATE_INSERT, (3,))
-        assert out is not None and out.capacity == 3
+        b.append_row(Op.UPDATE_DELETE, (2,))
+        b.append_row(Op.UPDATE_INSERT, (3,))
+        chunks = b.drain()
+        assert [c.capacity for c in chunks] == [3]
+
+    def test_builder_no_row_loss_on_overflow(self):
+        b = StreamChunkBuilder([T.INT64], max_chunk_size=4)
+        for i in range(10):
+            b.append_row(Op.INSERT, (i,))
+        chunks = b.drain()
+        assert sum(c.capacity for c in chunks) == 10
+        got = [r[0] for c in chunks for _, r in c.op_rows()]
+        assert got == list(range(10))
+        assert b.drain() == []
 
     def test_device_chunk_padding(self):
         ch = StreamChunk.from_rows([T.INT64, T.VARCHAR],

@@ -388,3 +388,56 @@ class TestMvParityOracle:
                         for rk, rv in r_live if lk == rk)
         # dedup: identical rows collapse in the MV (pk covers all cols)
         assert out == sorted(set(expect))
+
+
+class TestNullJoinKeys:
+    """SQL NULL semantics: NULL join keys match nothing (not even NULL)."""
+
+    def test_inner_null_keys_never_match(self):
+        out = run_join(ab((Op.INSERT, (None, "l1")), (Op.INSERT, (1, "l2"))),
+                       ab((Op.INSERT, (None, "r1")), (Op.INSERT, (1, "r2"))),
+                       JoinType.INNER, pk=(0, 1, 2, 3))
+        assert out == [(1, "l2", 1, "r2")]
+
+    def test_left_outer_null_key_is_unmatched(self):
+        out = run_join(ab((Op.INSERT, (None, "l1"))),
+                       ab((Op.INSERT, (None, "r1"))),
+                       JoinType.LEFT_OUTER, pk=(0, 1, 2, 3))
+        assert out == [(None, "l1", None, None)]
+
+    def test_anti_null_key_emits(self):
+        out = run_join(ab((Op.INSERT, (None, "l1"))),
+                       ab((Op.INSERT, (None, "r1"))),
+                       JoinType.LEFT_ANTI, pk=(0, 1))
+        assert out == [(None, "l1")]
+
+    def test_null_key_delete_roundtrip(self):
+        l = [StreamChunk.from_rows(AB_SCHEMA.dtypes,
+                                   [(Op.INSERT, (None, "l1"))]),
+             StreamChunk.from_rows(AB_SCHEMA.dtypes,
+                                   [(Op.DELETE, (None, "l1"))])]
+        out = run_join(l, ab((Op.INSERT, (1, "r1"))), JoinType.LEFT_OUTER,
+                       pk=(0, 1, 2, 3))
+        assert out == []
+
+
+class TestChunkOverflow:
+    """Emission larger than max_chunk_size must not drop rows."""
+
+    def test_join_fanout_exceeds_chunk_size(self):
+        n = 40
+        l = [StreamChunk.from_rows(AB_SCHEMA.dtypes,
+                                   [(Op.INSERT, (1, f"l{i}")) for i in range(n)])]
+        r = [StreamChunk.from_rows(CD_SCHEMA.dtypes,
+                                   [(Op.INSERT, (1, f"r{i}")) for i in range(n)])]
+        store = MemoryStateStore()
+        injector = BarrierInjector()
+        lsrc = SourceExecutor(AB_SCHEMA, ListReader(l), injector)
+        rsrc = SourceExecutor(CD_SCHEMA, ListReader(r), injector)
+        join = HashJoinExecutor(lsrc, rsrc, [0], [0], JoinType.INNER,
+                                max_chunk_size=16)  # 40*40 = 1600 outputs
+        table = StateTable(store, 1, join.schema.dtypes, [0, 1, 2, 3])
+        mat = MaterializeExecutor(join, table, ConflictBehavior.OVERWRITE)
+        job = StreamJob(mat, injector, store)
+        job.run_until_idle()
+        assert len(BatchScan(table, None).rows()) == n * n
