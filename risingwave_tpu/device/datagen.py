@@ -1,0 +1,38 @@
+"""Device-side Nexmark-style event generation.
+
+The reference benchmarks against an in-process datagen connector
+(`e2e_test/nexmark/create_sources.slt.part`, `src/connector/src/source/
+nexmark/source/reader.rs:42`): events are synthesized, not ingested. The
+TPU-native equivalent synthesizes them ON DEVICE with `jax.random`
+(threefry is a TPU-friendly counter-based PRNG), so the source feeds the
+pipeline at HBM bandwidth instead of host-link bandwidth — the design rule
+"minimise host<->device transfers" applied to the source connector itself.
+
+Distributions follow the Nexmark generator's shape: hot auctions/bidders
+(power-law skew), uniform prices. Exact NEXMark event-id arithmetic lives in
+the host connector (`risingwave_tpu/connectors/nexmark.py`); this generator
+is for device-resident benchmarking and soak tests.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("n", "n_auctions", "skew"))
+def gen_bids(key: jax.Array, n: int, n_auctions: int = 10_000,
+             skew: float = 3.0) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One epoch of bid events: (auction_id int64, price int64, next_key).
+
+    auction ~ floor(n_auctions * u^skew): power-law-ish popularity (small ids
+    hot), the shape of Nexmark's hot-auction ratio.
+    """
+    key, k1, k2 = jax.random.split(key, 3)
+    u = jax.random.uniform(k1, (n,), dtype=jnp.float32)
+    auction = (n_auctions * u ** skew).astype(jnp.int64)
+    price = jax.random.randint(k2, (n,), 1, 10_000, dtype=jnp.int32
+                               ).astype(jnp.int64)
+    return auction, price, key

@@ -1,0 +1,47 @@
+"""Fused device pipeline: datagen source -> hash agg -> materialized view.
+
+One jitted program per epoch with ZERO steady-state host traffic — the
+device analog of the reference's complete hot path (source_executor ->
+dispatch -> hash_agg -> materialize, SURVEY.md §3.2), where parity is
+checked at barrier boundaries only. Overflow ("needed") scalars accumulate
+on device and are validated once at the end, so the epoch loop never syncs.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .agg_step import DeviceAggSpec, epoch_core
+from .datagen import gen_bids
+from .materialize import make_mv_state, mv_apply_changes
+from .sorted_state import SortedState
+
+
+@partial(jax.jit, static_argnames=("spec", "n", "n_auctions"))
+def bid_agg_epoch(spec: DeviceAggSpec, n: int, n_auctions: int,
+                  agg_state: SortedState, mv_state: SortedState,
+                  rng: jax.Array, max_needed: jax.Array):
+    """(states, rng, max_needed) -> one epoch applied. All device-resident."""
+    auction, price, rng = gen_bids(rng, n, n_auctions)
+    ones_i = jnp.ones(n, dtype=jnp.int32)
+    ones_b = jnp.ones(n, dtype=bool)
+    inputs = tuple((price, ones_b) for _ in spec.calls)
+    new_agg, needed_a, ch = epoch_core(spec, agg_state, auction, ones_i,
+                                       ones_b, inputs)
+    upsert = ch["new_found"]
+    delete = ch["old_found"] & ~ch["new_found"]
+    new_mv, needed_m = mv_apply_changes(mv_state, ch["keys"], upsert, delete,
+                                        ch["new_out"], ch["new_null"])
+    max_needed = jnp.maximum(max_needed,
+                             jnp.maximum(needed_a, needed_m))
+    return new_agg, new_mv, rng, max_needed
+
+
+def make_bid_pipeline(spec: DeviceAggSpec, capacity: int):
+    agg_state = spec.make_state(capacity)
+    mv_dtypes = [c.acc_dtype for c in spec.calls]
+    mv_state = make_mv_state(capacity, mv_dtypes)
+    return agg_state, mv_state

@@ -29,13 +29,16 @@ EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
 
 class ReduceKind(enum.IntEnum):
     """How a payload column combines across rows of the same key."""
-    SUM = 0   # additive (counts, sums; retraction = sign-weighted add)
-    MIN = 1   # append-only min
-    MAX = 2   # append-only max
+    SUM = 0      # additive (counts, sums; retraction = sign-weighted add)
+    MIN = 1      # append-only min
+    MAX = 2      # append-only max
+    REPLACE = 3  # newest wins (MV upsert columns; delta overwrites state)
 
 
 def _neutral(kind: ReduceKind, dtype) -> jnp.ndarray:
-    if kind == ReduceKind.SUM:
+    if kind in (ReduceKind.SUM, ReduceKind.REPLACE):
+        return jnp.zeros((), dtype=dtype)
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.bool_):
         return jnp.zeros((), dtype=dtype)
     big = (jnp.iinfo(dtype).max if jnp.issubdtype(dtype, jnp.integer)
            else jnp.asarray(jnp.inf, dtype=dtype))
@@ -45,8 +48,12 @@ def _neutral(kind: ReduceKind, dtype) -> jnp.ndarray:
 
 
 def _combine(kind: ReduceKind, a, b):
+    """a = the state-side row, b = the delta-side row (stable sort keeps
+    state first within an equal-key pair — merge() relies on this order)."""
     if kind == ReduceKind.SUM:
         return a + b
+    if kind == ReduceKind.REPLACE:
+        return b
     return jnp.minimum(a, b) if kind == ReduceKind.MIN else jnp.maximum(a, b)
 
 
@@ -105,12 +112,21 @@ def batch_reduce(keys: jax.Array, mask: jax.Array,
         [jnp.ones((1,), bool), keys[1:] != keys[:-1]])
     seg = jnp.cumsum(boundary) - 1                      # segment id per row
     ukeys = jnp.full((b,), EMPTY_KEY, dtype=jnp.int64).at[seg].set(keys)
+    # original row position, for REPLACE (last write in arrival order wins)
+    arrival = jnp.where(mask, jnp.arange(b), -1)[order]
     out = []
     for v, k in zip(vals, kinds):
         if k == ReduceKind.SUM:
             r = jax.ops.segment_sum(v, seg, num_segments=b)
         elif k == ReduceKind.MIN:
             r = jax.ops.segment_min(v, seg, num_segments=b)
+        elif k == ReduceKind.REPLACE:
+            last = jax.ops.segment_max(arrival, seg, num_segments=b)
+            safe = jnp.where(arrival >= 0, arrival, b)  # b = OOB, dropped
+            inv = jnp.zeros(b, dtype=jnp.int32).at[safe].set(
+                jnp.arange(b, dtype=jnp.int32), mode="drop")
+            r = jnp.where(last >= 0, v[inv[jnp.clip(last, 0)]],
+                          _neutral(k, v.dtype))
         else:
             r = jax.ops.segment_max(v, seg, num_segments=b)
         # untouched segments get segment-op defaults; force neutral dtype-wise

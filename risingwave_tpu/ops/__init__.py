@@ -9,6 +9,7 @@ from .agg import (HashAggExecutor, SimpleAggExecutor,
                   StatelessSimpleAggExecutor)
 from .join import HashJoinExecutor, JoinType
 from .topn import AppendOnlyDedupExecutor, TopNExecutor
+from .watermark import WatermarkFilterExecutor
 from .window import HopWindowExecutor, OverWindowExecutor, WindowFuncCall
 
 __all__ = [
@@ -20,4 +21,5 @@ __all__ = [
     "HashAggExecutor", "SimpleAggExecutor", "StatelessSimpleAggExecutor",
     "HashJoinExecutor", "JoinType", "AppendOnlyDedupExecutor", "TopNExecutor",
     "HopWindowExecutor", "OverWindowExecutor", "WindowFuncCall",
+    "WatermarkFilterExecutor",
 ]

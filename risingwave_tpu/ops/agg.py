@@ -115,6 +115,10 @@ class HashAggExecutor(UnaryExecutor):
         # EOWC: buffer change emission until the watermark passes the window
         # column (`hash_agg.rs:420-429` SortBuffer semantics).
         self.emit_on_window_close = emit_on_window_close
+        if emit_on_window_close:
+            assert window_col_in_group is not None, \
+                "EOWC requires window_col_in_group (the window column's " \
+                "position within the group key)"
         self.window_col_in_group = window_col_in_group
         self.window_watermark: Optional[Any] = None
         self._emitted_windows_upto: Optional[Any] = None
@@ -154,7 +158,7 @@ class HashAggExecutor(UnaryExecutor):
                 # dropped — emitted EOWC output is final
                 if (self._emitted_windows_upto is not None
                         and key[wc] is not None
-                        and key[wc] <= self._emitted_windows_upto):
+                        and key[wc] < self._emitted_windows_upto):
                     continue
             g = self.groups.get(key)
             if g is None:
@@ -221,7 +225,10 @@ class HashAggExecutor(UnaryExecutor):
         if self.window_watermark is None:
             return
         wm = self.window_watermark
-        while self._window_heap and self._window_heap[0][0] <= wm:
+        # a watermark promises no future rows with value < wm, so exactly
+        # the windows strictly below it are closed (watermark_filter.rs
+        # keeps `ts >= watermark`)
+        while self._window_heap and self._window_heap[0][0] < wm:
             _, _, key = heapq.heappop(self._window_heap)
             g = self.groups.pop(key, None)
             if g is None:
@@ -229,7 +236,6 @@ class HashAggExecutor(UnaryExecutor):
             self.dirty.pop(key, None)
             if g.row_count > 0 and g.prev_output is None:
                 out.append_row(Op.INSERT, key + g.output())
-                g.prev_output = g.output()
             if self.state_table is not None:
                 self.state_table.delete(key + (pickle.dumps(g),))
 

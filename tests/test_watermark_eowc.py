@@ -1,0 +1,149 @@
+"""WatermarkFilter + emit-on-window-close HashAgg behavior."""
+from typing import Iterator, List
+
+import pytest
+
+from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
+from risingwave_tpu.expr import AggCall
+from risingwave_tpu.ops import (Barrier, BarrierKind, HashAggExecutor,
+                                Message, Watermark, WatermarkFilterExecutor)
+from risingwave_tpu.ops.executor import Executor
+from risingwave_tpu.ops.message import EpochPair
+from risingwave_tpu.state import MemoryStateStore, StateTable
+
+SCHEMA = Schema.of(("w", T.INT64), ("v", T.INT64))
+
+
+class MessageList(Executor):
+    """Yields a scripted message sequence (chunks / watermarks / barriers)."""
+
+    def __init__(self, schema: Schema, msgs: List[Message]):
+        super().__init__(schema, "MessageList")
+        self.msgs = msgs
+
+    def execute(self) -> Iterator[Message]:
+        yield from self.msgs
+
+
+def barrier(e: int, checkpoint: bool = True) -> Barrier:
+    return Barrier(EpochPair(e, e - 1),
+                   kind=BarrierKind.CHECKPOINT if checkpoint
+                   else BarrierKind.BARRIER)
+
+
+def chunk(*rows):
+    return StreamChunk.from_rows(SCHEMA.dtypes,
+                                 [(Op.INSERT, r) for r in rows])
+
+
+def run(execu) -> List[Message]:
+    return list(execu.execute())
+
+
+def eowc_agg(src, store=None):
+    st = None
+    if store is not None:
+        agg_dtypes = [T.INT64, T.BYTEA]
+        st = StateTable(store, 7, agg_dtypes, [0])
+    return HashAggExecutor(src, [0], [AggCall("count")], state_table=st,
+                           emit_on_window_close=True, window_col_in_group=0), st
+
+
+class TestWatermarkFilter:
+    def test_derives_and_emits_watermark_at_barrier(self):
+        src = MessageList(SCHEMA, [chunk((10, 1), (20, 2)), barrier(1)])
+        wf = WatermarkFilterExecutor(src, time_col=0, delay=5)
+        msgs = run(wf)
+        wms = [m for m in msgs if isinstance(m, Watermark)]
+        assert len(wms) == 1 and wms[0].value == 15 and wms[0].col_idx == 0
+
+    def test_filters_late_rows(self):
+        src = MessageList(SCHEMA, [chunk((100, 1)), barrier(1),
+                                   chunk((10, 2), (99, 3), (200, 4)),
+                                   barrier(2)])
+        wf = WatermarkFilterExecutor(src, time_col=0, delay=0)
+        msgs = run(wf)
+        rows = [r for m in msgs if isinstance(m, StreamChunk)
+                for _, r in m.compact().op_rows()]
+        # wm after epoch1 = 100; rows 10 and 99 are late and dropped
+        assert (10, 2) not in rows and (99, 3) not in rows
+        assert (100, 1) in rows and (200, 4) in rows
+
+    def test_own_chunk_max_does_not_filter_siblings(self):
+        """The watermark derived from a chunk must not retroactively drop
+        older rows of the same chunk (filter first, then advance)."""
+        src = MessageList(SCHEMA, [chunk((1, 1), (1, 2), (100, 3)),
+                                   barrier(1)])
+        wf = WatermarkFilterExecutor(src, time_col=0, delay=0)
+        msgs = run(wf)
+        rows = [r for m in msgs if isinstance(m, StreamChunk)
+                for _, r in m.compact().op_rows()]
+        assert len(rows) == 3
+
+    def test_watermark_recovery(self):
+        store = MemoryStateStore()
+        st = StateTable(store, 9, [T.INT64, T.INT64], [0])
+        src = MessageList(SCHEMA, [chunk((50, 1)), barrier(1)])
+        wf = WatermarkFilterExecutor(src, 0, 0, state_table=st)
+        run(wf)
+        st2 = StateTable(store, 9, [T.INT64, T.INT64], [0])
+        src2 = MessageList(SCHEMA, [chunk((10, 9)), barrier(2)])
+        wf2 = WatermarkFilterExecutor(src2, 0, 0, state_table=st2)
+        msgs = run(wf2)
+        rows = [r for m in msgs if isinstance(m, StreamChunk)
+                for _, r in m.compact().op_rows()]
+        assert rows == []  # 10 < recovered watermark 50 -> filtered
+
+
+class TestEowcHashAgg:
+    def test_rows_before_watermark(self):
+        """Windows close only when the watermark passes; emission precedes
+        the (buffered) watermark release."""
+        src = MessageList(SCHEMA, [
+            chunk((1, 1), (1, 2), (2, 3)), barrier(1),
+            Watermark(0, T.INT64, 2), barrier(2),
+        ])
+        agg, _ = eowc_agg(src)
+        msgs = run(agg)
+        # barrier1: nothing closed, no watermark yet
+        b1 = msgs.index(next(m for m in msgs if isinstance(m, Barrier)))
+        assert not any(isinstance(m, (StreamChunk, Watermark))
+                       for m in msgs[:b1])
+        # after barrier2: window 1 INSERT (count=2), then watermark, no w=2
+        tail = msgs[b1 + 1:]
+        chunks = [m for m in tail if isinstance(m, StreamChunk)]
+        wms = [m for m in tail if isinstance(m, Watermark)]
+        assert len(chunks) == 1
+        assert chunks[0].compact().op_rows() == [(Op.INSERT, (1, 2))]
+        assert len(wms) == 1 and wms[0].value == 2
+        assert tail.index(chunks[0]) < tail.index(wms[0])
+
+    def test_late_rows_dropped_after_close(self):
+        src = MessageList(SCHEMA, [
+            chunk((1, 1)), Watermark(0, T.INT64, 5), barrier(1),
+            chunk((1, 99)), barrier(2),   # late row for closed window 1
+        ])
+        agg, _ = eowc_agg(src)
+        msgs = run(agg)
+        chunks = [m for m in msgs if isinstance(m, StreamChunk)]
+        assert len(chunks) == 1  # the late row produced no second INSERT
+        assert chunks[0].compact().op_rows() == [(Op.INSERT, (1, 1))]
+
+    def test_open_windows_survive_recovery(self):
+        store = MemoryStateStore()
+        src = MessageList(SCHEMA, [chunk((8, 1), (8, 2)), barrier(1)])
+        agg, _ = eowc_agg(src, store)
+        run(agg)
+        # restart: same state table id; watermark now closes window 8
+        src2 = MessageList(SCHEMA, [Watermark(0, T.INT64, 9), barrier(2)])
+        agg2, _ = eowc_agg(src2, store)
+        msgs = run(agg2)
+        chunks = [m for m in msgs if isinstance(m, StreamChunk)]
+        assert len(chunks) == 1
+        assert chunks[0].compact().op_rows() == [(Op.INSERT, (8, 2))]
+
+    def test_eowc_requires_window_col(self):
+        src = MessageList(SCHEMA, [])
+        with pytest.raises(AssertionError):
+            HashAggExecutor(src, [0], [AggCall("count")],
+                            emit_on_window_close=True)
