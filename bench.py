@@ -76,7 +76,9 @@ def host_events():
 
 
 def run_host(epochs):
-    """Exact host path: AggGroup dict loop (HashAggExecutor's hot loop)."""
+    """Exact host path: AggGroup dict loop (HashAggExecutor's hot loop).
+    Throughput is timed over the first HOST_EPOCHS; the full replay then
+    continues so the end state doubles as the parity oracle."""
     from risingwave_tpu.expr.agg import AggCall, create_agg_state
     from risingwave_tpu.expr.expression import InputRef
     from risingwave_tpu.core import dtypes as T
@@ -85,8 +87,11 @@ def run_host(epochs):
     calls = [AggCall("count"), AggCall("sum", price_ref),
              AggCall("max", price_ref)]
     groups = {}
+    eps = None
     t0 = time.perf_counter()
-    for k, p in epochs:
+    for n_done, (k, p) in enumerate(epochs):
+        if n_done == HOST_EPOCHS:
+            eps = HOST_EPOCHS * ROWS / (time.perf_counter() - t0)
         for i in range(len(k)):
             g = groups.get(k[i])
             if g is None:
@@ -94,8 +99,9 @@ def run_host(epochs):
             g[0].apply(1, 1)
             g[1].apply(1, int(p[i]))
             g[2].apply(1, int(p[i]))
-    dt = time.perf_counter() - t0
-    return len(epochs) * ROWS / dt, groups
+    if eps is None:
+        eps = len(epochs) * ROWS / (time.perf_counter() - t0)
+    return eps, groups
 
 
 def verify(spec, mv, host_groups):
@@ -116,8 +122,7 @@ def main():
 
     device_eps, (spec, agg, mv) = run_device()
     events = host_events()
-    host_eps, _ = run_host(events[:HOST_EPOCHS])
-    _, host_groups = run_host(events)   # full replay: the parity oracle
+    host_eps, host_groups = run_host(events)
     verify(spec, mv, host_groups)
     result = {
         "metric": "nexmark_q4_agg_throughput",

@@ -83,6 +83,14 @@ class SharedStream:
         self._buffers.append(buf)
         return SharedStreamPort(self, buf)
 
+    def unsubscribe(self, port: "SharedStreamPort") -> None:
+        """Detach a consumer (DROP of a downstream MV/sink) — its buffer
+        must stop accumulating messages."""
+        try:
+            self._buffers.remove(port.buf)
+        except ValueError:
+            pass
+
     def _pump(self) -> bool:
         if self._iter is None:
             self._iter = self.upstream.execute()

@@ -141,7 +141,11 @@ class RowIdGenExecutor(UnaryExecutor):
     def __init__(self, input: Executor, row_id_index: int, shard: int = 0):
         super().__init__(input, input.schema)
         self.row_id_index = row_id_index
-        self._next = 0
+        # ids embed wall-clock millis in the high bits (the reference's
+        # row-id layout: timestamp | vnode | sequence) so a restarted
+        # process mints ids disjoint from any persisted pre-crash rows
+        import time
+        self._next = int(time.time() * 1000) << 12
         self.shard = shard
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
@@ -150,7 +154,17 @@ class RowIdGenExecutor(UnaryExecutor):
         ids = (np.arange(self._next, self._next + n, dtype=np.int64) << 16) | self.shard
         self._next += n
         cols = list(chunk.columns)
-        cols[self.row_id_index] = Column(T.SERIAL, ids)
+        if self.row_id_index >= len(cols):
+            # connector chunks don't carry the row-id column; append it
+            cols.append(Column(T.SERIAL, ids))
+        else:
+            old = cols[self.row_id_index]
+            if old.validity.any():
+                # rows that already carry an id (DML deletes/updates resolved
+                # against the table) keep it; only NULL ids are minted
+                ids = np.where(old.validity,
+                               old.values.astype(np.int64, copy=False), ids)
+            cols[self.row_id_index] = Column(T.SERIAL, ids)
         yield StreamChunk(chunk.ops, cols)
 
 

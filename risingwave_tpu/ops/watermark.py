@@ -29,6 +29,7 @@ class WatermarkFilterExecutor(UnaryExecutor):
         self.state_table = state_table
         self._recovered = state_table is None
         self._wm_dirty = False
+        self._persisted: Optional[Any] = None
 
     def _recover(self) -> None:
         if self._recovered:
@@ -37,6 +38,12 @@ class WatermarkFilterExecutor(UnaryExecutor):
         for row in self.state_table.iter_all():
             self.watermark = row[1] if self.watermark is None \
                 else max(self.watermark, row[1])
+        if self.watermark is not None:
+            # re-announce the recovered watermark downstream (the reference
+            # emits the persisted watermark on startup) so e.g. a recovered
+            # EOWC agg can close its pre-crash windows even on a quiet stream
+            self._wm_dirty = True
+            self._persisted = self.watermark
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
         self._recover()
@@ -66,6 +73,10 @@ class WatermarkFilterExecutor(UnaryExecutor):
             yield Watermark(self.time_col,
                             self.schema.fields[self.time_col].dtype,
                             self.watermark)
-        if self.state_table is not None:
+        if self.state_table is not None and \
+                self.watermark != self._persisted:
+            # persist only on change — an idle stream must not produce a
+            # spill-run per epoch for an unchanged watermark
+            self._persisted = self.watermark
             self.state_table.insert((0, self.watermark))
             self.state_table.commit(barrier.epoch.curr)

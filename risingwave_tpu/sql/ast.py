@@ -1,0 +1,250 @@
+"""SQL AST nodes (the `src/sqlparser/src/ast/` analog, minimal)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+# ---------------------------------------------------------------------------
+# Expressions
+# ---------------------------------------------------------------------------
+
+
+class ExprNode:
+    pass
+
+
+@dataclass
+class Lit(ExprNode):
+    value: Any                 # python value; None = NULL
+    type_hint: Optional[str] = None   # 'interval', etc.
+
+
+@dataclass
+class Col(ExprNode):
+    name: str
+    table: Optional[str] = None
+
+
+@dataclass
+class Star(ExprNode):
+    table: Optional[str] = None
+
+
+@dataclass
+class BinOp(ExprNode):
+    op: str                    # '+', '-', '=', 'and', ...
+    left: ExprNode
+    right: ExprNode
+
+
+@dataclass
+class UnaryOp(ExprNode):
+    op: str                    # 'not', '-'
+    operand: ExprNode
+
+
+@dataclass
+class FuncCall(ExprNode):
+    name: str
+    args: List[ExprNode]
+    distinct: bool = False
+    over: Optional["WindowSpec"] = None
+
+
+@dataclass
+class WindowSpec:
+    partition_by: List[ExprNode]
+    order_by: List[Tuple[ExprNode, bool]]   # (expr, desc)
+
+
+@dataclass
+class CaseExpr(ExprNode):
+    operand: Optional[ExprNode]
+    branches: List[Tuple[ExprNode, ExprNode]]
+    else_expr: Optional[ExprNode]
+
+
+@dataclass
+class CastExpr(ExprNode):
+    operand: ExprNode
+    type_name: str
+
+
+@dataclass
+class ExtractExpr(ExprNode):
+    field: str
+    operand: ExprNode
+
+
+@dataclass
+class IsNullExpr(ExprNode):
+    operand: ExprNode
+    negated: bool
+
+
+@dataclass
+class InList(ExprNode):
+    operand: ExprNode
+    items: List[ExprNode]
+    negated: bool
+
+
+@dataclass
+class Between(ExprNode):
+    operand: ExprNode
+    low: ExprNode
+    high: ExprNode
+    negated: bool
+
+
+@dataclass
+class SubqueryExpr(ExprNode):
+    query: "Select"
+
+
+# ---------------------------------------------------------------------------
+# FROM clause
+# ---------------------------------------------------------------------------
+
+
+class TableRef:
+    alias: Optional[str]
+
+
+@dataclass
+class NamedTable(TableRef):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class WindowTable(TableRef):
+    """TUMBLE(t, time_col, size) / HOP(t, time_col, hop, size)."""
+    kind: str                  # 'tumble' | 'hop'
+    inner: TableRef
+    time_col: str
+    args: List[ExprNode]       # intervals
+    alias: Optional[str] = None
+
+
+@dataclass
+class SubqueryTable(TableRef):
+    query: "Select"
+    alias: Optional[str] = None
+
+
+@dataclass
+class Join(TableRef):
+    left: TableRef
+    right: TableRef
+    kind: str                  # 'inner' | 'left' | 'right' | 'full' | 'cross'
+    on: Optional[ExprNode]
+    alias: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# Statements
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SelectItem:
+    expr: ExprNode
+    alias: Optional[str] = None
+
+
+@dataclass
+class Select:
+    items: List[SelectItem]
+    from_: Optional[TableRef]
+    where: Optional[ExprNode] = None
+    group_by: List[ExprNode] = field(default_factory=list)
+    having: Optional[ExprNode] = None
+    order_by: List[Tuple[ExprNode, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    primary_key: bool = False
+    watermark_delay: Optional[ExprNode] = None   # WATERMARK FOR c AS c - d
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: List[ColumnDef]
+    primary_key: List[str]
+    with_options: dict
+    append_only: bool = False
+    is_source: bool = False
+    watermark: Optional[Tuple[str, ExprNode]] = None
+
+
+@dataclass
+class CreateMaterializedView:
+    name: str
+    query: Select
+
+
+@dataclass
+class CreateSink:
+    name: str
+    from_name: Optional[str]
+    query: Optional[Select]
+    with_options: dict
+
+
+@dataclass
+class CreateIndex:
+    name: str
+    table: str
+    columns: List[str]
+
+
+@dataclass
+class DropObject:
+    kind: str                  # 'table' | 'source' | 'materialized view' ...
+    name: str
+    if_exists: bool = False
+    cascade: bool = False
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: List[str]
+    rows: List[List[ExprNode]]
+    query: Optional[Select] = None
+
+
+@dataclass
+class Delete:
+    table: str
+    where: Optional[ExprNode]
+
+
+@dataclass
+class Update:
+    table: str
+    assignments: List[Tuple[str, ExprNode]]
+    where: Optional[ExprNode]
+
+
+@dataclass
+class Flush:
+    pass
+
+
+@dataclass
+class ShowObjects:
+    kind: str
+
+
+@dataclass
+class Explain:
+    stmt: Any

@@ -1,0 +1,424 @@
+"""Database: the single-process control plane + session surface.
+
+Plays the combined role of the reference's frontend session
+(`src/frontend/src/session.rs`), meta DDL controller
+(`src/meta/src/rpc/ddl_controller.rs:295`) and barrier worker
+(`src/meta/src/barrier/worker.rs:380`): executes statements, owns the
+catalog, spawns streaming jobs, ticks barriers through ALL jobs, and
+commits epochs to the state store.
+
+Dataflow topology: every table/source/MV materializes into a state table
+and exposes its change stream through a `SharedStream`; downstream MVs tap
+a port and prepend a backfill snapshot (the `backfill/` executor analog —
+consistent because DDL happens between barriers, so a new port sees exactly
+the changes after the snapshot).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..connectors import ListReader
+from ..connectors.nexmark import NexmarkReader
+from ..connectors.datagen import DatagenReader
+from ..core import dtypes as T
+from ..core.chunk import Column, Op, StreamChunk
+from ..core.dtypes import DataType
+from ..core.schema import Field, Schema
+from ..ops import (Barrier, BarrierInjector, BatchScan, ConflictBehavior,
+                   MaterializeExecutor, RowIdGenExecutor, SourceExecutor,
+                   WatermarkFilterExecutor)
+from ..ops.executor import Executor, SharedStream
+from ..ops.message import Message, Watermark
+from ..state import MemoryStateStore, SpillStateStore, StateStore, StateTable
+from . import ast as A
+from .catalog import Catalog, CatalogObject
+from .parser import parse_sql
+from .planner import Binder, Namespace, Planner, type_from_name
+
+ROWID = "_row_id"
+
+
+class _Backfill(Executor):
+    """Yield a snapshot chunk, then the live change stream
+    (`arrangement_backfill.rs` analog, trivially: snapshot is consistent
+    because DDL runs between barriers)."""
+
+    def __init__(self, snapshot: Optional[StreamChunk], port: Executor):
+        super().__init__(port.schema, "Backfill")
+        self.snapshot = snapshot
+        self.port = port
+
+    def execute(self) -> Iterator[Message]:
+        if self.snapshot is not None and self.snapshot.capacity:
+            yield self.snapshot
+        yield from self.port.execute()
+
+
+class Database:
+    def __init__(self, store: Optional[StateStore] = None,
+                 data_dir: Optional[str] = None,
+                 checkpoint_frequency: int = 1):
+        if store is None:
+            store = (SpillStateStore(data_dir) if data_dir
+                     else MemoryStateStore())
+        self.store = store
+        self.catalog = Catalog()
+        self.injector = BarrierInjector(checkpoint_frequency)
+        self.sinks: List[Tuple[str, Iterator[Message]]] = []   # job pumps
+        self._iters: Dict[str, Iterator[Message]] = {}
+        self.sink_results: Dict[str, List[Tuple]] = {}
+        self.epoch_committed = 0
+        self._nexmark_gen = None
+        # upstream (SharedStream, port) pairs captured while planning the
+        # statement currently being executed; moved onto the created object
+        self._pending_subs: List[Tuple[SharedStream, Any]] = []
+
+    # ------------------------------------------------------------------
+    # statement surface
+    # ------------------------------------------------------------------
+    def run(self, sql: str) -> List[Any]:
+        out = []
+        for stmt in parse_sql(sql):
+            out.append(self._execute(stmt))
+        return out
+
+    def query(self, sql: str) -> List[Tuple]:
+        """Run a single SELECT and return rows."""
+        stmts = parse_sql(sql)
+        assert len(stmts) == 1 and isinstance(stmts[0], A.Select)
+        return self._run_batch_select(stmts[0])
+
+    def _execute(self, stmt: Any) -> Any:
+        if isinstance(stmt, A.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, A.CreateMaterializedView):
+            return self._create_mv(stmt)
+        if isinstance(stmt, A.CreateSink):
+            return self._create_sink(stmt)
+        if isinstance(stmt, A.DropObject):
+            return self._drop(stmt)
+        if isinstance(stmt, A.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, A.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, A.Update):
+            return self._update(stmt)
+        if isinstance(stmt, A.Flush):
+            return self.flush()
+        if isinstance(stmt, A.Select):
+            return self._run_batch_select(stmt)
+        if isinstance(stmt, A.ShowObjects):
+            kind = {"tables": "table", "sources": "source",
+                    "materialized views": "mv", "sinks": "sink"}[stmt.kind]
+            return self.catalog.list(kind)
+        if isinstance(stmt, A.Explain):
+            return repr(stmt.stmt)
+        raise ValueError(f"unsupported statement {stmt!r}")
+
+    # ------------------------------------------------------------------
+    # DDL
+    # ------------------------------------------------------------------
+    def _create_table(self, stmt: A.CreateTable) -> str:
+        fields = [Field(c.name, type_from_name(c.type_name))
+                  for c in stmt.columns]
+        has_pk = bool(stmt.primary_key)
+        if not has_pk:
+            fields.append(Field(ROWID, T.INT64))
+        schema = Schema(fields)
+        pk = [schema.index_of(n) for n in stmt.primary_key] if has_pk \
+            else [len(fields) - 1]
+        tid = self.catalog.alloc_table_id()
+        obj = CatalogObject(stmt.name, "source" if stmt.is_source else "table",
+                            schema, pk, tid, stmt.append_only,
+                            stmt.with_options)
+        connector = stmt.with_options.get("connector", "dml")
+        reader = self._make_reader(connector, stmt, schema)
+        split_st = StateTable(self.store, self.catalog.alloc_table_id(),
+                              [T.VARCHAR, T.VARCHAR], [0])
+        src: Executor = SourceExecutor(schema, reader, self.injector,
+                                       split_state_table=split_st,
+                                       name=f"Source({stmt.name})")
+        if not has_pk:
+            src = RowIdGenExecutor(src, row_id_index=len(fields) - 1,
+                                   shard=tid & 0xFFFF)
+        if stmt.watermark is not None:
+            col, delay_expr = stmt.watermark
+            ns = Namespace.of_schema(schema, stmt.name)
+            ti = ns.resolve(col)
+            bound = Binder(ns).bind(delay_expr)
+            delay = _extract_delay(bound, ti)
+            wm_st = StateTable(self.store, self.catalog.alloc_table_id(),
+                               [T.INT64, schema.fields[ti].dtype], [0])
+            src = WatermarkFilterExecutor(src, ti, delay, wm_st)
+            obj.watermark_col = ti
+        mv_table = StateTable(self.store, tid, schema.dtypes, pk)
+        mat = MaterializeExecutor(src, mv_table,
+                                  ConflictBehavior.OVERWRITE)
+        shared = SharedStream(mat)
+        obj.runtime = {"reader": reader if connector == "dml" else None,
+                       "state_table": mv_table, "shared": shared,
+                       "port": shared.subscribe()}
+        self.catalog.create(obj)
+        self._iters[stmt.name] = obj.runtime["port"].execute()
+        return f"CREATE_{'SOURCE' if stmt.is_source else 'TABLE'}"
+
+    def _make_reader(self, connector: str, stmt: A.CreateTable,
+                     schema: Schema):
+        if connector == "dml":
+            return ListReader([])
+        if connector == "nexmark":
+            from ..connectors.nexmark import NexmarkGenerator
+            table = stmt.with_options.get("nexmark.table", "bid").lower()
+            maxe = stmt.with_options.get("nexmark.max.events")
+            if self._nexmark_gen is None:
+                self._nexmark_gen = NexmarkGenerator()
+            return NexmarkReader(table, self._nexmark_gen,
+                                 max_events=int(maxe) if maxe else None)
+        if connector == "datagen":
+            per = int(float(stmt.with_options.get("rows.per.poll", "1024")))
+            maxr = stmt.with_options.get("datagen.max.rows")
+            return DatagenReader(schema, rows_per_chunk=per,
+                                 max_rows=int(maxr) if maxr else None)
+        raise ValueError(f"unknown connector {connector!r}")
+
+    def _subscribe(self, name: str) -> Tuple[Executor, Schema]:
+        obj = self.catalog.get(name)
+        rt = obj.runtime
+        snapshot_rows = list(rt["state_table"].iter_all())
+        snap = None
+        if snapshot_rows:
+            snap = StreamChunk.from_rows(
+                obj.schema.dtypes,
+                [(Op.INSERT, r) for r in snapshot_rows])
+        port = rt["shared"].subscribe()
+        self._pending_subs.append((rt["shared"], port))
+        return _Backfill(snap, port), obj.schema
+
+    def _create_mv(self, stmt: A.CreateMaterializedView) -> str:
+        planner = Planner(self._subscribe)
+        self._pending_subs = []
+        execu, ns = planner.plan_select(stmt.query)
+        schema = ns.schema()
+        # MV pk: group keys if aggregated else append full row + row id.
+        # The planner's output schema is final; pk = all columns is always
+        # correct for OVERWRITE upsert (the reference derives a stream key;
+        # full-row keying is the degenerate-but-sound version).
+        pk = list(range(len(schema)))
+        tid = self.catalog.alloc_table_id()
+        mv_table = StateTable(self.store, tid, schema.dtypes, pk)
+        mat = MaterializeExecutor(execu, mv_table, ConflictBehavior.OVERWRITE)
+        shared = SharedStream(mat)
+        obj = CatalogObject(stmt.name, "mv", schema, pk, tid)
+        obj.runtime = {"state_table": mv_table, "shared": shared,
+                       "port": shared.subscribe(), "reader": None,
+                       "upstream_subs": self._pending_subs}
+        self._pending_subs = []
+        self.catalog.create(obj)
+        self._iters[stmt.name] = obj.runtime["port"].execute()
+        return "CREATE_MATERIALIZED_VIEW"
+
+    def _create_sink(self, stmt: A.CreateSink) -> str:
+        self._pending_subs = []
+        if stmt.from_name is not None:
+            execu, schema = self._subscribe(stmt.from_name)
+        else:
+            execu, ns = Planner(self._subscribe).plan_select(stmt.query)
+            schema = ns.schema()
+        rows: List[Tuple] = []
+        self.sink_results[stmt.name] = rows
+        obj = CatalogObject(stmt.name, "sink", schema, [], 0,
+                            with_options=stmt.with_options)
+        obj.runtime = {"collect": rows, "state_table": None, "shared": None,
+                       "reader": None, "upstream_subs": self._pending_subs}
+        self._pending_subs = []
+        self.catalog.create(obj)
+        self._iters[stmt.name] = self._sink_pump(execu, rows)
+        return "CREATE_SINK"
+
+    @staticmethod
+    def _sink_pump(execu: Executor, rows: List[Tuple]) -> Iterator[Message]:
+        for msg in execu.execute():
+            if isinstance(msg, StreamChunk):
+                for op, r in msg.compact().op_rows():
+                    rows.append((op, r))
+            yield msg
+
+    def _drop(self, stmt: A.DropObject) -> str:
+        try:
+            obj = self.catalog.drop(stmt.name)
+        except KeyError:
+            if stmt.if_exists:
+                return "DROP_SKIPPED"
+            raise
+        self._iters.pop(stmt.name, None)
+        # release upstream taps, or their buffers grow forever
+        for shared, port in (obj.runtime or {}).get("upstream_subs", []):
+            shared.unsubscribe(port)
+        return "DROP"
+
+    # ------------------------------------------------------------------
+    # DML
+    # ------------------------------------------------------------------
+    def _insert(self, stmt: A.Insert) -> str:
+        obj = self.catalog.get(stmt.table)
+        reader: ListReader = obj.runtime["reader"]
+        assert reader is not None, f"{stmt.table} is not DML-writable"
+        schema = obj.schema
+        data_cols = [f.name for f in schema.fields if f.name != ROWID]
+        target = stmt.columns or data_cols
+        rows = []
+        for r in stmt.rows:
+            vals = {c: _eval_const(e, _dtype(schema, c))
+                    for c, e in zip(target, r)}
+            # full schema row; _row_id stays NULL for RowIdGen to mint
+            rows.append(tuple(vals.get(f.name) for f in schema.fields))
+        reader.push(StreamChunk.from_rows(
+            schema.dtypes, [(Op.INSERT, r) for r in rows]))
+        self.flush()
+        return f"INSERT_{len(rows)}"
+
+    def _delete(self, stmt: A.Delete) -> str:
+        obj = self.catalog.get(stmt.table)
+        reader: ListReader = obj.runtime["reader"]
+        assert reader is not None
+        # bind predicate against the table, evaluate over the current MV
+        rows = list(obj.runtime["state_table"].iter_all())
+        if not rows:
+            return "DELETE_0"
+        chunk = StreamChunk.from_rows(obj.schema.dtypes,
+                                      [(Op.DELETE, r) for r in rows])
+        if stmt.where is not None:
+            ns = Namespace.of_schema(obj.schema, stmt.table)
+            pred = Binder(ns).bind(stmt.where)
+            col = pred.eval(chunk)
+            keep = np.asarray(col.values, dtype=object)
+            mask = np.array([bool(v) and bool(ok)
+                             for v, ok in zip(keep, col.validity)])
+            chunk = chunk.with_visibility(chunk.vis_mask() & mask)
+        chunk = chunk.compact()
+        if chunk.capacity == 0:
+            return "DELETE_0"
+        # deletes flow through the source so downstream MVs retract; rows
+        # already carry their _row_id (RowIdGen preserves non-NULL ids)
+        reader.push(chunk)
+        n = chunk.capacity
+        self.flush()
+        return f"DELETE_{n}"
+
+    def _update(self, stmt: A.Update) -> str:
+        raise NotImplementedError("UPDATE lands with the DML channel rework")
+
+    # ------------------------------------------------------------------
+    # barrier loop (GlobalBarrierWorker tick)
+    # ------------------------------------------------------------------
+    def tick(self) -> None:
+        """Inject one barrier and drive every job until it passes."""
+        b = self.injector.inject()
+        for name, it in list(self._iters.items()):
+            for msg in it:
+                if isinstance(msg, Barrier) and msg.epoch.curr == b.epoch.curr:
+                    break
+        if b.is_checkpoint:
+            self.store.commit_epoch(b.epoch.curr)
+            self.epoch_committed = b.epoch.curr
+
+    def flush(self, ticks: int = 2) -> str:
+        for _ in range(ticks):
+            self.tick()
+        return "FLUSH"
+
+    # ------------------------------------------------------------------
+    # batch SELECT
+    # ------------------------------------------------------------------
+    def _run_batch_select(self, q: A.Select) -> List[Tuple]:
+        # SELECT without FROM: evaluate constant expressions
+        if q.from_ is None:
+            return [tuple(_eval_const(i.expr, None) for i in q.items)]
+        self.flush(1)
+        inj = BarrierInjector()
+
+        def subscribe(name: str) -> Tuple[Executor, Schema]:
+            obj = self.catalog.get(name)
+            rows = list(obj.runtime["state_table"].iter_all())
+            chunks = []
+            if rows:
+                chunks.append(StreamChunk.from_rows(
+                    obj.schema.dtypes, [(Op.INSERT, r) for r in rows]))
+            src = SourceExecutor(obj.schema, ListReader(chunks), inj,
+                                 name=f"Scan({name})")
+            return src, obj.schema
+
+        # plan without limit/order; ORDER BY columns ride along as hidden
+        # trailing items (PG allows ordering by non-output expressions)
+        items = list(q.items) + [A.SelectItem(e, f"__ord{i}")
+                                 for i, (e, _) in enumerate(q.order_by)]
+        plan_q = A.Select(items, q.from_, q.where, q.group_by, q.having,
+                         [], None, None, q.distinct)
+        execu, ns = Planner(subscribe).plan_select(plan_q)
+        n_vis = len(ns.cols) - len(q.order_by)  # stars are expanded by now
+        state: Dict[Tuple, int] = {}
+        it = execu.execute()
+        inj.inject()
+        inj.inject_stop()
+        for msg in it:
+            if isinstance(msg, StreamChunk):
+                for op, r in msg.compact().op_rows():
+                    if op.is_insert:
+                        state[r] = state.get(r, 0) + 1
+                    else:
+                        state[r] = state.get(r, 0) - 1
+        out = [r for r, n in state.items() for _ in range(n)]
+        for i in range(len(q.order_by) - 1, -1, -1):
+            desc = q.order_by[i][1]
+            out.sort(key=lambda r: _sort_key(r[n_vis + i]), reverse=desc)
+        if q.offset:
+            out = out[q.offset:]
+        if q.limit is not None:
+            out = out[: q.limit]
+        return [r[:n_vis] for r in out]
+
+
+def _sort_key(v):
+    return (v is None, v)
+
+
+def _dtype(schema: Schema, col: str) -> DataType:
+    return schema.fields[schema.index_of(col)].dtype
+
+
+def _coerce(v, dtype: DataType):
+    if v is None:
+        return None
+    return dtype.coerce(v) if hasattr(dtype, "coerce") else v
+
+
+def _eval_const(e: A.ExprNode, dtype: Optional[DataType]):
+    from .planner import Binder, Namespace
+    b = Binder(Namespace([]))
+    expr = b.bind(e)
+    chunk = StreamChunk.from_rows([T.INT64], [(Op.INSERT, (0,))])
+    col = expr.eval(chunk)
+    v = col.get(0)
+    if dtype is not None and v is not None:
+        from ..expr import cast as _cast
+        from ..expr import Literal
+        lit = Literal(v, expr.return_type)
+        casted = _cast(lit, dtype)
+        v = casted.eval(chunk).get(0)
+    return v
+
+
+def _extract_delay(bound, time_idx: int) -> int:
+    """WATERMARK FOR c AS c - INTERVAL '...' -> delay usecs."""
+    from ..expr.expression import FunctionCall, InputRef, Literal
+    if isinstance(bound, FunctionCall) and bound.name == "subtract":
+        a, b = bound.args
+        if isinstance(b, Literal):
+            iv = b.value
+            return iv.total_usecs_approx() if hasattr(
+                iv, "total_usecs_approx") else int(iv)
+    if isinstance(bound, InputRef):
+        return 0
+    raise ValueError("WATERMARK expression must be `col - INTERVAL '...'`")

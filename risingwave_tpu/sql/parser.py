@@ -1,0 +1,705 @@
+"""SQL lexer + recursive-descent parser.
+
+The `src/sqlparser/` analog (the reference forks sqlparser-rs; this is a
+fresh Pratt-style parser over the dialect subset the framework executes).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Tuple
+
+from . import ast as A
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
+  | (?P<str>'(?:[^']|'')*')
+  | (?P<qid>"(?:[^"]|"")*")
+  | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|<=|>=|\|\||::|[-+*/%(),.;=<>\[\]])
+""", re.VERBOSE | re.DOTALL)
+
+
+class Token:
+    __slots__ = ("kind", "value")
+
+    def __init__(self, kind: str, value: str):
+        self.kind = kind       # 'num' | 'str' | 'id' | 'kw' | 'op' | 'eof'
+        self.value = value
+
+    def __repr__(self):
+        return f"{self.kind}:{self.value}"
+
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "is", "null", "true", "false",
+    "join", "inner", "left", "right", "full", "outer", "cross", "on",
+    "create", "table", "source", "materialized", "view", "sink", "index",
+    "drop", "insert", "into", "values", "delete", "update", "set", "flush",
+    "show", "tables", "sources", "sinks", "views", "primary", "key", "with",
+    "case", "when", "then", "else", "end", "cast", "extract", "interval",
+    "between", "in", "like", "distinct", "asc", "desc", "exists", "if",
+    "over", "partition", "watermark", "for", "append", "only", "explain",
+    "tumble", "hop", "emit", "window", "close", "cascade", "rows", "range",
+    "unbounded", "preceding", "following", "current", "row", "union", "all",
+}
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            raise ValueError(f"cannot tokenize at: {sql[pos:pos+30]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind == "ws":
+            continue
+        if kind == "id":
+            low = text.lower()
+            out.append(Token("kw" if low in _KEYWORDS else "id", low))
+        elif kind == "qid":
+            out.append(Token("id", text[1:-1].replace('""', '"')))
+        elif kind == "str":
+            out.append(Token("str", text[1:-1].replace("''", "'")))
+        else:
+            out.append(Token(kind, text))
+    out.append(Token("eof", ""))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+_CMP_OPS = {"=", "<>", "!=", "<", "<=", ">", ">="}
+_TYPE_NAMES = {
+    "int", "integer", "int4", "bigint", "int8", "smallint", "int2",
+    "real", "float4", "double", "float8", "float", "numeric", "decimal",
+    "boolean", "bool", "varchar", "text", "string", "character",
+    "date", "time", "timestamp", "timestamptz", "interval", "bytea",
+    "serial",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # ---- token helpers --------------------------------------------------
+    def peek(self, ahead: int = 0) -> Token:
+        return self.toks[min(self.i + ahead, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value: Optional[str] = None) -> Optional[Token]:
+        t = self.peek()
+        if t.kind == kind and (value is None or t.value == value):
+            return self.next()
+        return None
+
+    def expect(self, kind: str, value: Optional[str] = None) -> Token:
+        t = self.accept(kind, value)
+        if t is None:
+            raise ValueError(
+                f"expected {value or kind}, got {self.peek()!r} at {self.i}")
+        return t
+
+    def accept_kw(self, *kws: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "kw" and t.value in kws:
+            self.next()
+            return t.value
+        return None
+
+    def expect_kw(self, kw: str) -> None:
+        if not self.accept_kw(kw):
+            raise ValueError(f"expected {kw.upper()}, got {self.peek()!r}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind in ("id", "kw"):
+            self.next()
+            return t.value
+        raise ValueError(f"expected identifier, got {t!r}")
+
+    # ---- entry ----------------------------------------------------------
+    def parse_statements(self) -> List[Any]:
+        stmts = []
+        while self.peek().kind != "eof":
+            stmts.append(self.parse_statement())
+            while self.accept("op", ";"):
+                pass
+        return stmts
+
+    def parse_statement(self) -> Any:
+        t = self.peek()
+        if t.kind == "kw":
+            if t.value == "select":
+                return self.parse_select()
+            if t.value == "create":
+                return self.parse_create()
+            if t.value == "drop":
+                return self.parse_drop()
+            if t.value == "insert":
+                return self.parse_insert()
+            if t.value == "delete":
+                return self.parse_delete()
+            if t.value == "update":
+                return self.parse_update()
+            if t.value == "flush":
+                self.next()
+                return A.Flush()
+            if t.value == "show":
+                self.next()
+                kind = self.ident()
+                if kind == "materialized":
+                    self.expect_kw("views")
+                    kind = "materialized views"
+                return A.ShowObjects(kind)
+            if t.value == "explain":
+                self.next()
+                return A.Explain(self.parse_statement())
+            if t.value == "with":
+                raise ValueError("WITH (CTE) not supported yet")
+        raise ValueError(f"cannot parse statement at {t!r}")
+
+    # ---- DDL ------------------------------------------------------------
+    def parse_create(self) -> Any:
+        self.expect_kw("create")
+        if self.accept_kw("table"):
+            return self._create_table(is_source=False)
+        if self.accept_kw("source"):
+            return self._create_table(is_source=True)
+        if self.accept_kw("materialized"):
+            self.expect_kw("view")
+            name = self.ident()
+            self.expect_kw("as")
+            q = self.parse_select()
+            self._accept_emit_clause(q)
+            return A.CreateMaterializedView(name, q)
+        if self.accept_kw("sink"):
+            name = self.ident()
+            from_name, query = None, None
+            if self.accept_kw("from"):
+                from_name = self.ident()
+            else:
+                self.expect_kw("as")
+                query = self.parse_select()
+            opts = self._with_options()
+            return A.CreateSink(name, from_name, query, opts)
+        if self.accept_kw("index"):
+            name = self.ident()
+            self.expect_kw("on")
+            table = self.ident()
+            self.expect("op", "(")
+            cols = [self.ident()]
+            while self.accept("op", ","):
+                cols.append(self.ident())
+            self.expect("op", ")")
+            return A.CreateIndex(name, table, cols)
+        raise ValueError(f"CREATE what? {self.peek()!r}")
+
+    def _accept_emit_clause(self, q: A.Select) -> None:
+        if self.accept_kw("emit"):
+            self.expect_kw("on")
+            self.expect_kw("window")
+            self.expect_kw("close")
+            q.emit_on_window_close = True  # type: ignore[attr-defined]
+
+    def _create_table(self, is_source: bool) -> A.CreateTable:
+        name = self.ident()
+        columns: List[A.ColumnDef] = []
+        pk: List[str] = []
+        watermark = None
+        if self.accept("op", "("):
+            while True:
+                if self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    self.expect("op", "(")
+                    pk.append(self.ident())
+                    while self.accept("op", ","):
+                        pk.append(self.ident())
+                    self.expect("op", ")")
+                elif self.accept_kw("watermark"):
+                    self.expect_kw("for")
+                    col = self.ident()
+                    self.expect_kw("as")
+                    watermark = (col, self.parse_expr())
+                else:
+                    cname = self.ident()
+                    tname = self._type_name()
+                    cd = A.ColumnDef(cname, tname)
+                    if self.accept_kw("primary"):
+                        self.expect_kw("key")
+                        cd.primary_key = True
+                        pk.append(cname)
+                    columns.append(cd)
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        append_only = False
+        if self.accept_kw("append"):
+            self.expect_kw("only")
+            append_only = True
+        opts = self._with_options()
+        return A.CreateTable(name, columns, pk, opts, append_only, is_source,
+                             watermark)
+
+    def _type_name(self) -> str:
+        t = self.ident()
+        if t == "double":
+            self.accept_kw("precision") if False else self.accept("id", "precision")
+            return "double"
+        if t == "character":
+            if self.accept("id", "varying"):
+                t = "varchar"
+        if t in ("numeric", "decimal", "varchar") and self.accept("op", "("):
+            self.next()
+            if self.accept("op", ","):
+                self.next()
+            self.expect("op", ")")
+        return t
+
+    def _with_options(self) -> dict:
+        opts: dict = {}
+        if self.accept_kw("with"):
+            self.expect("op", "(")
+            while True:
+                k = self.ident()
+                while self.accept("op", "."):
+                    k += "." + self.ident()
+                self.expect("op", "=")
+                t = self.next()
+                opts[k] = t.value
+                if not self.accept("op", ","):
+                    break
+            self.expect("op", ")")
+        return opts
+
+    def parse_drop(self) -> A.DropObject:
+        self.expect_kw("drop")
+        kind = self.ident()
+        if kind == "materialized":
+            self.expect_kw("view")
+            kind = "materialized view"
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        name = self.ident()
+        cascade = bool(self.accept_kw("cascade"))
+        return A.DropObject(kind, name, if_exists, cascade)
+
+    # ---- DML ------------------------------------------------------------
+    def parse_insert(self) -> A.Insert:
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.ident()
+        cols: List[str] = []
+        if self.accept("op", "("):
+            cols.append(self.ident())
+            while self.accept("op", ","):
+                cols.append(self.ident())
+            self.expect("op", ")")
+        if self.accept_kw("values"):
+            rows = []
+            while True:
+                self.expect("op", "(")
+                row = [self.parse_expr()]
+                while self.accept("op", ","):
+                    row.append(self.parse_expr())
+                self.expect("op", ")")
+                rows.append(row)
+                if not self.accept("op", ","):
+                    break
+            return A.Insert(table, cols, rows)
+        q = self.parse_select()
+        return A.Insert(table, cols, [], q)
+
+    def parse_delete(self) -> A.Delete:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.ident()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return A.Delete(table, where)
+
+    def parse_update(self) -> A.Update:
+        self.expect_kw("update")
+        table = self.ident()
+        self.expect_kw("set")
+        assigns = []
+        while True:
+            c = self.ident()
+            self.expect("op", "=")
+            assigns.append((c, self.parse_expr()))
+            if not self.accept("op", ","):
+                break
+        where = self.parse_expr() if self.accept_kw("where") else None
+        return A.Update(table, assigns, where)
+
+    # ---- SELECT ---------------------------------------------------------
+    def parse_select(self) -> A.Select:
+        self.expect_kw("select")
+        distinct = bool(self.accept_kw("distinct"))
+        items = [self._select_item()]
+        while self.accept("op", ","):
+            items.append(self._select_item())
+        from_ = None
+        if self.accept_kw("from"):
+            from_ = self._table_expr()
+        where = self.parse_expr() if self.accept_kw("where") else None
+        group_by: List[A.ExprNode] = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group_by.append(self.parse_expr())
+            while self.accept("op", ","):
+                group_by.append(self.parse_expr())
+        having = self.parse_expr() if self.accept_kw("having") else None
+        order_by: List[Tuple[A.ExprNode, bool]] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order_by.append(self._order_item())
+            while self.accept("op", ","):
+                order_by.append(self._order_item())
+        limit = offset = None
+        if self.accept_kw("limit"):
+            limit = int(self.expect("num").value)
+        if self.accept_kw("offset"):
+            offset = int(self.expect("num").value)
+        return A.Select(items, from_, where, group_by, having, order_by,
+                        limit, offset, distinct)
+
+    def _select_item(self) -> A.SelectItem:
+        if self.accept("op", "*"):
+            return A.SelectItem(A.Star())
+        # table.* ?
+        if (self.peek().kind in ("id",) and self.peek(1).kind == "op"
+                and self.peek(1).value == "." and self.peek(2).value == "*"):
+            t = self.ident()
+            self.next(); self.next()
+            return A.SelectItem(A.Star(table=t))
+        e = self.parse_expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "id":
+            alias = self.ident()
+        return A.SelectItem(e, alias)
+
+    def _order_item(self) -> Tuple[A.ExprNode, bool]:
+        e = self.parse_expr()
+        desc = False
+        if self.accept_kw("desc"):
+            desc = True
+        else:
+            self.accept_kw("asc")
+        return (e, desc)
+
+    def _table_expr(self) -> A.TableRef:
+        left = self._table_factor()
+        while True:
+            if self.accept("op", ","):
+                right = self._table_factor()
+                left = A.Join(left, right, "cross", None)
+                continue
+            kind = None
+            if self.accept_kw("join"):
+                kind = "inner"
+            elif self.accept_kw("inner"):
+                self.expect_kw("join")
+                kind = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "full"
+            elif self.accept_kw("cross"):
+                self.expect_kw("join")
+                kind = "cross"
+            if kind is None:
+                return left
+            right = self._table_factor()
+            on = None
+            if kind != "cross":
+                self.expect_kw("on")
+                on = self.parse_expr()
+            left = A.Join(left, right, kind, on)
+
+    def _table_factor(self) -> A.TableRef:
+        if self.accept_kw("tumble") or self.accept_kw("hop"):
+            kind = self.toks[self.i - 1].value
+            self.expect("op", "(")
+            inner = self._table_factor()
+            self.expect("op", ",")
+            tc = self.ident()
+            args = []
+            while self.accept("op", ","):
+                args.append(self.parse_expr())
+            self.expect("op", ")")
+            alias = self._alias()
+            return A.WindowTable(kind, inner, tc, args, alias)
+        if self.accept("op", "("):
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                q = self.parse_select()
+                self.expect("op", ")")
+                return A.SubqueryTable(q, self._alias())
+            t = self._table_expr()
+            self.expect("op", ")")
+            a = self._alias()
+            if a:
+                t.alias = a
+            return t
+        name = self.ident()
+        return A.NamedTable(name, self._alias())
+
+    def _alias(self) -> Optional[str]:
+        if self.accept_kw("as"):
+            return self.ident()
+        if self.peek().kind == "id":
+            return self.ident()
+        return None
+
+    # ---- expressions (precedence climbing) ------------------------------
+    def parse_expr(self) -> A.ExprNode:
+        return self._or_expr()
+
+    def _or_expr(self) -> A.ExprNode:
+        e = self._and_expr()
+        while self.accept_kw("or"):
+            e = A.BinOp("or", e, self._and_expr())
+        return e
+
+    def _and_expr(self) -> A.ExprNode:
+        e = self._not_expr()
+        while self.accept_kw("and"):
+            e = A.BinOp("and", e, self._not_expr())
+        return e
+
+    def _not_expr(self) -> A.ExprNode:
+        if self.accept_kw("not"):
+            return A.UnaryOp("not", self._not_expr())
+        return self._cmp_expr()
+
+    def _cmp_expr(self) -> A.ExprNode:
+        e = self._add_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in _CMP_OPS:
+                self.next()
+                e = A.BinOp(t.value, e, self._add_expr())
+                continue
+            if t.kind == "kw" and t.value == "is":
+                self.next()
+                neg = bool(self.accept_kw("not"))
+                self.expect_kw("null")
+                e = A.IsNullExpr(e, neg)
+                continue
+            if t.kind == "kw" and t.value in ("between", "in", "like"):
+                self.next()
+                if t.value == "between":
+                    lo = self._add_expr()
+                    self.expect_kw("and")
+                    hi = self._add_expr()
+                    e = A.Between(e, lo, hi, False)
+                elif t.value == "in":
+                    self.expect("op", "(")
+                    items = [self.parse_expr()]
+                    while self.accept("op", ","):
+                        items.append(self.parse_expr())
+                    self.expect("op", ")")
+                    e = A.InList(e, items, False)
+                else:
+                    pat = self._add_expr()
+                    e = A.FuncCall("like", [e, pat])
+                continue
+            if t.kind == "kw" and t.value == "not" and \
+                    self.peek(1).value in ("between", "in", "like"):
+                self.next()
+                kw = self.next().value
+                if kw == "between":
+                    lo = self._add_expr()
+                    self.expect_kw("and")
+                    hi = self._add_expr()
+                    e = A.Between(e, lo, hi, True)
+                elif kw == "in":
+                    self.expect("op", "(")
+                    items = [self.parse_expr()]
+                    while self.accept("op", ","):
+                        items.append(self.parse_expr())
+                    self.expect("op", ")")
+                    e = A.InList(e, items, True)
+                else:
+                    pat = self._add_expr()
+                    e = A.UnaryOp("not", A.FuncCall("like", [e, pat]))
+                continue
+            return e
+
+    def _add_expr(self) -> A.ExprNode:
+        e = self._mul_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("+", "-", "||"):
+                self.next()
+                op = "concat" if t.value == "||" else t.value
+                r = self._mul_expr()
+                e = A.FuncCall("concat_op", [e, r]) if op == "concat" \
+                    else A.BinOp(op, e, r)
+            else:
+                return e
+
+    def _mul_expr(self) -> A.ExprNode:
+        e = self._unary_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.value in ("*", "/", "%"):
+                self.next()
+                e = A.BinOp(t.value, e, self._unary_expr())
+            else:
+                return e
+
+    def _unary_expr(self) -> A.ExprNode:
+        if self.accept("op", "-"):
+            return A.UnaryOp("-", self._unary_expr())
+        if self.accept("op", "+"):
+            return self._unary_expr()
+        return self._postfix_expr()
+
+    def _postfix_expr(self) -> A.ExprNode:
+        e = self._primary()
+        while self.accept("op", "::"):
+            e = A.CastExpr(e, self._type_name())
+        return e
+
+    def _primary(self) -> A.ExprNode:
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            v = float(t.value) if any(c in t.value for c in ".eE") \
+                else int(t.value)
+            return A.Lit(v)
+        if t.kind == "str":
+            self.next()
+            return A.Lit(t.value)
+        if t.kind == "op" and t.value == "(":
+            self.next()
+            if self.peek().kind == "kw" and self.peek().value == "select":
+                q = self.parse_select()
+                self.expect("op", ")")
+                return A.SubqueryExpr(q)
+            e = self.parse_expr()
+            self.expect("op", ")")
+            return e
+        if t.kind == "kw":
+            if t.value == "null":
+                self.next()
+                return A.Lit(None)
+            if t.value in ("true", "false"):
+                self.next()
+                return A.Lit(t.value == "true")
+            if t.value == "interval":
+                self.next()
+                s = self.expect("str").value
+                unit = None
+                if self.peek().kind == "id":
+                    unit = self.ident()
+                return A.Lit(s + (" " + unit if unit else ""), "interval")
+            if t.value == "case":
+                return self._case()
+            if t.value == "cast":
+                self.next()
+                self.expect("op", "(")
+                e = self.parse_expr()
+                self.expect_kw("as")
+                ty = self._type_name()
+                self.expect("op", ")")
+                return A.CastExpr(e, ty)
+            if t.value == "extract":
+                self.next()
+                self.expect("op", "(")
+                fld = self.ident()
+                self.expect_kw("from")
+                e = self.parse_expr()
+                self.expect("op", ")")
+                return A.ExtractExpr(fld, e)
+            if t.value == "exists":
+                raise ValueError("EXISTS subqueries not supported yet")
+            if t.value == "distinct":
+                raise ValueError("misplaced DISTINCT")
+        # identifier: column, qualified column, or function call
+        name = self.ident()
+        if self.accept("op", "("):
+            distinct = bool(self.accept_kw("distinct"))
+            args: List[A.ExprNode] = []
+            if self.accept("op", "*"):
+                pass  # count(*)
+            elif not (self.peek().kind == "op" and self.peek().value == ")"):
+                args.append(self.parse_expr())
+                while self.accept("op", ","):
+                    args.append(self.parse_expr())
+            self.expect("op", ")")
+            over = None
+            if self.accept_kw("over"):
+                over = self._window_spec()
+            return A.FuncCall(name, args, distinct, over)
+        if self.accept("op", "."):
+            col = self.ident()
+            return A.Col(col, table=name)
+        return A.Col(name)
+
+    def _window_spec(self) -> A.WindowSpec:
+        self.expect("op", "(")
+        partition: List[A.ExprNode] = []
+        order: List[Tuple[A.ExprNode, bool]] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.accept("op", ","):
+                partition.append(self.parse_expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self._order_item())
+            while self.accept("op", ","):
+                order.append(self._order_item())
+        # frame clauses parsed & ignored (default frame used)
+        if self.accept_kw("rows") or self.accept_kw("range"):
+            while not (self.peek().kind == "op" and self.peek().value == ")"):
+                self.next()
+        self.expect("op", ")")
+        return A.WindowSpec(partition, order)
+
+    def _case(self) -> A.CaseExpr:
+        self.expect_kw("case")
+        operand = None
+        if not (self.peek().kind == "kw" and self.peek().value == "when"):
+            operand = self.parse_expr()
+        branches = []
+        while self.accept_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            branches.append((cond, self.parse_expr()))
+        else_expr = self.parse_expr() if self.accept_kw("else") else None
+        self.expect_kw("end")
+        return A.CaseExpr(operand, branches, else_expr)
+
+
+def parse_sql(sql: str) -> List[Any]:
+    return Parser(sql).parse_statements()

@@ -1,0 +1,554 @@
+"""Binder + planner: Select AST -> executor tree.
+
+Collapses the reference's binder -> logical plan -> optimizer -> stream plan
+pipeline (`src/frontend/src/{binder,planner,optimizer}/`) into one direct
+lowering: each SELECT shape maps onto the executor set the same way the
+reference's optimized stream plans do (Project/Filter/HashAgg/HashJoin/
+HopWindow/OverWindow/TopN/Materialize). The 100+ rewrite rules exist to
+normalize hand-written SQL into those shapes; here the planner emits them
+directly and leaves micro-optimization to XLA on the device path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core import dtypes as T
+from ..core.dtypes import DataType, TypeKind, parse_interval
+from ..core.schema import Field, Schema
+from ..expr import (AGG_KINDS, AggCall, Case, Coalesce, Expr, InputRef,
+                    Literal, build_func, cast)
+from ..expr.expression import IsNull
+from ..ops import (FilterExecutor, HashAggExecutor, HashJoinExecutor,
+                   HopWindowExecutor, JoinType, OverWindowExecutor,
+                   ProjectExecutor, SimpleAggExecutor, TopNExecutor,
+                   WindowFuncCall)
+from ..ops.executor import Executor
+from . import ast as A
+
+_TYPE_MAP = {
+    "int": T.INT32, "integer": T.INT32, "int4": T.INT32,
+    "smallint": T.INT16, "int2": T.INT16,
+    "bigint": T.INT64, "int8": T.INT64, "serial": T.INT64,
+    "real": T.FLOAT32, "float4": T.FLOAT32,
+    "double": T.FLOAT64, "float8": T.FLOAT64, "float": T.FLOAT64,
+    "numeric": T.DECIMAL, "decimal": T.DECIMAL,
+    "boolean": T.BOOLEAN, "bool": T.BOOLEAN,
+    "varchar": T.VARCHAR, "text": T.VARCHAR, "string": T.VARCHAR,
+    "date": T.DATE, "time": T.TIME, "timestamp": T.TIMESTAMP,
+    "timestamptz": T.TIMESTAMPTZ, "interval": T.INTERVAL, "bytea": T.BYTEA,
+}
+
+
+def type_from_name(name: str) -> DataType:
+    dt = _TYPE_MAP.get(name.lower())
+    if dt is None:
+        raise ValueError(f"unknown type {name!r}")
+    return dt
+
+
+# ---------------------------------------------------------------------------
+# Namespace: the column scope a plan node exposes
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ColumnEntry:
+    table: Optional[str]
+    name: str
+    dtype: DataType
+
+
+@dataclass
+class Namespace:
+    cols: List[ColumnEntry]
+
+    def resolve(self, name: str, table: Optional[str] = None) -> int:
+        hits = [i for i, c in enumerate(self.cols)
+                if c.name == name and (table is None or c.table == table)]
+        if not hits:
+            raise ValueError(f"column {table + '.' if table else ''}{name} "
+                             f"does not exist")
+        if len(hits) > 1:
+            raise ValueError(f"column reference {name!r} is ambiguous")
+        return hits[0]
+
+    def schema(self) -> Schema:
+        return Schema([Field(c.name, c.dtype) for c in self.cols])
+
+    @staticmethod
+    def of_schema(schema: Schema, table: Optional[str]) -> "Namespace":
+        return Namespace([ColumnEntry(table, f.name, f.dtype)
+                          for f in schema.fields])
+
+    def concat(self, other: "Namespace") -> "Namespace":
+        return Namespace(self.cols + other.cols)
+
+
+# ---------------------------------------------------------------------------
+# Expression binding
+# ---------------------------------------------------------------------------
+
+_BINOP_FUNC = {
+    "+": "add", "-": "subtract", "*": "multiply", "/": "divide",
+    "%": "modulus", "=": "equal", "<>": "not_equal", "!=": "not_equal",
+    "<": "less_than", "<=": "less_than_or_equal", ">": "greater_than",
+    ">=": "greater_than_or_equal", "and": "and", "or": "or",
+}
+
+
+def _lit(value: Any, hint: Optional[str]) -> Literal:
+    if hint == "interval":
+        return Literal(parse_interval(value), T.INTERVAL)
+    if value is None:
+        return Literal(None, T.VARCHAR)
+    if isinstance(value, bool):
+        return Literal(value, T.BOOLEAN)
+    if isinstance(value, int):
+        return Literal(value, T.INT32 if -2**31 <= value < 2**31 else T.INT64)
+    if isinstance(value, float):
+        return Literal(value, T.FLOAT64)
+    if isinstance(value, str):
+        return Literal(value, T.VARCHAR)
+    raise ValueError(f"cannot type literal {value!r}")
+
+
+class Binder:
+    def __init__(self, ns: Namespace):
+        self.ns = ns
+
+    def bind(self, node: A.ExprNode) -> Expr:
+        if isinstance(node, A.Lit):
+            return _lit(node.value, node.type_hint)
+        if isinstance(node, A.Col):
+            i = self.ns.resolve(node.name, node.table)
+            return InputRef(i, self.ns.cols[i].dtype)
+        if isinstance(node, A.BinOp):
+            return build_func(_BINOP_FUNC[node.op],
+                              [self.bind(node.left), self.bind(node.right)])
+        if isinstance(node, A.UnaryOp):
+            if node.op == "not":
+                return build_func("not", [self.bind(node.operand)])
+            return build_func("neg", [self.bind(node.operand)])
+        if isinstance(node, A.FuncCall):
+            if node.name in ("count", "sum", "min", "max", "avg") \
+                    and node.over is None:
+                raise ValueError(f"aggregate {node.name} in scalar context")
+            if node.name == "concat_op":
+                return build_func("concat_op", [self.bind(a)
+                                                for a in node.args])
+            return build_func(node.name, [self.bind(a) for a in node.args])
+        if isinstance(node, A.CaseExpr):
+            branches = []
+            for cond, res in node.branches:
+                if node.operand is not None:
+                    cond = A.BinOp("=", node.operand, cond)
+                branches.append((self.bind(cond), self.bind(res)))
+            els = self.bind(node.else_expr) if node.else_expr else None
+            ret = branches[0][1].return_type
+            return Case(branches, els, ret)
+        if isinstance(node, A.CastExpr):
+            return cast(self.bind(node.operand),
+                        type_from_name(node.type_name))
+        if isinstance(node, A.ExtractExpr):
+            return build_func("extract",
+                              [Literal(node.field.upper(), T.VARCHAR),
+                               self.bind(node.operand)])
+        if isinstance(node, A.IsNullExpr):
+            return IsNull(self.bind(node.operand), negated=node.negated)
+        if isinstance(node, A.Between):
+            lo = A.BinOp(">=", node.operand, node.low)
+            hi = A.BinOp("<=", node.operand, node.high)
+            e = A.BinOp("and", lo, hi)
+            if node.negated:
+                e = A.UnaryOp("not", e)
+            return self.bind(e)
+        if isinstance(node, A.InList):
+            e: Optional[A.ExprNode] = None
+            for item in node.items:
+                eq = A.BinOp("=", node.operand, item)
+                e = eq if e is None else A.BinOp("or", e, eq)
+            if node.negated:
+                e = A.UnaryOp("not", e)
+            return self.bind(e)
+        raise ValueError(f"cannot bind {node!r}")
+
+
+# ---------------------------------------------------------------------------
+# Aggregate extraction
+# ---------------------------------------------------------------------------
+
+
+def _find_aggs(node: A.ExprNode, out: List[A.FuncCall]) -> None:
+    if isinstance(node, A.FuncCall) and node.over is None and \
+            node.name in AGG_KINDS:
+        out.append(node)
+        return
+    for child in _children(node):
+        _find_aggs(child, out)
+
+
+def _children(node: A.ExprNode) -> List[A.ExprNode]:
+    if isinstance(node, A.BinOp):
+        return [node.left, node.right]
+    if isinstance(node, A.UnaryOp):
+        return [node.operand]
+    if isinstance(node, A.FuncCall):
+        return list(node.args)
+    if isinstance(node, A.CaseExpr):
+        out = list(node.branches and
+                   [x for b in node.branches for x in b] or [])
+        if node.operand:
+            out.append(node.operand)
+        if node.else_expr:
+            out.append(node.else_expr)
+        return out
+    if isinstance(node, (A.CastExpr, A.ExtractExpr, A.IsNullExpr)):
+        return [node.operand]
+    if isinstance(node, A.Between):
+        return [node.operand, node.low, node.high]
+    if isinstance(node, A.InList):
+        return [node.operand] + node.items
+    return []
+
+
+def _contains_agg(node: A.ExprNode) -> bool:
+    found: List[A.FuncCall] = []
+    _find_aggs(node, found)
+    return bool(found)
+
+
+# ---------------------------------------------------------------------------
+# Planner
+# ---------------------------------------------------------------------------
+
+_JOIN_KIND = {"inner": JoinType.INNER, "left": JoinType.LEFT_OUTER,
+              "right": JoinType.RIGHT_OUTER, "full": JoinType.FULL_OUTER}
+
+
+class Planner:
+    """Plans one Select into an executor tree.
+
+    `subscribe(name) -> (Executor, Schema, pk)` is supplied by the runtime
+    (Database): streaming change feed + backfill for MV plans, snapshot
+    source for batch queries — the planner is mode-agnostic, exactly the
+    to-stream / to-batch split of the reference's plan_node lowering.
+    """
+
+    def __init__(self, subscribe: Callable[[str], Tuple[Executor, Schema]]):
+        self.subscribe = subscribe
+
+    # ---- FROM -----------------------------------------------------------
+    def _plan_table(self, ref: A.TableRef) -> Tuple[Executor, Namespace]:
+        if isinstance(ref, A.NamedTable):
+            execu, schema = self.subscribe(ref.name)
+            return execu, Namespace.of_schema(schema, ref.alias or ref.name)
+        if isinstance(ref, A.SubqueryTable):
+            execu, ns = self.plan_select(ref.query)
+            alias = ref.alias
+            return execu, Namespace(
+                [ColumnEntry(alias, c.name, c.dtype) for c in ns.cols])
+        if isinstance(ref, A.WindowTable):
+            execu, ns = self._plan_table(ref.inner)
+            ti = ns.resolve(ref.time_col)
+            b = Binder(ns)
+            ivals = [b.bind(a) for a in ref.args]
+            assert all(isinstance(e, Literal) for e in ivals), \
+                "window sizes must be INTERVAL literals"
+            if ref.kind == "tumble":
+                size = ivals[0].value
+                hop = size
+            else:
+                hop, size = ivals[0].value, ivals[1].value
+            execu = HopWindowExecutor(execu, ti, hop, size)
+            alias = ref.alias
+            cols = [ColumnEntry(alias or c.table, c.name, c.dtype)
+                    for c in ns.cols]
+            cols += [ColumnEntry(alias, "window_start", T.TIMESTAMP),
+                     ColumnEntry(alias, "window_end", T.TIMESTAMP)]
+            return execu, Namespace(cols)
+        if isinstance(ref, A.Join):
+            return self._plan_join(ref)
+        raise ValueError(f"cannot plan table ref {ref!r}")
+
+    def _plan_join(self, ref: A.Join) -> Tuple[Executor, Namespace]:
+        lexec, lns = self._plan_table(ref.left)
+        rexec, rns = self._plan_table(ref.right)
+        ns = lns.concat(rns)
+        if ref.kind == "cross":
+            raise ValueError("cross join without equi-condition is not "
+                             "supported in streaming plans")
+        # split ON into equi-conjuncts and residual condition
+        conjuncts = _split_and(ref.on)
+        lkeys: List[int] = []
+        rkeys: List[int] = []
+        residual: List[A.ExprNode] = []
+        nl = len(lns.cols)
+        for c in conjuncts:
+            pair = _equi_pair(c, ns, nl)
+            if pair is not None:
+                lkeys.append(pair[0])
+                rkeys.append(pair[1] - nl)
+            else:
+                residual.append(c)
+        if not lkeys:
+            raise ValueError("join requires at least one equi-condition")
+        cond = None
+        if residual:
+            node = residual[0]
+            for r in residual[1:]:
+                node = A.BinOp("and", node, r)
+            cond = Binder(ns).bind(node)
+        execu = HashJoinExecutor(lexec, rexec, lkeys, rkeys,
+                                 _JOIN_KIND[ref.kind], condition=cond)
+        return execu, ns
+
+    # ---- SELECT ---------------------------------------------------------
+    def plan_select(self, q: A.Select) -> Tuple[Executor, Namespace]:
+        if q.from_ is None:
+            raise ValueError("SELECT without FROM is a batch-only statement")
+        execu, ns = self._plan_table(q.from_)
+
+        if q.where is not None:
+            execu = FilterExecutor(execu, Binder(ns).bind(q.where))
+
+        # expand stars
+        items: List[A.SelectItem] = []
+        for it in q.items:
+            if isinstance(it.expr, A.Star):
+                for i, c in enumerate(ns.cols):
+                    if it.expr.table is None or c.table == it.expr.table:
+                        items.append(A.SelectItem(A.Col(c.name, c.table),
+                                                  c.name))
+            else:
+                items.append(it)
+
+        has_aggs = bool(q.group_by) or any(_contains_agg(i.expr)
+                                           for i in items) or \
+            (q.having is not None and _contains_agg(q.having))
+
+        if has_aggs:
+            execu, ns, items = self._plan_agg(execu, ns, q, items)
+        if q.having is not None and not has_aggs:
+            execu = FilterExecutor(execu, Binder(ns).bind(q.having))
+
+        # over-window functions
+        if any(isinstance(i.expr, A.FuncCall) and i.expr.over is not None
+               for i in items):
+            execu, ns, items = self._plan_over_window(execu, ns, items)
+
+        # final projection
+        b = Binder(ns)
+        exprs = [b.bind(i.expr) for i in items]
+        names = [i.alias or _default_name(i.expr) for i in items]
+        execu = ProjectExecutor(execu, exprs, names)
+        ns = Namespace([ColumnEntry(None, n, e.return_type)
+                        for n, e in zip(names, exprs)])
+
+        if q.distinct:
+            execu = HashAggExecutor(execu, list(range(len(ns.cols))), [])
+            # schema unchanged: group keys only
+
+        if q.limit is not None:
+            order = [(ns.resolve(_order_name(e, ns)), d)
+                     for e, d in q.order_by] if q.order_by else []
+            execu = TopNExecutor(execu, order, q.limit, q.offset or 0)
+        return execu, ns
+
+    def _plan_agg(self, execu: Executor, ns: Namespace, q: A.Select,
+                  items: List[A.SelectItem]
+                  ) -> Tuple[Executor, Namespace, List[A.SelectItem]]:
+        b = Binder(ns)
+        group_exprs = [b.bind(g) for g in q.group_by]
+
+        aggs: List[A.FuncCall] = []
+        for it in items:
+            _find_aggs(it.expr, aggs)
+        if q.having is not None:
+            _find_aggs(q.having, aggs)
+
+        # pre-projection: group keys then agg args
+        pre_exprs: List[Expr] = list(group_exprs)
+        pre_names = [f"g{i}" for i in range(len(group_exprs))]
+        calls: List[AggCall] = []
+        for i, a in enumerate(aggs):
+            if a.args:
+                arg = b.bind(a.args[0])
+                idx = len(pre_exprs)
+                pre_exprs.append(arg)
+                pre_names.append(f"a{i}")
+                call_arg = InputRef(idx, arg.return_type)
+            else:
+                call_arg = None
+            calls.append(AggCall(a.name, call_arg, distinct=a.distinct))
+        if not pre_exprs:
+            # count(*)-only: chunks must keep their cardinality, and a
+            # zero-column chunk cannot (`DataChunk` derives capacity from
+            # its columns) — project a constant
+            pre_exprs = [Literal(1, T.INT32)]
+            pre_names = ["_one"]
+        proj = ProjectExecutor(execu, pre_exprs, pre_names)
+        eowc = getattr(q, "emit_on_window_close", False)
+        wc = None
+        if eowc:
+            wc = _find_window_col(q.group_by)
+        agg = HashAggExecutor(proj, list(range(len(group_exprs))), calls,
+                              emit_on_window_close=eowc,
+                              window_col_in_group=wc) \
+            if group_exprs else SimpleAggExecutor(proj, calls)
+
+        # post-agg namespace: group cols (resolvable by original AST) + aggs
+        post_cols = []
+        for i, g in enumerate(q.group_by):
+            name = _default_name(g)
+            post_cols.append(ColumnEntry(_table_of(g), name,
+                                         group_exprs[i].return_type))
+        for i, (a, c) in enumerate(zip(aggs, calls)):
+            post_cols.append(ColumnEntry(None, f"agg#{i}", c.return_type))
+        post_ns = Namespace(post_cols)
+
+        # rewrite items/having: replace agg calls with agg#i refs, group
+        # exprs with their post-agg columns
+        def rewrite(node: A.ExprNode) -> A.ExprNode:
+            for i, g in enumerate(q.group_by):
+                if node == g:
+                    c = post_cols[i]
+                    return A.Col(c.name, c.table)
+            if isinstance(node, A.FuncCall) and node.over is None and \
+                    node.name in AGG_KINDS:
+                idx = next(i for i, a in enumerate(aggs) if a is node)
+                return A.Col(f"agg#{idx}")
+            clone = _clone_with(node, rewrite)
+            return clone
+
+        new_items = [A.SelectItem(rewrite(i.expr), i.alias) for i in items]
+        out: Executor = agg
+        if q.having is not None:
+            out = FilterExecutor(out, Binder(post_ns).bind(rewrite(q.having)))
+        return out, post_ns, new_items
+
+    def _plan_over_window(self, execu: Executor, ns: Namespace,
+                          items: List[A.SelectItem]):
+        specs = [i for i in items
+                 if isinstance(i.expr, A.FuncCall) and i.expr.over is not None]
+        first = specs[0].expr.over
+        for s in specs[1:]:
+            if s.expr.over != first:
+                raise ValueError("multiple distinct OVER() specs unsupported")
+        b = Binder(ns)
+        partition = [_as_input_ref(b.bind(p)) for p in first.partition_by]
+        order = [(_as_input_ref(b.bind(e)), d) for e, d in first.order_by]
+        calls = []
+        for s in specs:
+            f: A.FuncCall = s.expr
+            arg = b.bind(f.args[0]) if f.args else None
+            calls.append(WindowFuncCall(f.name, arg))
+        execu = OverWindowExecutor(execu, partition, order, calls)
+        cols = list(ns.cols)
+        new_items = []
+        wi = 0
+        for it in items:
+            if isinstance(it.expr, A.FuncCall) and it.expr.over is not None:
+                name = f"w#{wi}"
+                cols.append(ColumnEntry(None, name, calls[wi].return_type))
+                new_items.append(A.SelectItem(A.Col(name), it.alias))
+                wi += 1
+            else:
+                new_items.append(it)
+        return execu, Namespace(cols), new_items
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _split_and(node: Optional[A.ExprNode]) -> List[A.ExprNode]:
+    if node is None:
+        return []
+    if isinstance(node, A.BinOp) and node.op == "and":
+        return _split_and(node.left) + _split_and(node.right)
+    return [node]
+
+
+def _equi_pair(node: A.ExprNode, ns: Namespace, nl: int
+               ) -> Optional[Tuple[int, int]]:
+    if not (isinstance(node, A.BinOp) and node.op == "="):
+        return None
+    if not (isinstance(node.left, A.Col) and isinstance(node.right, A.Col)):
+        return None
+    try:
+        li = ns.resolve(node.left.name, node.left.table)
+        ri = ns.resolve(node.right.name, node.right.table)
+    except ValueError:
+        return None
+    if li < nl <= ri:
+        return (li, ri)
+    if ri < nl <= li:
+        return (ri, li)
+    return None
+
+
+def _as_input_ref(e: Expr) -> int:
+    if not isinstance(e, InputRef):
+        raise ValueError("PARTITION BY / ORDER BY must be plain columns")
+    return e.index
+
+
+def _order_name(e: A.ExprNode, ns: Namespace) -> str:
+    if isinstance(e, A.Col):
+        return e.name
+    raise ValueError("ORDER BY in MV must reference output columns")
+
+
+def _default_name(e: A.ExprNode) -> str:
+    if isinstance(e, A.Col):
+        return e.name
+    if isinstance(e, A.FuncCall):
+        return e.name
+    if isinstance(e, A.ExtractExpr):
+        return "extract"
+    if isinstance(e, A.CaseExpr):
+        return "case"
+    if isinstance(e, A.CastExpr):
+        return _default_name(e.operand)
+    return "?column?"
+
+
+def _table_of(e: A.ExprNode) -> Optional[str]:
+    return e.table if isinstance(e, A.Col) else None
+
+
+def _find_window_col(group_by: List[A.ExprNode]) -> Optional[int]:
+    for i, g in enumerate(group_by):
+        if isinstance(g, A.Col) and g.name in ("window_start", "window_end"):
+            return i
+    raise ValueError("EMIT ON WINDOW CLOSE requires window_start/window_end "
+                     "in GROUP BY")
+
+
+def _clone_with(node: A.ExprNode, f) -> A.ExprNode:
+    if isinstance(node, A.BinOp):
+        return A.BinOp(node.op, f(node.left), f(node.right))
+    if isinstance(node, A.UnaryOp):
+        return A.UnaryOp(node.op, f(node.operand))
+    if isinstance(node, A.FuncCall):
+        return A.FuncCall(node.name, [f(a) for a in node.args],
+                          node.distinct, node.over)
+    if isinstance(node, A.CaseExpr):
+        return A.CaseExpr(f(node.operand) if node.operand else None,
+                          [(f(c), f(r)) for c, r in node.branches],
+                          f(node.else_expr) if node.else_expr else None)
+    if isinstance(node, A.CastExpr):
+        return A.CastExpr(f(node.operand), node.type_name)
+    if isinstance(node, A.ExtractExpr):
+        return A.ExtractExpr(node.field, f(node.operand))
+    if isinstance(node, A.IsNullExpr):
+        return A.IsNullExpr(f(node.operand), node.negated)
+    if isinstance(node, A.Between):
+        return A.Between(f(node.operand), f(node.low), f(node.high),
+                         node.negated)
+    if isinstance(node, A.InList):
+        return A.InList(f(node.operand), [f(i) for i in node.items],
+                        node.negated)
+    return node

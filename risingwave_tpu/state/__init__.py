@@ -1,6 +1,7 @@
 """State layer: stores + relational state tables (reference: `src/storage/`,
 `src/stream/src/common/table/`)."""
+from .hummock import SpillStateStore
 from .state_table import StateTable
 from .store import MemoryStateStore, StateStore
 
-__all__ = ["StateTable", "MemoryStateStore", "StateStore"]
+__all__ = ["StateTable", "MemoryStateStore", "SpillStateStore", "StateStore"]

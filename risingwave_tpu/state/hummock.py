@@ -1,0 +1,138 @@
+"""SpillStateStore — durable LSM-lite state store.
+
+Re-design of Hummock (`src/storage/src/hummock/`) scoped to what the TPU
+runtime needs from it:
+
+* writes buffer in memtables and become durable ONLY at barrier commit
+  (`seal_current_epoch` -> uploader `sync(epoch)` analog,
+  `hummock/event_handler/uploader/mod.rs:994`): each commit flushes the
+  epoch's per-table delta as one sorted run file, then atomically advances
+  the manifest (`HummockManager::commit_epoch` analog,
+  `src/meta/src/hummock/manager/commit_epoch.rs:71`);
+* recovery = replay committed runs in epoch order (uncommitted epochs
+  vanish, exactly the checkpoint contract);
+* compaction merges a table's runs into one base snapshot once the run
+  count passes a threshold (`hummock/compactor/` analog, trivially tiered);
+* reads serve from memory — host RAM is the cache tier above the spill
+  tier, the `foyer` block-cache analog; run files are never read on the
+  hot path.
+
+File format: zlib-compressed pickle of the sorted (key, row|None) delta
+list. The column-aware value encoding (`core/encoding.py`) remains the
+parity-tested wire format; spill files are a private on-disk format the
+same way the reference's SST blocks are.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from .store import KeyedTable, MemoryStateStore
+
+MANIFEST = "MANIFEST.json"
+COMPACT_THRESHOLD = 8
+
+
+class SpillStateStore(MemoryStateStore):
+    """Durable store: MemoryStateStore working set + epoch-run spill dir."""
+
+    def __init__(self, directory: str):
+        super().__init__()
+        self.dir = directory
+        os.makedirs(os.path.join(directory, "runs"), exist_ok=True)
+        self._deltas: Dict[int, Dict[bytes, Optional[Tuple]]] = {}
+        self._manifest: Dict[str, Any] = {"committed_epoch": 0, "tables": {}}
+        self._recover()
+
+    # ---- write path -----------------------------------------------------
+    def ingest_batch(self, table_id, batch, epoch):
+        d = self._deltas.setdefault(table_id, {})
+        for key, row in batch:
+            d[key] = row
+        super().ingest_batch(table_id, batch, epoch)
+
+    def commit_epoch(self, epoch):
+        garbage: List[str] = []
+        for tid, delta in self._deltas.items():
+            if not delta:
+                continue
+            name = f"t{tid}_e{epoch}.run"
+            self._write_run(name, sorted(delta.items()))
+            runs = self._manifest["tables"].setdefault(str(tid), [])
+            runs.append(name)
+            if len(runs) > COMPACT_THRESHOLD:
+                garbage += self._compact(tid, epoch)
+        self._deltas.clear()
+        self._manifest["committed_epoch"] = max(
+            self._manifest["committed_epoch"], epoch)
+        self._write_manifest()
+        # old runs are deleted only after the manifest that no longer
+        # references them is durable (crash between compact and manifest
+        # write must leave the previous version fully readable)
+        self._gc(garbage)
+        super().commit_epoch(epoch)
+
+    # ---- files ----------------------------------------------------------
+    def _run_path(self, name: str) -> str:
+        return os.path.join(self.dir, "runs", name)
+
+    def _write_run(self, name: str, items: List) -> None:
+        blob = zlib.compress(pickle.dumps(items, protocol=4), 1)
+        tmp = self._run_path(name) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._run_path(name))
+
+    def _read_run(self, name: str) -> List:
+        with open(self._run_path(name), "rb") as f:
+            return pickle.loads(zlib.decompress(f.read()))
+
+    def _write_manifest(self) -> None:
+        tmp = os.path.join(self.dir, MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(self._manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.dir, MANIFEST))
+
+    # ---- compaction -----------------------------------------------------
+    def _compact(self, table_id: int, epoch: int) -> List[str]:
+        """Merge all runs into one base snapshot; tombstones drop out.
+        Returns the now-unreferenced run files (deleted by the caller AFTER
+        the new manifest is durable)."""
+        t = self._table(table_id)
+        items = [(k, v) for k, v in t.iter_range(None, None)]
+        name = f"t{table_id}_e{epoch}.base"
+        self._write_run(name, items)
+        old = self._manifest["tables"][str(table_id)]
+        self._manifest["tables"][str(table_id)] = [name]
+        return old
+
+    def _gc(self, names: Sequence[str]) -> None:
+        for n in names:
+            try:
+                os.remove(self._run_path(n))
+            except FileNotFoundError:
+                pass
+
+    # ---- recovery -------------------------------------------------------
+    def _recover(self) -> None:
+        path = os.path.join(self.dir, MANIFEST)
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            self._manifest = json.load(f)
+        for tid_s, runs in self._manifest["tables"].items():
+            t = self._table(int(tid_s))
+            for name in runs:
+                for key, row in self._read_run(name):
+                    if row is None:
+                        t.delete(key)
+                    else:
+                        t.put(key, row)
+        self.committed_epoch = self._manifest["committed_epoch"]

@@ -1,0 +1,191 @@
+"""SQL frontend end-to-end: DDL, DML, MV maintenance, batch queries.
+
+The oracle everywhere: MV contents == batch recompute over the same data
+(the reference's sqllogictest-driven MV/batch equivalence, SURVEY.md §4).
+"""
+from decimal import Decimal
+
+import pytest
+
+from risingwave_tpu.sql import Database
+
+
+@pytest.fixture()
+def db():
+    return Database()
+
+
+class TestBasics:
+    def test_create_insert_select(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10), (2, 20), (3, NULL)")
+        assert sorted(db.query("SELECT k, v FROM t")) == \
+            [(1, 10), (2, 20), (3, None)]
+
+    def test_where_and_exprs(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+        assert db.query("SELECT k + v FROM t WHERE v > 15 AND k < 3") == \
+            [(22,)]
+        assert sorted(db.query(
+            "SELECT k FROM t WHERE v BETWEEN 10 AND 20")) == [(1,), (2,)]
+        assert db.query("SELECT k FROM t WHERE k IN (3, 4)") == [(3,)]
+
+    def test_case_cast_extract(self, db):
+        db.run("CREATE TABLE t (ts TIMESTAMP, v BIGINT)")
+        db.run("INSERT INTO t VALUES ('2026-07-29 10:30:00', 7)")
+        assert db.query(
+            "SELECT extract(year FROM ts), CAST(v AS DOUBLE), "
+            "CASE WHEN v > 5 THEN 'hi' ELSE 'lo' END FROM t") == \
+            [(2026, 7.0, "hi")]
+
+    def test_delete(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10), (2, 20)")
+        db.run("DELETE FROM t WHERE k = 1")
+        assert db.query("SELECT k FROM t") == [(2,)]
+
+    def test_primary_key_upsert(self, db):
+        db.run("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10)")
+        db.run("INSERT INTO t VALUES (1, 99)")
+        assert db.query("SELECT v FROM t") == [(99,)]
+
+    def test_show_and_drop(self, db):
+        db.run("CREATE TABLE t (k BIGINT)")
+        assert db.run("SHOW TABLES")[0] == ["t"]
+        db.run("DROP TABLE t")
+        assert db.run("SHOW TABLES")[0] == []
+
+
+class TestMVMaintenance:
+    def test_agg_mv_incremental(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW m AS "
+               "SELECT k, count(*) AS c, sum(v) AS s FROM t GROUP BY k")
+        db.run("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5)")
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1, 2, Decimal(30)), (2, 1, Decimal(5))]
+        db.run("DELETE FROM t WHERE v = 20")
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1, 1, Decimal(10)), (2, 1, Decimal(5))]
+        db.run("DELETE FROM t WHERE k = 2")
+        assert db.query("SELECT * FROM m") == [(1, 1, Decimal(10))]
+
+    def test_mv_on_mv_backfill(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10), (2, 20)")  # data BEFORE the MV
+        db.run("CREATE MATERIALIZED VIEW m1 AS "
+               "SELECT k, sum(v) AS s FROM t GROUP BY k")
+        db.run("CREATE MATERIALIZED VIEW m2 AS "
+               "SELECT count(*) AS n FROM m1")
+        db.run("FLUSH")
+        assert db.query("SELECT n FROM m2") == [(2,)]
+        db.run("INSERT INTO t VALUES (3, 30)")
+        assert db.query("SELECT n FROM m2") == [(3,)]
+
+    def test_join_mv(self, db):
+        db.run("CREATE TABLE a (id BIGINT PRIMARY KEY, cat BIGINT)")
+        db.run("CREATE TABLE b (aid BIGINT, price BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT a.cat, b.price "
+               "FROM b JOIN a ON b.aid = a.id")
+        db.run("INSERT INTO a VALUES (1, 100)")
+        db.run("INSERT INTO b VALUES (1, 5), (1, 7), (2, 9)")
+        assert sorted(db.query("SELECT * FROM j")) == [(100, 5), (100, 7)]
+        db.run("INSERT INTO a VALUES (2, 200)")
+        assert sorted(db.query("SELECT * FROM j")) == \
+            [(100, 5), (100, 7), (200, 9)]
+
+    def test_left_join_null_padding(self, db):
+        db.run("CREATE TABLE a (id BIGINT PRIMARY KEY, x BIGINT)")
+        db.run("CREATE TABLE b (id BIGINT PRIMARY KEY, y BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT a.x, b.y "
+               "FROM a LEFT JOIN b ON a.id = b.id")
+        db.run("INSERT INTO a VALUES (1, 10)")
+        assert db.query("SELECT * FROM j") == [(10, None)]
+        db.run("INSERT INTO b VALUES (1, 99)")
+        assert db.query("SELECT * FROM j") == [(10, 99)]
+
+    def test_topn_mv(self, db):
+        db.run("CREATE TABLE t (v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW top2 AS "
+               "SELECT v FROM t ORDER BY v DESC LIMIT 2")
+        db.run("INSERT INTO t VALUES (5), (1), (9), (3)")
+        assert sorted(db.query("SELECT v FROM top2")) == [(5,), (9,)]
+        db.run("DELETE FROM t WHERE v = 9")
+        assert sorted(db.query("SELECT v FROM top2")) == [(3,), (5,)]
+
+    def test_tumble_window_mv(self, db):
+        db.run("CREATE TABLE ev (ts TIMESTAMP, v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW w AS SELECT window_start, "
+               "count(*) AS c FROM TUMBLE(ev, ts, INTERVAL '10' SECOND) "
+               "GROUP BY window_start")
+        db.run("INSERT INTO ev VALUES ('2026-01-01 00:00:01', 1), "
+               "('2026-01-01 00:00:05', 2), ('2026-01-01 00:00:12', 3)")
+        rows = sorted(db.query("SELECT * FROM w"))
+        assert [c for _, c in rows] == [2, 1]
+
+    def test_simple_agg_no_group(self, db):
+        db.run("CREATE TABLE t (v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT count(*) AS c, "
+               "min(v) AS mn FROM t")
+        db.run("FLUSH")
+        assert db.query("SELECT * FROM m") == [(0, None)]
+        db.run("INSERT INTO t VALUES (5), (2)")
+        assert db.query("SELECT * FROM m") == [(2, 2)]
+
+    def test_having(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT k, count(*) AS c "
+               "FROM t GROUP BY k HAVING count(*) > 1")
+        db.run("INSERT INTO t VALUES (1, 1), (1, 2), (2, 3)")
+        assert db.query("SELECT * FROM m") == [(1, 2)]
+
+    def test_distinct(self, db):
+        db.run("CREATE TABLE t (k BIGINT)")
+        db.run("INSERT INTO t VALUES (1), (1), (2)")
+        assert sorted(db.query("SELECT DISTINCT k FROM t")) == [(1,), (2,)]
+
+    def test_sink_collects_changes(self, db):
+        db.run("CREATE TABLE t (k BIGINT)")
+        db.run("CREATE SINK s FROM t WITH (connector='blackhole')")
+        db.run("INSERT INTO t VALUES (1), (2)")
+        assert len(db.sink_results["s"]) == 2
+
+
+class TestBatchOrderLimit:
+    def test_order_by_limit(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 30), (2, 10), (3, 20)")
+        assert db.query("SELECT k, v FROM t ORDER BY v DESC LIMIT 2") == \
+            [(1, 30), (3, 20)]
+        assert db.query("SELECT k FROM t ORDER BY v ASC LIMIT 1 OFFSET 1") \
+            == [(3,)]
+
+
+class TestNexmarkSource:
+    def test_bid_source_counts(self, db):
+        db.run("CREATE SOURCE nbid (auction BIGINT, bidder BIGINT, "
+               "price BIGINT, channel VARCHAR, url VARCHAR, "
+               "date_time TIMESTAMP, extra VARCHAR) WITH ("
+               "connector='nexmark', nexmark.table='bid', "
+               "nexmark.max.events='500')")
+        db.run("CREATE MATERIALIZED VIEW c AS SELECT count(*) AS n FROM nbid")
+        db.run("FLUSH")
+        db.run("FLUSH")
+        (n,), = db.query("SELECT n FROM c")
+        assert n > 400  # ~92% of nexmark events are bids
+
+
+class TestDurability:
+    def test_database_over_spill_store(self, tmp_path):
+        d = str(tmp_path)
+        db = Database(data_dir=d)
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10), (2, 20)")
+        del db
+        db2 = Database(data_dir=d)
+        # catalog is rebuilt by re-running DDL (catalog persistence is a
+        # separate milestone); state tables recover from the spill store
+        db2.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        assert sorted(db2.query("SELECT k, v FROM t")) == [(1, 10), (2, 20)]
