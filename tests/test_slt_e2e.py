@@ -1,0 +1,38 @@
+"""Run the .slt end-to-end suites (the reference's e2e_test tier)."""
+import glob
+import os
+
+import pytest
+
+from risingwave_tpu.testing import run_slt_file
+
+E2E = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "e2e_test")
+SLT_FILES = sorted(glob.glob(os.path.join(E2E, "**", "*.slt"), recursive=True))
+
+
+@pytest.mark.parametrize("path", SLT_FILES,
+                         ids=[os.path.relpath(p, E2E) for p in SLT_FILES])
+def test_slt(path):
+    run_slt_file(path)
+
+
+def test_mv_equals_batch_recompute_nexmark_datagen():
+    """Parity oracle on generated data: every MV == batch recompute of its
+    defining query over the base table (SURVEY §4 'core correctness
+    oracle')."""
+    from risingwave_tpu.sql import Database
+    db = Database()
+    db.run("CREATE SOURCE nbid (auction BIGINT, bidder BIGINT, price BIGINT, "
+           "channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
+           " WITH (connector='nexmark', nexmark.table='bid', "
+           "nexmark.max.events='2000')")
+    db.run("CREATE MATERIALIZED VIEW agg AS SELECT auction, count(*) AS c, "
+           "sum(price) AS s, max(price) AS m FROM nbid GROUP BY auction")
+    db.run("FLUSH")
+    db.run("FLUSH")
+    mv = sorted(db.query("SELECT * FROM agg"))
+    batch = sorted(db.query(
+        "SELECT auction, count(*), sum(price), max(price) "
+        "FROM nbid GROUP BY auction"))
+    assert mv == batch and len(mv) > 10
