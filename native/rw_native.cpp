@@ -1,0 +1,114 @@
+// Native host kernels for risingwave_tpu.
+//
+// The reference's host hot loops are Rust (`src/common/src/hash/`,
+// value encodings in `src/common/src/util/value_encoding/`); this is the
+// C++ equivalent for the Python host runtime, loaded via ctypes
+// (risingwave_tpu/native/__init__.py).  Everything here is allocation-free
+// and operates on caller-provided numpy buffers.
+//
+// Build: g++ -O3 -shared -fPIC -o librw_native.so rw_native.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// CRC32 (IEEE reflected, matches zlib/crc32fast) — slice-by-8 tables.
+uint32_t T8[8][256];
+bool init_done = false;
+
+void init_tables() {
+    if (init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c >> 1) ^ (0xEDB88320u & (~((c & 1u) - 1u)));
+        T8[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int t = 1; t < 8; t++)
+            T8[t][i] = (T8[t - 1][i] >> 8) ^ T8[0][T8[t - 1][i] & 0xFF];
+    init_done = true;
+}
+
+inline uint32_t crc32_bytes(const uint8_t* p, int64_t len, uint32_t crc) {
+    crc = ~crc;
+    while (len >= 8) {
+        uint32_t lo;
+        uint32_t hi;
+        std::memcpy(&lo, p, 4);
+        std::memcpy(&hi, p + 4, 4);
+        lo ^= crc;
+        crc = T8[7][lo & 0xFF] ^ T8[6][(lo >> 8) & 0xFF] ^
+              T8[5][(lo >> 16) & 0xFF] ^ T8[4][lo >> 24] ^
+              T8[3][hi & 0xFF] ^ T8[2][(hi >> 8) & 0xFF] ^
+              T8[1][(hi >> 16) & 0xFF] ^ T8[0][hi >> 24];
+        p += 8;
+        len -= 8;
+    }
+    while (len--) crc = (crc >> 8) ^ T8[0][(crc ^ *p++) & 0xFF];
+    return ~crc;
+}
+
+}  // namespace
+
+extern "C" {
+
+// CRC32 of each row of an (n, k) row-major uint8 matrix.
+void rw_crc32_rows(const uint8_t* data, int64_t n, int64_t k, uint32_t* out) {
+    init_tables();
+    for (int64_t i = 0; i < n; i++)
+        out[i] = crc32_bytes(data + i * k, k, 0);
+}
+
+// CRC32 over the 8 big-endian bytes of each int64 — the vnode key path
+// (`consistent_hash/vnode.rs:45-49` serializes ints big-endian).
+void rw_crc32_i64_be(const int64_t* vals, int64_t n, uint32_t* out) {
+    init_tables();
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = static_cast<uint64_t>(vals[i]);
+        uint8_t be[8];
+        for (int b = 0; b < 8; b++) be[b] = (v >> (56 - 8 * b)) & 0xFF;
+        out[i] = crc32_bytes(be, 8, 0);
+    }
+}
+
+// vnode = crc32(key) % vnode_count, fused (saves a numpy round trip).
+void rw_vnodes_i64(const int64_t* vals, int64_t n, int32_t vnode_count,
+                   int32_t* out) {
+    init_tables();
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = static_cast<uint64_t>(vals[i]);
+        uint8_t be[8];
+        for (int b = 0; b < 8; b++) be[b] = (v >> (56 - 8 * b)) & 0xFF;
+        out[i] = static_cast<int32_t>(crc32_bytes(be, 8, 0) %
+                                      static_cast<uint32_t>(vnode_count));
+    }
+}
+
+// FNV-1a 64 over each row of an (n, k) uint8 matrix with per-row lengths
+// (string hash64 projection for device chunks).
+void rw_fnv1a64_rows(const uint8_t* data, const int64_t* lens, int64_t n,
+                     int64_t stride, uint64_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t* p = data + i * stride;
+        uint64_t h = 1469598103934665603ull;
+        for (int64_t j = 0; j < lens[i]; j++) {
+            h ^= p[j];
+            h *= 1099511628211ull;
+        }
+        out[i] = h;
+    }
+}
+
+// Memcomparable encode of int64 batch: big-endian with sign bit flipped
+// (`util/memcmp_encoding.rs`), 8 bytes per value into out (n*8).
+void rw_memcmp_i64(const int64_t* vals, int64_t n, uint8_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = static_cast<uint64_t>(vals[i]) ^ (1ull << 63);
+        for (int b = 0; b < 8; b++)
+            out[i * 8 + b] = (v >> (56 - 8 * b)) & 0xFF;
+    }
+}
+
+}  // extern "C"

@@ -152,6 +152,17 @@ def compute_vnodes(key_cols: Sequence[Column], n: Optional[int] = None,
         assert n is not None
         return np.zeros(n, dtype=np.int32)
     n = len(key_cols[0])
+    # fast path: single non-null integral key -> fused C++ kernel
+    if len(key_cols) == 1:
+        col = key_cols[0]
+        if (col.dtype.is_fixed_width and col.validity.all()
+                and col.dtype.kind not in (TypeKind.BOOLEAN, TypeKind.FLOAT32,
+                                           TypeKind.FLOAT64)):
+            from ..native import vnodes_i64
+            vn = vnodes_i64(col.values.astype(np.int64, copy=False),
+                            vnode_count)
+            if vn is not None:
+                return vn
     crc = None
     for col in key_cols:
         if col.dtype.is_fixed_width:

@@ -1,0 +1,104 @@
+"""ctypes loader for the C++ host kernels (native/rw_native.cpp).
+
+The library is built on first import (g++ is in the image; result cached
+next to the sources). Every entry point has a numpy fallback so the
+framework still runs where no toolchain exists — but the native path is the
+default for host hot loops (vnode hashing for dispatch, key encoding),
+mirroring the reference's native `src/common/src/hash/` kernels.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO, "native", "rw_native.cpp")
+_SO = os.path.join(_REPO, "native", "librw_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not os.path.exists(_SRC) or not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        lib.rw_crc32_rows.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
+                                      u32p]
+        lib.rw_crc32_i64_be.argtypes = [i64p, ctypes.c_int64, u32p]
+        lib.rw_vnodes_i64.argtypes = [i64p, ctypes.c_int64, ctypes.c_int32,
+                                      i32p]
+        lib.rw_fnv1a64_rows.argtypes = [u8p, i64p, ctypes.c_int64,
+                                        ctypes.c_int64, u64p]
+        lib.rw_memcmp_i64.argtypes = [i64p, ctypes.c_int64, u8p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def crc32_rows(data: np.ndarray) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    n, k = data.shape
+    out = np.empty(n, dtype=np.uint32)
+    lib.rw_crc32_rows(data, n, k, out)
+    return out
+
+
+def vnodes_i64(vals: np.ndarray, vnode_count: int) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = np.empty(len(vals), dtype=np.int32)
+    lib.rw_vnodes_i64(vals, len(vals), vnode_count, out)
+    return out
+
+
+def memcmp_i64(vals: np.ndarray) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    vals = np.ascontiguousarray(vals, dtype=np.int64)
+    out = np.empty(len(vals) * 8, dtype=np.uint8)
+    lib.rw_memcmp_i64(vals, len(vals), out)
+    return out.reshape(len(vals), 8)

@@ -4,6 +4,7 @@ from .materialize import BatchScan, ConflictBehavior, MaterializeExecutor
 from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Watermark
 from .simple import (ExpandExecutor, FilterExecutor, ProjectExecutor,
                      RowIdGenExecutor, UnionExecutor, ValuesExecutor)
+from .exchange import Channel, DispatchExecutor, MergeExecutor
 from .source import BarrierInjector, SourceExecutor, SourceReader
 from .agg import (HashAggExecutor, SimpleAggExecutor,
                   StatelessSimpleAggExecutor)
@@ -21,5 +22,5 @@ __all__ = [
     "HashAggExecutor", "SimpleAggExecutor", "StatelessSimpleAggExecutor",
     "HashJoinExecutor", "JoinType", "AppendOnlyDedupExecutor", "TopNExecutor",
     "HopWindowExecutor", "OverWindowExecutor", "WindowFuncCall",
-    "WatermarkFilterExecutor",
+    "WatermarkFilterExecutor", "Channel", "DispatchExecutor", "MergeExecutor",
 ]

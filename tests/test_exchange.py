@@ -1,0 +1,139 @@
+"""Exchange layer: hash dispatch + merge alignment == single-actor result."""
+import numpy as np
+import pytest
+
+from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
+from risingwave_tpu.connectors import ListReader
+from risingwave_tpu.expr import AggCall, InputRef
+from risingwave_tpu.ops import (BarrierInjector, Channel, DispatchExecutor,
+                                HashAggExecutor, MergeExecutor,
+                                SourceExecutor, Watermark)
+from risingwave_tpu.ops.message import Barrier
+
+S = Schema.of(("k", T.INT64), ("v", T.INT64))
+
+
+def make_chunks(rng, n_chunks=6, rows=64, keys=10):
+    out = []
+    for _ in range(n_chunks):
+        ks = rng.integers(0, keys, rows)
+        vs = rng.integers(0, 100, rows)
+        out.append(StreamChunk.from_rows(
+            S.dtypes, [(Op.INSERT, (int(k), int(v)))
+                       for k, v in zip(ks, vs)]))
+    return out
+
+
+def run_parallel_agg(chunks, n_actors):
+    """source -> hash dispatch -> N agg actors -> simple dispatch -> merge."""
+    inj = BarrierInjector()
+    src = SourceExecutor(S, ListReader(chunks), inj)
+    mids = [Channel(capacity=1 << 20) for _ in range(n_actors)]
+    disp = DispatchExecutor(src, mids, kind="hash", key_indices=[0])
+    outs = []
+    agg_disps = []
+    for i in range(n_actors):
+        merge_in = MergeExecutor([mids[i]], S, pumps=[disp])
+        agg = HashAggExecutor(merge_in, [0],
+                              [AggCall("count"),
+                               AggCall("sum", InputRef(1, T.INT64))])
+        out_ch = Channel(capacity=1 << 20)
+        outs.append(out_ch)
+        agg_disps.append(DispatchExecutor(agg, [out_ch], kind="simple"))
+    final = MergeExecutor(outs, None, pumps=agg_disps)
+    inj.inject()
+    inj.inject_stop()
+    state = {}
+    barriers = 0
+    for msg in final.execute():
+        if isinstance(msg, StreamChunk):
+            for op, r in msg.compact().op_rows():
+                if op.is_insert:
+                    state[r[0]] = r[1:]
+                elif state.get(r[0]) == r[1:]:
+                    del state[r[0]]
+        elif isinstance(msg, Barrier):
+            barriers += 1
+    return state, barriers
+
+
+def oracle(chunks):
+    st = {}
+    for c in chunks:
+        for op, (k, v) in c.op_rows():
+            cnt, sm = st.get(k, (0, 0))
+            st[k] = (cnt + 1, sm + v)
+    return st
+
+
+def test_parallel_agg_matches_oracle():
+    rng = np.random.default_rng(5)
+    chunks = make_chunks(rng)
+    exp = oracle(chunks)
+    for n in (1, 2, 4):
+        got, barriers = run_parallel_agg(chunks, n)
+        got = {k: (c, int(s)) for k, (c, s) in got.items()}
+        assert got == exp, f"n_actors={n}"
+        assert barriers == 2  # initial + stop, each aligned to ONE barrier
+
+
+def test_update_pair_split_degrades():
+    """A U-/U+ pair whose halves hash to different outputs becomes D+I."""
+    ch0, ch1 = Channel(), Channel()
+    # find two keys landing on different outputs
+    from risingwave_tpu.core.vnode import vnode_of_row, VNODE_COUNT
+    k0 = 0
+    k1 = next(k for k in range(1, 100)
+              if (vnode_of_row([k]) * 2) // VNODE_COUNT !=
+                 (vnode_of_row([k0]) * 2) // VNODE_COUNT)
+    chunk = StreamChunk.from_rows(
+        S.dtypes, [(Op.UPDATE_DELETE, (k0, 1)), (Op.UPDATE_INSERT, (k1, 2))])
+
+    class OneShot:
+        schema = S
+        def execute(self):
+            yield chunk
+    d = DispatchExecutor(OneShot(), [ch0, ch1], kind="hash", key_indices=[0])
+    d.pump_until_barrier()
+    msgs = []
+    for ch in (ch0, ch1):
+        m = ch.recv()
+        while m is not None:
+            msgs.append(m)
+            m = ch.recv()
+    ops = [op for m in msgs for op, _ in m.compact().op_rows()]
+    assert sorted(ops) == [Op.INSERT, Op.DELETE]
+
+
+def test_broadcast_and_round_robin():
+    ch = [Channel(), Channel()]
+    chunk = StreamChunk.from_rows(S.dtypes, [(Op.INSERT, (1, 1))])
+
+    class OneShot:
+        schema = S
+        def execute(self):
+            yield chunk
+            yield chunk
+    d = DispatchExecutor(OneShot(), ch, kind="broadcast")
+    d.pump_until_barrier()
+    assert len(ch[0]) == 2 and len(ch[1]) == 2
+    ch = [Channel(), Channel()]
+    d = DispatchExecutor(OneShot(), ch, kind="round_robin")
+    d.pump_until_barrier()
+    assert len(ch[0]) == 1 and len(ch[1]) == 1
+
+
+def test_merge_min_watermark():
+    a, b = Channel(), Channel()
+    m = MergeExecutor([a, b], S)
+    from risingwave_tpu.ops.message import BarrierKind, EpochPair
+    bar = Barrier(EpochPair(2, 1), BarrierKind.CHECKPOINT)
+    stop = Barrier(EpochPair(3, 2), BarrierKind.CHECKPOINT)
+    from risingwave_tpu.ops.message import Mutation, MutationKind
+    stop.mutation = Mutation(MutationKind.STOP)
+    a.send(Watermark(0, T.INT64, 10)); a.send(bar); a.send(stop)
+    b.send(Watermark(0, T.INT64, 5)); b.send(bar); b.send(stop)
+    msgs = list(m.execute())
+    wms = [x for x in msgs if isinstance(x, Watermark)]
+    assert [w.value for w in wms] == [5]
+    assert sum(isinstance(x, Barrier) for x in msgs) == 2
