@@ -74,14 +74,47 @@ class Database:
         # upstream (SharedStream, port) pairs captured while planning the
         # statement currently being executed; moved onto the created object
         self._pending_subs: List[Tuple[SharedStream, Any]] = []
+        # DDL log (catalog persistence): table id 0 holds (seq, sql) rows;
+        # replayed on open so a restarted process rebuilds its dataflows
+        # (the meta catalog + recovery analog, `worker.rs:664`)
+        self._ddl_log = StateTable(self.store, 0, [T.INT64, T.VARCHAR], [0])
+        self._ddl_seq = 0
+        self._replaying = False
+        self._recover_catalog()
+
+    def _recover_catalog(self) -> None:
+        entries = sorted(self._ddl_log.iter_all())
+        if not entries:
+            return
+        self._replaying = True
+        try:
+            for seq, sql in entries:
+                self._ddl_seq = max(self._ddl_seq, seq + 1)
+                for stmt in parse_sql(sql):
+                    self._execute(stmt)
+        finally:
+            self._replaying = False
+
+    def _log_ddl(self, sql: str) -> None:
+        if self._replaying:
+            return
+        self._ddl_log.insert((self._ddl_seq, sql))
+        self._ddl_seq += 1
+        self._ddl_log.commit(self.injector.epoch.curr)
+        self.store.commit_epoch(self.injector.epoch.curr)
 
     # ------------------------------------------------------------------
     # statement surface
     # ------------------------------------------------------------------
     def run(self, sql: str) -> List[Any]:
+        from .parser import parse_sql_with_text
         out = []
-        for stmt in parse_sql(sql):
-            out.append(self._execute(stmt))
+        for stmt, text in parse_sql_with_text(sql):
+            result = self._execute(stmt)
+            if isinstance(stmt, (A.CreateTable, A.CreateMaterializedView,
+                                 A.CreateSink, A.DropObject)):
+                self._log_ddl(text)
+            out.append(result)
         return out
 
     def query(self, sql: str) -> List[Tuple]:
@@ -135,8 +168,11 @@ class Database:
                             stmt.with_options)
         connector = stmt.with_options.get("connector", "dml")
         reader = self._make_reader(connector, stmt, schema)
-        split_st = StateTable(self.store, self.catalog.alloc_table_id(),
-                              [T.VARCHAR, T.VARCHAR], [0])
+        # split offsets persist for real connectors only: a DML buffer is
+        # transient, and restoring its offset would skip freshly pushed rows
+        split_st = None if connector == "dml" else StateTable(
+            self.store, self.catalog.alloc_table_id(),
+            [T.VARCHAR, T.VARCHAR], [0])
         src: Executor = SourceExecutor(schema, reader, self.injector,
                                        split_state_table=split_st,
                                        name=f"Source({stmt.name})")
@@ -186,18 +222,25 @@ class Database:
     def _subscribe(self, name: str) -> Tuple[Executor, Schema]:
         obj = self.catalog.get(name)
         rt = obj.runtime
-        snapshot_rows = list(rt["state_table"].iter_all())
         snap = None
-        if snapshot_rows:
-            snap = StreamChunk.from_rows(
-                obj.schema.dtypes,
-                [(Op.INSERT, r) for r in snapshot_rows])
+        if not self._replaying:
+            # DDL-log replay: downstream recovered state already includes
+            # the snapshot — re-backfilling would double-count
+            snapshot_rows = list(rt["state_table"].iter_all())
+            if snapshot_rows:
+                snap = StreamChunk.from_rows(
+                    obj.schema.dtypes,
+                    [(Op.INSERT, r) for r in snapshot_rows])
         port = rt["shared"].subscribe()
         self._pending_subs.append((rt["shared"], port))
         return _Backfill(snap, port), obj.schema
 
+    def _make_state(self, dtypes, pk):
+        return StateTable(self.store, self.catalog.alloc_table_id(),
+                          list(dtypes), list(pk))
+
     def _create_mv(self, stmt: A.CreateMaterializedView) -> str:
-        planner = Planner(self._subscribe)
+        planner = Planner(self._subscribe, make_state=self._make_state)
         self._pending_subs = []
         execu, ns = planner.plan_select(stmt.query)
         schema = ns.schema()
@@ -224,7 +267,9 @@ class Database:
         if stmt.from_name is not None:
             execu, schema = self._subscribe(stmt.from_name)
         else:
-            execu, ns = Planner(self._subscribe).plan_select(stmt.query)
+            execu, ns = Planner(self._subscribe,
+                                make_state=self._make_state
+                                ).plan_select(stmt.query)
             schema = ns.schema()
         rows: List[Tuple] = []
         self.sink_results[stmt.name] = rows

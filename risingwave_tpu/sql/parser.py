@@ -25,11 +25,12 @@ _TOKEN_RE = re.compile(r"""
 
 
 class Token:
-    __slots__ = ("kind", "value")
+    __slots__ = ("kind", "value", "pos")
 
-    def __init__(self, kind: str, value: str):
+    def __init__(self, kind: str, value: str, pos: int = -1):
         self.kind = kind       # 'num' | 'str' | 'id' | 'kw' | 'op' | 'eof'
         self.value = value
+        self.pos = pos         # char offset in the source text
 
     def __repr__(self):
         return f"{self.kind}:{self.value}"
@@ -57,6 +58,7 @@ def tokenize(sql: str) -> List[Token]:
         m = _TOKEN_RE.match(sql, pos)
         if not m:
             raise ValueError(f"cannot tokenize at: {sql[pos:pos+30]!r}")
+        start = pos
         pos = m.end()
         kind = m.lastgroup
         text = m.group()
@@ -64,14 +66,14 @@ def tokenize(sql: str) -> List[Token]:
             continue
         if kind == "id":
             low = text.lower()
-            out.append(Token("kw" if low in _KEYWORDS else "id", low))
+            out.append(Token("kw" if low in _KEYWORDS else "id", low, start))
         elif kind == "qid":
-            out.append(Token("id", text[1:-1].replace('""', '"')))
+            out.append(Token("id", text[1:-1].replace('""', '"'), start))
         elif kind == "str":
-            out.append(Token("str", text[1:-1].replace("''", "'")))
+            out.append(Token("str", text[1:-1].replace("''", "'"), start))
         else:
-            out.append(Token(kind, text))
-    out.append(Token("eof", ""))
+            out.append(Token(kind, text, start))
+    out.append(Token("eof", "", len(sql)))
     return out
 
 
@@ -703,3 +705,17 @@ class Parser:
 
 def parse_sql(sql: str) -> List[Any]:
     return Parser(sql).parse_statements()
+
+
+def parse_sql_with_text(sql: str) -> List[tuple]:
+    """[(stmt, source_text)] — source slices let DDL be logged verbatim."""
+    p = Parser(sql)
+    out = []
+    while p.peek().kind != "eof":
+        start = p.peek().pos
+        stmt = p.parse_statement()
+        end = p.peek().pos if p.peek().kind != "eof" else len(sql)
+        while p.accept("op", ";"):
+            end = p.peek().pos if p.peek().kind != "eof" else len(sql)
+        out.append((stmt, sql[start:end].rstrip().rstrip(";")))
+    return out

@@ -235,8 +235,14 @@ class Planner:
     to-stream / to-batch split of the reference's plan_node lowering.
     """
 
-    def __init__(self, subscribe: Callable[[str], Tuple[Executor, Schema]]):
+    def __init__(self, subscribe: Callable[[str], Tuple[Executor, Schema]],
+                 make_state: Optional[Callable[[Sequence[DataType],
+                                                Sequence[int]], Any]] = None):
         self.subscribe = subscribe
+        # state-table factory: (dtypes, pk) -> StateTable | None. Called in
+        # a DETERMINISTIC order per statement so table ids line up when the
+        # DDL log replays on recovery.
+        self.make_state = make_state or (lambda dtypes, pk: None)
 
     # ---- FROM -----------------------------------------------------------
     def _plan_table(self, ref: A.TableRef) -> Tuple[Executor, Namespace]:
@@ -299,8 +305,14 @@ class Planner:
             for r in residual[1:]:
                 node = A.BinOp("and", node, r)
             cond = Binder(ns).bind(node)
-        execu = HashJoinExecutor(lexec, rexec, lkeys, rkeys,
-                                 _JOIN_KIND[ref.kind], condition=cond)
+        ldtypes = [c.dtype for c in lns.cols]
+        rdtypes = [c.dtype for c in rns.cols]
+        execu = HashJoinExecutor(
+            lexec, rexec, lkeys, rkeys, _JOIN_KIND[ref.kind], condition=cond,
+            left_state=self.make_state(ldtypes + [T.INT64],
+                                       list(range(len(ldtypes)))),
+            right_state=self.make_state(rdtypes + [T.INT64],
+                                        list(range(len(rdtypes)))))
         return execu, ns
 
     # ---- SELECT ---------------------------------------------------------
@@ -346,13 +358,19 @@ class Planner:
                         for n, e in zip(names, exprs)])
 
         if q.distinct:
-            execu = HashAggExecutor(execu, list(range(len(ns.cols))), [])
+            st = self.make_state([c.dtype for c in ns.cols] + [T.BYTEA],
+                                 list(range(len(ns.cols))))
+            execu = HashAggExecutor(execu, list(range(len(ns.cols))), [],
+                                    state_table=st)
             # schema unchanged: group keys only
 
         if q.limit is not None:
             order = [(ns.resolve(_order_name(e, ns)), d)
                      for e, d in q.order_by] if q.order_by else []
-            execu = TopNExecutor(execu, order, q.limit, q.offset or 0)
+            st = self.make_state([c.dtype for c in ns.cols],
+                                 list(range(len(ns.cols))))
+            execu = TopNExecutor(execu, order, q.limit, q.offset or 0,
+                                 state_table=st)
         return execu, ns
 
     def _plan_agg(self, execu: Executor, ns: Namespace, q: A.Select,
@@ -392,10 +410,16 @@ class Planner:
         wc = None
         if eowc:
             wc = _find_window_col(q.group_by)
-        agg = HashAggExecutor(proj, list(range(len(group_exprs))), calls,
-                              emit_on_window_close=eowc,
-                              window_col_in_group=wc) \
-            if group_exprs else SimpleAggExecutor(proj, calls)
+        if group_exprs:
+            gdtypes = [e.return_type for e in group_exprs]
+            st = self.make_state(gdtypes + [T.BYTEA],
+                                 list(range(len(group_exprs))))
+            agg: Executor = HashAggExecutor(
+                proj, list(range(len(group_exprs))), calls, state_table=st,
+                emit_on_window_close=eowc, window_col_in_group=wc)
+        else:
+            st = self.make_state([T.INT64, T.BYTEA], [0])
+            agg = SimpleAggExecutor(proj, calls, state_table=st)
 
         # post-agg namespace: group cols (resolvable by original AST) + aggs
         post_cols = []
@@ -443,7 +467,10 @@ class Planner:
             f: A.FuncCall = s.expr
             arg = b.bind(f.args[0]) if f.args else None
             calls.append(WindowFuncCall(f.name, arg))
-        execu = OverWindowExecutor(execu, partition, order, calls)
+        st = self.make_state([c.dtype for c in ns.cols],
+                             list(range(len(ns.cols))))
+        execu = OverWindowExecutor(execu, partition, order, calls,
+                                   state_table=st)
         cols = list(ns.cols)
         new_items = []
         wi = 0

@@ -45,6 +45,7 @@ class SpillStateStore(MemoryStateStore):
         os.makedirs(os.path.join(directory, "runs"), exist_ok=True)
         self._deltas: Dict[int, Dict[bytes, Optional[Tuple]]] = {}
         self._manifest: Dict[str, Any] = {"committed_epoch": 0, "tables": {}}
+        self._file_seq = 0
         self._recover()
 
     # ---- write path -----------------------------------------------------
@@ -59,7 +60,11 @@ class SpillStateStore(MemoryStateStore):
         for tid, delta in self._deltas.items():
             if not delta:
                 continue
-            name = f"t{tid}_e{epoch}.run"
+            # the sequence number makes names unique even when two commits
+            # share an epoch (e.g. back-to-back DDL) — a same-named run
+            # would silently overwrite its predecessor
+            self._file_seq += 1
+            name = f"t{tid}_e{epoch}_{self._file_seq}.run"
             self._write_run(name, sorted(delta.items()))
             runs = self._manifest["tables"].setdefault(str(tid), [])
             runs.append(name)
@@ -107,7 +112,8 @@ class SpillStateStore(MemoryStateStore):
         the new manifest is durable)."""
         t = self._table(table_id)
         items = [(k, v) for k, v in t.iter_range(None, None)]
-        name = f"t{table_id}_e{epoch}.base"
+        self._file_seq += 1
+        name = f"t{table_id}_e{epoch}_{self._file_seq}.base"
         self._write_run(name, items)
         old = self._manifest["tables"][str(table_id)]
         self._manifest["tables"][str(table_id)] = [name]
@@ -136,3 +142,8 @@ class SpillStateStore(MemoryStateStore):
                     else:
                         t.put(key, row)
         self.committed_epoch = self._manifest["committed_epoch"]
+        for runs in self._manifest["tables"].values():
+            for name in runs:
+                parts = name.rsplit(".", 1)[0].split("_")
+                if len(parts) >= 3:
+                    self._file_seq = max(self._file_seq, int(parts[2]))

@@ -184,8 +184,35 @@ class TestDurability:
         db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
         db.run("INSERT INTO t VALUES (1, 10), (2, 20)")
         del db
-        db2 = Database(data_dir=d)
-        # catalog is rebuilt by re-running DDL (catalog persistence is a
-        # separate milestone); state tables recover from the spill store
-        db2.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db2 = Database(data_dir=d)  # DDL log replays the catalog
         assert sorted(db2.query("SELECT k, v FROM t")) == [(1, 10), (2, 20)]
+
+    def test_full_recovery_with_mv_and_agg_state(self, tmp_path):
+        d = str(tmp_path)
+        db = Database(data_dir=d)
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW m AS "
+               "SELECT k, count(*) AS c, sum(v) AS s FROM t GROUP BY k")
+        db.run("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5)")
+        before = sorted(db.query("SELECT * FROM m"))
+        del db
+
+        db2 = Database(data_dir=d)
+        assert sorted(db2.query("SELECT * FROM m")) == before
+        # incremental maintenance continues from recovered agg state
+        db2.run("INSERT INTO t VALUES (1, 100)")
+        from decimal import Decimal
+        assert sorted(db2.query("SELECT * FROM m")) == \
+            [(1, 3, Decimal(130)), (2, 1, Decimal(5))]
+        db2.run("DELETE FROM t WHERE k = 2")
+        assert db2.query("SELECT * FROM m") == [(1, 3, Decimal(130))]
+
+    def test_recovery_drop_replay(self, tmp_path):
+        d = str(tmp_path)
+        db = Database(data_dir=d)
+        db.run("CREATE TABLE t (k BIGINT)")
+        db.run("CREATE TABLE u (k BIGINT)")
+        db.run("DROP TABLE u")
+        del db
+        db2 = Database(data_dir=d)
+        assert db2.run("SHOW TABLES")[0] == ["t"]
