@@ -1,0 +1,68 @@
+"""Elastic rescale: move vnode-sharded device state between mesh sizes.
+
+Analog of `ScaleController::reschedule_actors` + the vnode-bitmap updates
+stateful executors apply at barriers (`src/meta/src/stream/scale.rs:2329`,
+`state_table.rs:694-790`): state rows move to the shard that owns their
+vnode under the new mapping. Runs at a barrier boundary (no in-flight
+epoch), host-driven — rescale is rare and control-plane-paced, so the
+gather/scatter through host memory is the simple correct choice; the
+steady-state path never pays for it.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.vnode import VNODE_COUNT
+from ..device.sorted_state import EMPTY_KEY, SortedState, _neutral
+from .mesh import SHARD_AXIS, shard_of_vnode
+
+
+def _vnode_of_keys(keys: np.ndarray, vnode_count: int) -> np.ndarray:
+    """vnode per key — must match the device exchange's CRC32 routing."""
+    from ..native import vnodes_i64
+    vn = vnodes_i64(keys, vnode_count)
+    if vn is not None:
+        return vn
+    from ..core.vnode import crc32_bytes_matrix, _int_key_bytes
+    crc = crc32_bytes_matrix(_int_key_bytes(keys))
+    return (crc % np.uint32(vnode_count)).astype(np.int32)
+
+
+def reshard_state(state: SortedState, kinds, new_mesh: Mesh,
+                  vnode_count: int = VNODE_COUNT,
+                  min_capacity: int = 64) -> SortedState:
+    """Redistribute a [n_old, C] sharded SortedState onto `new_mesh`.
+
+    Per-shard sorted order is preserved (keys were globally hashed, so a
+    shard's rows stay sorted after filtering), capacity grows to the
+    largest new shard (pow2)."""
+    n_new = new_mesh.devices.size
+    keys = np.asarray(state.keys).reshape(-1)          # [n_old * C]
+    vals = [np.asarray(v).reshape(-1) for v in state.vals]
+    live = keys != EMPTY_KEY
+    lkeys = keys[live]
+    lvals = [v[live] for v in vals]
+    dest = shard_of_vnode(_vnode_of_keys(lkeys, vnode_count), n_new,
+                          vnode_count)
+    counts = np.bincount(dest, minlength=n_new)
+    cap = max(min_capacity, 1 << int(max(1, counts.max()) - 1).bit_length())
+    new_keys = np.full((n_new, cap), EMPTY_KEY, dtype=np.int64)
+    new_vals = [np.full((n_new, cap), np.asarray(_neutral(k, v.dtype)),
+                        dtype=v.dtype) for v, k in zip(lvals, kinds)]
+    for s in range(n_new):
+        sel = dest == s
+        ks = lkeys[sel]
+        order = np.argsort(ks, kind="stable")
+        n = len(ks)
+        new_keys[s, :n] = ks[order]
+        for dst, src in zip(new_vals, lvals):
+            dst[s, :n] = src[sel][order]
+    sharding = NamedSharding(new_mesh, P(SHARD_AXIS))
+    return SortedState(
+        jax.device_put(new_keys, sharding),
+        jax.device_put(counts.astype(np.int32), sharding),
+        tuple(jax.device_put(v, sharding) for v in new_vals))

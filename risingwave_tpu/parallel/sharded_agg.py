@@ -182,6 +182,19 @@ class ShardedHashAgg:
                 np.concatenate([np.asarray(v), padv], 1), self._sharding))
         self.state = SortedState(keys, st.count, tuple(vals))
 
+    def rescale(self, new_mesh: Mesh) -> None:
+        """Barrier-synchronized elastic re-shard onto a different mesh
+        (`scale.rs:2329` analog). Epoch buffers must be flushed first."""
+        assert not self._rows, "rescale must happen at a barrier boundary"
+        from .rescale import reshard_state
+        self.state = reshard_state(self.state, self.spec.kinds, new_mesh,
+                                   self.vnode_count)
+        self.mesh = new_mesh
+        self.n = new_mesh.devices.size
+        self._step = make_sharded_agg_step(self.spec, new_mesh,
+                                           self.vnode_count)
+        self._sharding = NamedSharding(new_mesh, P(SHARD_AXIS))
+
     def flush_epoch(self) -> Optional[Dict[str, Any]]:
         if not self._rows:
             return None

@@ -360,6 +360,9 @@ class Database:
     # ------------------------------------------------------------------
     def tick(self) -> None:
         """Inject one barrier and drive every job until it passes."""
+        import time as _time
+        from ..utils.metrics import REGISTRY
+        t0 = _time.perf_counter()
         b = self.injector.inject()
         for name, it in list(self._iters.items()):
             for msg in it:
@@ -368,6 +371,20 @@ class Database:
         if b.is_checkpoint:
             self.store.commit_epoch(b.epoch.curr)
             self.epoch_committed = b.epoch.curr
+        # barrier latency + epoch progress (streaming_stats.rs analog)
+        REGISTRY.histogram("barrier_latency_seconds",
+                           "inject-to-collect barrier latency"
+                           ).observe(_time.perf_counter() - t0)
+        REGISTRY.counter("barrier_count", "barriers completed").inc()
+        REGISTRY.gauge("committed_epoch", "last committed epoch"
+                       ).set(self.epoch_committed)
+        REGISTRY.gauge("streaming_jobs", "running dataflows"
+                       ).set(len(self._iters))
+
+    def metrics(self) -> str:
+        """Prometheus text exposition (MonitorService analog)."""
+        from ..utils.metrics import REGISTRY
+        return REGISTRY.expose()
 
     def flush(self, ticks: int = 2) -> str:
         for _ in range(ticks):

@@ -1,0 +1,3 @@
+"""Utilities: metrics, tracing, config."""
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,
+                      REGISTRY)

@@ -1,0 +1,220 @@
+"""Prometheus-style metrics kernel.
+
+Analog of the reference's guarded labeled metrics
+(`src/common/metrics/src/guarded_metrics.rs` + per-layer metric structs like
+`src/stream/src/executor/monitor/streaming_stats.rs`): counters, gauges and
+histograms with label sets, a process-wide registry, and text exposition in
+the Prometheus format. No external client library — the framework only needs
+the data model and the wire format.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, label_names: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str):
+        values = tuple(str(v) for v in values)
+        assert len(values) == len(self.label_names)
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(values, self._make_child())
+        return child
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def collect(self) -> List[str]:
+        raise NotImplementedError
+
+    def _fmt_labels(self, values: Tuple[str, ...]) -> str:
+        if not values:
+            return ""
+        inner = ",".join(f'{k}="{v}"'
+                         for k, v in zip(self.label_names, values))
+        return "{" + inner + "}"
+
+
+class _CounterChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, by: float = 1.0) -> None:
+        self.value += by
+
+
+class Counter(_Metric):
+    def _make_child(self):
+        return _CounterChild()
+
+    def inc(self, by: float = 1.0) -> None:
+        self.labels().inc(by)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        for vals, ch in sorted(self._children.items()):
+            out.append(f"{self.name}{self._fmt_labels(vals)} {ch.value:g}")
+        return out
+
+
+class _GaugeChild:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, by: float = 1.0) -> None:
+        self.value += by
+
+    def dec(self, by: float = 1.0) -> None:
+        self.value -= by
+
+
+class Gauge(_Metric):
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for vals, ch in sorted(self._children.items()):
+            out.append(f"{self.name}{self._fmt_labels(vals)} {ch.value:g}")
+        return out
+
+
+_DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "sum")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        if i < len(self.counts):
+            self.counts[i] += 1
+        self.total += 1
+        self.sum += v
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket upper bounds (dashboards)."""
+        if self.total == 0:
+            return 0.0
+        target = q * self.total
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= target:
+                return self.buckets[i]
+        return float("inf")
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help_, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def time(self):
+        return _Timer(self.labels())
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        for vals, ch in sorted(self._children.items()):
+            acc = 0
+            for ub, c in zip(self.buckets, ch.counts):
+                acc += c
+                lbl = dict(zip(self.label_names, vals))
+                inner = ",".join([f'{k}="{v}"' for k, v in lbl.items()] +
+                                 [f'le="{ub:g}"'])
+                out.append(f"{self.name}_bucket{{{inner}}} {acc}")
+            linf = ",".join([f'{k}="{v}"' for k, v in
+                             zip(self.label_names, vals)] + ['le="+Inf"'])
+            out.append(f"{self.name}_bucket{{{linf}}} {ch.total}")
+            out.append(f"{self.name}_sum{self._fmt_labels(vals)} {ch.sum:g}")
+            out.append(f"{self.name}_count{self._fmt_labels(vals)} "
+                       f"{ch.total}")
+        return out
+
+
+class _Timer:
+    def __init__(self, child: _HistogramChild):
+        self.child = child
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.child.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(name, help_, labels))
+
+    def gauge(self, name: str, help_: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(name, help_, labels))
+
+    def histogram(self, name: str, help_: str = "",
+                  labels: Sequence[str] = (),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help_, labels, buckets))
+
+    def _register(self, m: _Metric):
+        with self._lock:
+            existing = self._metrics.get(m.name)
+            if existing is not None:
+                assert type(existing) is type(m), f"metric {m.name} re-typed"
+                return existing
+            self._metrics[m.name] = m
+            return m
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            lines += self._metrics[name].collect()
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()

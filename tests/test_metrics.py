@@ -1,0 +1,61 @@
+"""Metrics kernel + runtime wiring."""
+from risingwave_tpu.utils.metrics import MetricsRegistry
+
+
+def test_counter_gauge_histogram_exposition():
+    reg = MetricsRegistry()
+    c = reg.counter("rows_total", "rows", labels=("executor",))
+    c.labels("HashAgg").inc(5)
+    c.labels("Filter").inc()
+    g = reg.gauge("mem_bytes", "memory")
+    g.set(1024)
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.expose()
+    assert 'rows_total{executor="HashAgg"} 5' in text
+    assert 'rows_total{executor="Filter"} 1' in text
+    assert "mem_bytes 1024" in text
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+    assert h.labels().quantile(0.5) == 1.0
+
+
+def test_registry_dedup():
+    reg = MetricsRegistry()
+    a = reg.counter("x", "one")
+    b = reg.counter("x", "two")
+    assert a is b
+
+
+def test_database_emits_metrics():
+    from risingwave_tpu.sql import Database
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT)")
+    db.run("INSERT INTO t VALUES (1)")
+    text = db.metrics()
+    assert "barrier_count" in text and "committed_epoch" in text
+    assert "barrier_latency_seconds_count" in text
+
+
+def test_barrier_trace_breadcrumbs():
+    """Barriers accumulate the executor path they traversed
+    (TracingContext-in-barrier analog)."""
+    from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
+    from risingwave_tpu.connectors import ListReader
+    from risingwave_tpu.expr import AggCall
+    from risingwave_tpu.ops import (BarrierInjector, HashAggExecutor,
+                                    SourceExecutor)
+    from risingwave_tpu.ops.message import Barrier
+    S = Schema.of(("k", T.INT64))
+    inj = BarrierInjector()
+    src = SourceExecutor(S, ListReader([]), inj)
+    agg = HashAggExecutor(src, [0], [AggCall("count")])
+    it = agg.execute()
+    inj.inject()
+    inj.inject_stop()
+    barriers = [m for m in it if isinstance(m, Barrier)]
+    assert barriers and "HashAgg" in barriers[0].trace
