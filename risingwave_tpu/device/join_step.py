@@ -1,0 +1,242 @@
+"""Jitted streaming hash-join epoch step (inner equi-join).
+
+Device analog of `HashJoinExecutor`'s eq-join hot loop
+(`src/stream/src/executor/hash_join.rs:575-686`), re-shaped for XLA: each
+side's state is a SORTED MULTIMAP — rows ordered by (join_key, pk) in
+fixed-capacity HBM arrays — so a probe is a `searchsorted` range lookup and
+the per-epoch maintenance is the same sort-merge pattern as the agg state
+(sorted_state.py). The incremental-join algebra per epoch:
+
+    out  =  dA >< B_old   +   A_new >< dB          (A_new = A_old + dA)
+
+Ragged match output becomes static-shape via a cumsum expansion: pair t maps
+back to its probe row by searchsorted over the running match-count offsets.
+Inner joins only — outer/semi/anti need degree bookkeeping and stay on the
+exact host path (join.py), the same split the reference draws between its
+fast append-only executors and the general ones.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sorted_state import EMPTY_KEY
+
+
+class JoinSide(NamedTuple):
+    """Sorted-by-(jk, pk) multimap; empty slots hold EMPTY_KEY twice."""
+    jk: jax.Array                   # int64 (C,) join key
+    pk: jax.Array                   # int64 (C,) row identity (stream key)
+    count: jax.Array                # int32 scalar
+    vals: Tuple[jax.Array, ...]     # payload columns (C,)
+
+
+def make_side(capacity: int, val_dtypes: Sequence) -> JoinSide:
+    return JoinSide(
+        jnp.full((capacity,), EMPTY_KEY, dtype=jnp.int64),
+        jnp.full((capacity,), EMPTY_KEY, dtype=jnp.int64),
+        jnp.zeros((), jnp.int32),
+        tuple(jnp.zeros((capacity,), dtype=d) for d in val_dtypes))
+
+
+def grow_side(side: JoinSide, new_capacity: int) -> JoinSide:
+    pad = new_capacity - side.jk.shape[0]
+    assert pad >= 0
+    return JoinSide(
+        jnp.concatenate([side.jk, jnp.full((pad,), EMPTY_KEY, jnp.int64)]),
+        jnp.concatenate([side.pk, jnp.full((pad,), EMPTY_KEY, jnp.int64)]),
+        side.count,
+        tuple(jnp.concatenate([v, jnp.zeros((pad,), v.dtype)])
+              for v in side.vals))
+
+
+def batch_reduce_rows(jk, pk, signs, mask, vals):
+    """Unique (jk, pk) deltas: net sign (sum), payload (last write wins).
+    Rows whose net sign is 0 are dropped at merge. Output is (jk,pk)-sorted
+    with EMPTY padding."""
+    b = jk.shape[0]
+    jk = jnp.where(mask, jk, EMPTY_KEY)
+    pk = jnp.where(mask, pk, EMPTY_KEY)
+    order = jnp.lexsort((pk, jk))
+    jk, pk = jk[order], pk[order]
+    signs = jnp.where(mask, signs, 0)[order]
+    vals = [v[order] for v in vals]
+    same = jnp.concatenate([jnp.zeros((1,), bool),
+                            (jk[1:] == jk[:-1]) & (pk[1:] == pk[:-1])])
+    seg = jnp.cumsum(~same) - 1
+    usign = jax.ops.segment_sum(signs.astype(jnp.int32), seg, num_segments=b)
+    ujk = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(jk)
+    upk = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(pk)
+    # last write per segment
+    arrival = jnp.where(jk != EMPTY_KEY, jnp.arange(b), -1)
+    last = jax.ops.segment_max(arrival, seg, num_segments=b)
+    uvals = tuple(v[jnp.clip(last, 0)] for v in vals)
+    live = ujk != EMPTY_KEY
+    usign = jnp.where(live, usign, 0)
+    return ujk, upk, usign, uvals
+
+
+def merge_side(side: JoinSide, djk, dpk, dsign, dvals
+               ) -> Tuple[JoinSide, jax.Array]:
+    """Apply unique (jk,pk) deltas: +1 insert/upsert, -1 delete, 0 no-op."""
+    c = side.jk.shape[0]
+    jk = jnp.concatenate([side.jk, jnp.where(dsign == 0, EMPTY_KEY, djk)])
+    pk = jnp.concatenate([side.pk, jnp.where(dsign == 0, EMPTY_KEY, dpk)])
+    pres = jnp.concatenate([
+        (side.jk != EMPTY_KEY).astype(jnp.int32), dsign])
+    vals = [jnp.concatenate([sv, dv.astype(sv.dtype)])
+            for sv, dv in zip(side.vals, dvals)]
+    is_delta = jnp.concatenate([jnp.zeros((c,), bool),
+                                jnp.ones((djk.shape[0],), bool)])
+    order = jnp.lexsort((is_delta, pk, jk))   # state before delta in ties
+    jk, pk, pres, is_delta = jk[order], pk[order], pres[order], is_delta[order]
+    vals = [v[order] for v in vals]
+    same_next = jnp.concatenate(
+        [(jk[:-1] == jk[1:]) & (pk[:-1] == pk[1:]), jnp.zeros((1,), bool)])
+    same_prev = jnp.concatenate(
+        [jnp.zeros((1,), bool), (jk[1:] == jk[:-1]) & (pk[1:] == pk[:-1])])
+    nxt = lambda a: jnp.concatenate([a[1:], a[-1:]])
+    pres_m = jnp.where(same_next, jnp.clip(pres + nxt(pres), 0, 1), pres)
+    vals_m = [jnp.where(same_next & (nxt(pres) > 0), nxt(v), v)
+              for v in vals]   # upsert takes the delta payload
+    alive = ~same_prev & (jk != EMPTY_KEY) & (pres_m > 0)
+    dest = jnp.cumsum(alive) - 1
+    needed = jnp.sum(alive).astype(jnp.int32)
+    idx = jnp.where(alive, dest, jk.shape[0])
+    out_jk = jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(jk, mode="drop")
+    out_pk = jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(pk, mode="drop")
+    out_vals = tuple(jnp.zeros((c,), v.dtype).at[idx].set(v, mode="drop")
+                     for v in vals_m)
+    return JoinSide(out_jk, out_pk, jnp.minimum(needed, c), out_vals), needed
+
+
+def probe(side: JoinSide, qjk, qmask, m: int):
+    """All matches of each probe key: (probe_row[m], state_idx[m], mask[m],
+    needed_pairs). Ragged -> static via cumsum + searchsorted expansion."""
+    qjk = jnp.where(qmask, qjk, EMPTY_KEY)
+    lo = jnp.searchsorted(side.jk, qjk, side="left")
+    hi = jnp.searchsorted(side.jk, qjk, side="right")
+    cnt = jnp.where(qmask & (qjk != EMPTY_KEY), hi - lo, 0)
+    off = jnp.cumsum(cnt)
+    total = off[-1]
+    t = jnp.arange(m)
+    row = jnp.searchsorted(off, t, side="right")
+    row_c = jnp.clip(row, 0, qjk.shape[0] - 1)
+    prev = jnp.where(row_c > 0, off[row_c - 1], 0)
+    sidx = lo[row_c] + (t - prev)
+    mask = t < total
+    return row_c, jnp.clip(sidx, 0, side.jk.shape[0] - 1), mask, total
+
+
+@partial(jax.jit, static_argnames=("m",))
+def join_epoch_step(a: JoinSide, b: JoinSide,
+                    a_jk, a_pk, a_sign, a_mask, a_vals,
+                    b_jk, b_pk, b_sign, b_mask, b_vals, m: int):
+    """One epoch of both sides' rows -> (new states, pair change set).
+
+    Pair change set: for each emitted pair, sign = producing delta's sign
+    (+1 insert pair, -1 retract pair); payloads gathered from both sides.
+    """
+    dajk, dapk, dasign, davals = batch_reduce_rows(a_jk, a_pk, a_sign,
+                                                   a_mask, a_vals)
+    dbjk, dbpk, dbsign, dbvals = batch_reduce_rows(b_jk, b_pk, b_sign,
+                                                   b_mask, b_vals)
+    # dA >< B_old
+    r1, s1, m1, need1 = probe(b, dajk, dasign != 0, m)
+    out1 = {
+        "sign": jnp.where(m1, dasign[r1], 0),
+        "jk": dajk[r1],
+        "a_vals": tuple(v[r1] for v in davals),
+        "b_vals": tuple(v[s1] for v in b.vals),
+        "mask": m1,
+    }
+    new_a, needed_a = merge_side(a, dajk, dapk, dasign, davals)
+    new_b, needed_b = merge_side(b, dbjk, dbpk, dbsign, dbvals)
+    # A_new >< dB
+    r2, s2, m2, need2 = probe(new_a, dbjk, dbsign != 0, m)
+    out2 = {
+        "sign": jnp.where(m2, dbsign[r2], 0),
+        "jk": dbjk[r2],
+        "a_vals": tuple(v[s2] for v in new_a.vals),
+        "b_vals": tuple(v[r2] for v in dbvals),
+        "mask": m2,
+    }
+    needed = {"a": needed_a, "b": needed_b,
+              "pairs": jnp.maximum(need1, need2)}
+    return new_a, new_b, out1, out2, needed
+
+
+class DeviceHashJoin:
+    """Host wrapper: epoch buffering + state/pair-capacity growth."""
+
+    def __init__(self, a_dtypes: Sequence, b_dtypes: Sequence,
+                 capacity: int = 1024, pair_capacity: int = 4096):
+        self.a = make_side(capacity, a_dtypes)
+        self.b = make_side(capacity, b_dtypes)
+        self.m = pair_capacity
+        self._buf = {"a": [], "b": []}
+
+    def push_rows(self, side: str, jk, pk, signs, vals) -> None:
+        self._buf[side].append((np.asarray(jk, np.int64),
+                                np.asarray(pk, np.int64),
+                                np.asarray(signs, np.int32),
+                                [np.asarray(v) for v in vals]))
+
+    @staticmethod
+    def _concat(buf, nvals):
+        if not buf:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.int32), [np.zeros(0, np.int64)] * nvals)
+        jk = np.concatenate([x[0] for x in buf])
+        pk = np.concatenate([x[1] for x in buf])
+        sg = np.concatenate([x[2] for x in buf])
+        vals = [np.concatenate([x[3][i] for x in buf])
+                for i in range(nvals)]
+        return jk, pk, sg, vals
+
+    def flush_epoch(self):
+        from .agg_step import _acc_cast, _bucket
+        na, nb = len(self.a.vals), len(self.b.vals)
+        ajk, apk, asg, avals = self._concat(self._buf["a"], na)
+        bjk, bpk, bsg, bvals = self._concat(self._buf["b"], nb)
+        self._buf = {"a": [], "b": []}
+
+        def pad(arrs, bsz):
+            jk, pk, sg, vals = arrs
+            p = bsz - len(jk)
+            return (jnp.asarray(np.pad(jk, (0, p))),
+                    jnp.asarray(np.pad(pk, (0, p))),
+                    jnp.asarray(np.pad(sg, (0, p))),
+                    jnp.asarray(np.concatenate(
+                        [np.ones(len(jk), bool), np.zeros(p, bool)])),
+                    tuple(jnp.asarray(np.pad(_acc_cast(v), (0, p)))
+                          for v in vals))
+        bsz = _bucket(max(len(ajk), len(bjk), 1), lo=64)
+        A = pad((ajk, apk, asg, avals), bsz)
+        B = pad((bjk, bpk, bsg, bvals), bsz)
+        while True:
+            new_a, new_b, o1, o2, needed = join_epoch_step(
+                self.a, self.b, *A, *B, m=self.m)
+            na_, nb_, np_ = (int(needed["a"]), int(needed["b"]),
+                             int(needed["pairs"]))
+            if np_ > self.m:
+                self.m = _bucket(np_, lo=self.m * 2)
+                continue
+            grown = False
+            if na_ > self.a.jk.shape[0]:
+                self.a = grow_side(self.a, _bucket(na_,
+                                                   lo=self.a.jk.shape[0] * 2))
+                grown = True
+            if nb_ > self.b.jk.shape[0]:
+                self.b = grow_side(self.b, _bucket(nb_,
+                                                   lo=self.b.jk.shape[0] * 2))
+                grown = True
+            if grown:
+                continue
+            self.a, self.b = new_a, new_b
+            return (jax.tree_util.tree_map(np.asarray, o1),
+                    jax.tree_util.tree_map(np.asarray, o2))
