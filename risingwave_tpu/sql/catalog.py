@@ -23,6 +23,7 @@ class CatalogObject:
     with_options: Dict[str, str] = field(default_factory=dict)
     watermark_col: Optional[int] = None
     watermark_delay_usecs: int = 0
+    n_visible: Optional[int] = None   # hidden stream-key cols sit past this
     # runtime attachments (set by Database)
     runtime: Any = None
 

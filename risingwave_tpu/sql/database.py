@@ -233,7 +233,7 @@ class Database:
                     [(Op.INSERT, r) for r in snapshot_rows])
         port = rt["shared"].subscribe()
         self._pending_subs.append((rt["shared"], port))
-        return _Backfill(snap, port), obj.schema
+        return _Backfill(snap, port), obj.schema, obj.pk
 
     def _make_state(self, dtypes, pk):
         return StateTable(self.store, self.catalog.alloc_table_id(),
@@ -244,16 +244,16 @@ class Database:
         self._pending_subs = []
         execu, ns = planner.plan_select(stmt.query)
         schema = ns.schema()
-        # MV pk: group keys if aggregated else append full row + row id.
-        # The planner's output schema is final; pk = all columns is always
-        # correct for OVERWRITE upsert (the reference derives a stream key;
-        # full-row keying is the degenerate-but-sound version).
-        pk = list(range(len(schema)))
+        # MV pk = the derived stream key (hidden columns appended by the
+        # planner when the select list drops them) — preserves duplicate-row
+        # multiplicity exactly like the reference's StreamMaterialize pk
+        pk = list(ns.stream_key)
         tid = self.catalog.alloc_table_id()
         mv_table = StateTable(self.store, tid, schema.dtypes, pk)
         mat = MaterializeExecutor(execu, mv_table, ConflictBehavior.OVERWRITE)
         shared = SharedStream(mat)
         obj = CatalogObject(stmt.name, "mv", schema, pk, tid)
+        obj.n_visible = ns.n_visible
         obj.runtime = {"state_table": mv_table, "shared": shared,
                        "port": shared.subscribe(), "reader": None,
                        "upstream_subs": self._pending_subs}
@@ -265,7 +265,7 @@ class Database:
     def _create_sink(self, stmt: A.CreateSink) -> str:
         self._pending_subs = []
         if stmt.from_name is not None:
-            execu, schema = self._subscribe(stmt.from_name)
+            execu, schema, _pk = self._subscribe(stmt.from_name)
         else:
             execu, ns = Planner(self._subscribe,
                                 make_state=self._make_state
@@ -353,7 +353,46 @@ class Database:
         return f"DELETE_{n}"
 
     def _update(self, stmt: A.Update) -> str:
-        raise NotImplementedError("UPDATE lands with the DML channel rework")
+        """UPDATE = U-/U+ pairs through the source (row ids preserved, so
+        downstream retraction works like the reference's DML update path)."""
+        obj = self.catalog.get(stmt.table)
+        reader: ListReader = obj.runtime["reader"]
+        assert reader is not None, f"{stmt.table} is not DML-writable"
+        rows = list(obj.runtime["state_table"].iter_all())
+        if not rows:
+            return "UPDATE_0"
+        ns = Namespace.of_schema(obj.schema, stmt.table)
+        b = Binder(ns)
+        scan = StreamChunk.from_rows(obj.schema.dtypes,
+                                     [(Op.INSERT, r) for r in rows])
+        if stmt.where is not None:
+            col = b.bind(stmt.where).eval(scan)
+            keep = [bool(v) and bool(ok)
+                    for v, ok in zip(col.values, col.validity)]
+        else:
+            keep = [True] * len(rows)
+        assigns = [(obj.schema.index_of(c), b.bind(e))
+                   for c, e in stmt.assignments]
+        new_cols = {i: e.eval(scan) for i, e in assigns}
+        pairs = []
+        n = 0
+        for ri, row in enumerate(rows):
+            if not keep[ri]:
+                continue
+            new_row = list(row)
+            for i, _ in assigns:
+                c = new_cols[i]
+                new_row[i] = c.get(ri)
+            if tuple(new_row) == row:
+                continue
+            pairs += [(Op.UPDATE_DELETE, row),
+                      (Op.UPDATE_INSERT, tuple(new_row))]
+            n += 1
+        if not pairs:
+            return "UPDATE_0"
+        reader.push(StreamChunk.from_rows(obj.schema.dtypes, pairs))
+        self.flush()
+        return f"UPDATE_{n}"
 
     # ------------------------------------------------------------------
     # barrier loop (GlobalBarrierWorker tick)
@@ -401,7 +440,7 @@ class Database:
         self.flush(1)
         inj = BarrierInjector()
 
-        def subscribe(name: str) -> Tuple[Executor, Schema]:
+        def subscribe(name: str):
             obj = self.catalog.get(name)
             rows = list(obj.runtime["state_table"].iter_all())
             chunks = []
@@ -410,7 +449,7 @@ class Database:
                     obj.schema.dtypes, [(Op.INSERT, r) for r in rows]))
             src = SourceExecutor(obj.schema, ListReader(chunks), inj,
                                  name=f"Scan({name})")
-            return src, obj.schema
+            return src, obj.schema, obj.pk
 
         # plan without limit/order; ORDER BY columns ride along as hidden
         # trailing items (PG allows ordering by non-output expressions)
@@ -419,7 +458,9 @@ class Database:
         plan_q = A.Select(items, q.from_, q.where, q.group_by, q.having,
                          [], None, None, q.distinct)
         execu, ns = Planner(subscribe).plan_select(plan_q)
-        n_vis = len(ns.cols) - len(q.order_by)  # stars are expanded by now
+        # visible = user items (stars expanded) — minus hidden ORDER BY
+        # helpers and planner-appended stream-key columns
+        n_vis = (ns.n_visible or len(ns.cols)) - len(q.order_by)
         state: Dict[Tuple, int] = {}
         it = execu.execute()
         inj.inject()

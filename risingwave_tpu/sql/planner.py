@@ -62,6 +62,12 @@ class ColumnEntry:
 @dataclass
 class Namespace:
     cols: List[ColumnEntry]
+    # indices forming the stream key: the minimal column set that makes rows
+    # unique in the change stream (StreamMaterialize pk derivation analog,
+    # `src/frontend/src/optimizer/plan_node/stream_materialize.rs`). The MV
+    # pk must cover it or duplicate rows collapse.
+    stream_key: List[int] = field(default_factory=list)
+    n_visible: Optional[int] = None    # hidden stream-key cols sit past this
 
     def resolve(self, name: str, table: Optional[str] = None) -> int:
         hits = [i for i, c in enumerate(self.cols)
@@ -77,12 +83,17 @@ class Namespace:
         return Schema([Field(c.name, c.dtype) for c in self.cols])
 
     @staticmethod
-    def of_schema(schema: Schema, table: Optional[str]) -> "Namespace":
+    def of_schema(schema: Schema, table: Optional[str],
+                  stream_key: Optional[Sequence[int]] = None) -> "Namespace":
         return Namespace([ColumnEntry(table, f.name, f.dtype)
-                          for f in schema.fields])
+                          for f in schema.fields],
+                         list(stream_key or []))
 
     def concat(self, other: "Namespace") -> "Namespace":
-        return Namespace(self.cols + other.cols)
+        off = len(self.cols)
+        return Namespace(self.cols + other.cols,
+                         self.stream_key + [i + off
+                                            for i in other.stream_key])
 
 
 # ---------------------------------------------------------------------------
@@ -247,13 +258,15 @@ class Planner:
     # ---- FROM -----------------------------------------------------------
     def _plan_table(self, ref: A.TableRef) -> Tuple[Executor, Namespace]:
         if isinstance(ref, A.NamedTable):
-            execu, schema = self.subscribe(ref.name)
-            return execu, Namespace.of_schema(schema, ref.alias or ref.name)
+            execu, schema, pk = self.subscribe(ref.name)
+            return execu, Namespace.of_schema(schema, ref.alias or ref.name,
+                                              pk)
         if isinstance(ref, A.SubqueryTable):
             execu, ns = self.plan_select(ref.query)
             alias = ref.alias
             return execu, Namespace(
-                [ColumnEntry(alias, c.name, c.dtype) for c in ns.cols])
+                [ColumnEntry(alias, c.name, c.dtype) for c in ns.cols],
+                list(ns.stream_key))
         if isinstance(ref, A.WindowTable):
             execu, ns = self._plan_table(ref.inner)
             ti = ns.resolve(ref.time_col)
@@ -272,7 +285,9 @@ class Planner:
                     for c in ns.cols]
             cols += [ColumnEntry(alias, "window_start", T.TIMESTAMP),
                      ColumnEntry(alias, "window_end", T.TIMESTAMP)]
-            return execu, Namespace(cols)
+            # each input row appears once per window: key = input key + win
+            sk = list(ns.stream_key) + [len(cols) - 2]
+            return execu, Namespace(cols, sk)
         if isinstance(ref, A.Join):
             return self._plan_join(ref)
         raise ValueError(f"cannot plan table ref {ref!r}")
@@ -324,11 +339,14 @@ class Planner:
         if q.where is not None:
             execu = FilterExecutor(execu, Binder(ns).bind(q.where))
 
-        # expand stars
+        # expand stars (hidden system/stream-key columns stay hidden,
+        # like PG's ctid)
         items: List[A.SelectItem] = []
         for it in q.items:
             if isinstance(it.expr, A.Star):
                 for i, c in enumerate(ns.cols):
+                    if c.name.startswith("_"):
+                        continue
                     if it.expr.table is None or c.table == it.expr.table:
                         items.append(A.SelectItem(A.Col(c.name, c.table),
                                                   c.name))
@@ -349,13 +367,30 @@ class Planner:
                for i in items):
             execu, ns, items = self._plan_over_window(execu, ns, items)
 
-        # final projection
+        # final projection; upstream stream-key columns ride along hidden
+        # unless already selected, so the MV pk can preserve multiplicity
+        # (StreamMaterialize pk derivation analog)
         b = Binder(ns)
         exprs = [b.bind(i.expr) for i in items]
         names = [i.alias or _default_name(i.expr) for i in items]
+        n_visible = len(items)
+        out_sk: List[int] = []
+        if q.distinct:
+            out_sk = list(range(n_visible))   # output is set-like
+        else:
+            for ki, sk_idx in enumerate(ns.stream_key):
+                pos = next((j for j, e in enumerate(exprs)
+                            if isinstance(e, InputRef) and e.index == sk_idx),
+                           None)
+                if pos is None:
+                    pos = len(exprs)
+                    exprs.append(InputRef(sk_idx, ns.cols[sk_idx].dtype))
+                    names.append(f"_sk{ki}")
+                out_sk.append(pos)
         execu = ProjectExecutor(execu, exprs, names)
         ns = Namespace([ColumnEntry(None, n, e.return_type)
-                        for n, e in zip(names, exprs)])
+                        for n, e in zip(names, exprs)],
+                       out_sk, n_visible)
 
         if q.distinct:
             st = self.make_state([c.dtype for c in ns.cols] + [T.BYTEA],
@@ -429,7 +464,9 @@ class Planner:
                                          group_exprs[i].return_type))
         for i, (a, c) in enumerate(zip(aggs, calls)):
             post_cols.append(ColumnEntry(None, f"agg#{i}", c.return_type))
-        post_ns = Namespace(post_cols)
+        # the group key IS the stream key after aggregation (empty for the
+        # single-row SimpleAgg output)
+        post_ns = Namespace(post_cols, list(range(len(group_exprs))))
 
         # rewrite items/having: replace agg calls with agg#i refs, group
         # exprs with their post-agg columns
@@ -482,7 +519,7 @@ class Planner:
                 wi += 1
             else:
                 new_items.append(it)
-        return execu, Namespace(cols), new_items
+        return execu, Namespace(cols, list(ns.stream_key)), new_items
 
 
 # ---------------------------------------------------------------------------

@@ -216,3 +216,28 @@ class TestDurability:
         del db
         db2 = Database(data_dir=d)
         assert db2.run("SHOW TABLES")[0] == ["t"]
+
+
+class TestUpdate:
+    def test_update_propagates_to_mv(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("CREATE MATERIALIZED VIEW m AS "
+               "SELECT k, sum(v) AS s FROM t GROUP BY k")
+        db.run("INSERT INTO t VALUES (1, 10), (1, 20), (2, 5)")
+        db.run("UPDATE t SET v = v + 100 WHERE k = 1")
+        assert sorted(db.query("SELECT k, v FROM t")) == \
+            [(1, 110), (1, 120), (2, 5)]
+        from decimal import Decimal
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1, Decimal(230)), (2, Decimal(5))]
+
+    def test_update_pk_table(self, db):
+        db.run("CREATE TABLE t (k BIGINT PRIMARY KEY, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10), (2, 20)")
+        db.run("UPDATE t SET v = 99 WHERE k = 2")
+        assert sorted(db.query("SELECT * FROM t")) == [(1, 10), (2, 99)]
+
+    def test_update_no_match(self, db):
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("INSERT INTO t VALUES (1, 10)")
+        assert db.run("UPDATE t SET v = 5 WHERE k = 42")[0] == "UPDATE_0"
