@@ -123,7 +123,18 @@ def column_hash64(col: Column) -> np.ndarray:
             h[i] = acc
     # null → fixed sentinel hash
     null_h = np.uint64(0x9E3779B97F4A7C15)
-    return np.where(col.validity, h, null_h)
+    return _avoid_device_sentinel(np.where(col.validity, h, null_h))
+
+
+# int64 max is the device state's EMPTY_KEY padding sentinel
+# (device/sorted_state.py): a hash landing there would be silently treated
+# as padding (masked from reduce, dropped by merge, filtered from the
+# all-to-all receive mask). Every host->device key projection remaps it.
+_DEVICE_EMPTY = np.uint64(0x7FFFFFFFFFFFFFFF)
+
+
+def _avoid_device_sentinel(h: np.ndarray) -> np.ndarray:
+    return np.where(h == _DEVICE_EMPTY, _DEVICE_EMPTY - np.uint64(1), h)
 
 
 def hash_columns64(cols: Sequence[Column]) -> np.ndarray:
@@ -135,7 +146,7 @@ def hash_columns64(cols: Sequence[Column]) -> np.ndarray:
             h2 = column_hash64(c)
             h = h ^ (h2 + np.uint64(0x9E3779B97F4A7C15)
                      + (h << np.uint64(6)) + (h >> np.uint64(2)))
-    return h
+    return _avoid_device_sentinel(h)
 
 
 def compute_vnodes(key_cols: Sequence[Column], n: Optional[int] = None,

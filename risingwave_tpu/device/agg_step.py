@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .sorted_state import (EMPTY_KEY, ReduceKind, SortedState, batch_reduce,
-                           grow_state, lookup, make_state, merge)
+                           grow_state, lookup, make_state, merge,
+                           sanitize_keys)
 
 # Aggregate kinds the device step supports.
 DEVICE_AGG_KINDS = ("count", "count_star", "sum", "avg", "min", "max")
@@ -204,7 +205,7 @@ class DeviceHashAgg:
             raise ValueError(
                 "retraction through an append-only (min/max) device agg — "
                 "use the exact host path (aggregate/minput.rs analog)")
-        self._keys.append(keys.astype(np.int64))
+        self._keys.append(sanitize_keys(keys))
         self._signs.append(signs.astype(np.int32))
         self._inputs.append([(np.asarray(v), np.asarray(m)) for v, m in inputs])
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sorted_state import EMPTY_KEY
+from .sorted_state import EMPTY_KEY, sanitize_keys
 
 
 class JoinSide(NamedTuple):
@@ -181,8 +181,8 @@ class DeviceHashJoin:
         self._buf = {"a": [], "b": []}
 
     def push_rows(self, side: str, jk, pk, signs, vals) -> None:
-        self._buf[side].append((np.asarray(jk, np.int64),
-                                np.asarray(pk, np.int64),
+        self._buf[side].append((sanitize_keys(np.asarray(jk, np.int64)),
+                                sanitize_keys(np.asarray(pk, np.int64)),
                                 np.asarray(signs, np.int32),
                                 [np.asarray(v) for v in vals]))
 

@@ -24,7 +24,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# RESERVED KEY (device API boundary): int64 max marks padding slots. Host
+# key projections must never emit it — core/vnode.py remaps hash64 outputs,
+# and `sanitize_keys` below remaps raw int64 keys at the device wrappers'
+# push boundary. A key equal to EMPTY_KEY would be masked from batch_reduce,
+# dropped by merge, and filtered from the all-to-all receive mask.
 EMPTY_KEY = np.int64(np.iinfo(np.int64).max)
+
+
+def sanitize_keys(keys: np.ndarray) -> np.ndarray:
+    """Remap a legitimate key equal to the EMPTY_KEY sentinel to
+    EMPTY_KEY-1 (merging those two key values is the accepted, documented
+    collision — vanishingly rarer than the hash64 collision class)."""
+    keys = np.asarray(keys, dtype=np.int64)
+    return np.where(keys == EMPTY_KEY, EMPTY_KEY - 1, keys)
 
 
 class ReduceKind(enum.IntEnum):

@@ -138,21 +138,43 @@ class RowIdGenExecutor(UnaryExecutor):
     """Fill a serial row-id column (`row_id_gen.rs`): ids embed the vnode so
     generation is conflict-free across parallel shards."""
 
+    # Bit budget (63 bits total, like the reference's row-id layout
+    # timestamp|vnode|sequence): 41-bit millis since _ID_EPOCH | 10-bit
+    # shard | 12-bit per-ms sequence. Millis are anchored to a custom epoch
+    # (like the reference's row-id generator) so the 41-bit field lasts
+    # ~69 years from 2024 instead of overflowing into the sign bit in 2039.
+    # Restart-disjointness holds because a restarted process re-reads the
+    # clock; minting >4096 ids/ms advances the logical millis ahead of wall
+    # clock (same caveat as the reference).
+    _SEQ_BITS = 12
+    _SHARD_BITS = 10
+    _ID_EPOCH_MS = 1_704_067_200_000   # 2024-01-01T00:00:00Z
+
     def __init__(self, input: Executor, row_id_index: int, shard: int = 0):
         super().__init__(input, input.schema)
         self.row_id_index = row_id_index
-        # ids embed wall-clock millis in the high bits (the reference's
-        # row-id layout: timestamp | vnode | sequence) so a restarted
-        # process mints ids disjoint from any persisted pre-crash rows
-        import time
-        self._next = int(time.time() * 1000) << 12
+        # logical counter = millis * 2^12 + seq; monotonic, clock-anchored
+        self._counter = self._now_ms() << self._SEQ_BITS
+        if not 0 <= shard < (1 << self._SHARD_BITS):
+            raise ValueError(f"shard {shard} exceeds {self._SHARD_BITS} bits")
         self.shard = shard
+
+    @classmethod
+    def _now_ms(cls) -> int:
+        import time
+        return int(time.time() * 1000) - cls._ID_EPOCH_MS
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
         chunk = chunk.compact()
         n = chunk.capacity
-        ids = (np.arange(self._next, self._next + n, dtype=np.int64) << 16) | self.shard
-        self._next += n
+        # re-anchor to the wall clock whenever it has moved past the counter
+        self._counter = max(self._counter,
+                            self._now_ms() << self._SEQ_BITS)
+        counters = np.arange(self._counter, self._counter + n, dtype=np.int64)
+        ms, seq = counters >> self._SEQ_BITS, counters & ((1 << self._SEQ_BITS) - 1)
+        ids = ((ms << (self._SHARD_BITS + self._SEQ_BITS))
+               | (self.shard << self._SEQ_BITS) | seq)
+        self._counter += n
         cols = list(chunk.columns)
         if self.row_id_index >= len(cols):
             # connector chunks don't carry the row-id column; append it

@@ -53,8 +53,12 @@ class WatermarkFilterExecutor(UnaryExecutor):
         # max must not retroactively drop its older sibling rows
         # (watermark_filter.rs evaluates `ts >= watermark` before updating)
         if self.watermark is not None:
-            # late rows (ts < watermark) are filtered; NULL ts passes through
-            late = vis & (col.values < self.watermark)
+            # the reference's filter expression is `ts >= watermark`: late
+            # rows AND NULL-ts rows evaluate not-true and are dropped
+            # (NULL would otherwise accumulate as never-closing groups in
+            # downstream EOWC aggs)
+            late = (vis & (col.values < self.watermark)) \
+                | (chunk.vis_mask() & ~col.validity)
             if late.any():
                 chunk = chunk.with_visibility(chunk.vis_mask() & ~late)
                 vis = vis & ~late

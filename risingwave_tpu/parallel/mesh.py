@@ -24,10 +24,26 @@ def make_mesh(n_devices: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh over the shard axis. Multi-host meshes come from passing the
     global device list; the shape is (n,) either way — streaming dataflow
-    parallelism is one-dimensional (vnodes), unlike ML TP x DP grids."""
+    parallelism is one-dimensional (vnodes), unlike ML TP x DP grids.
+
+    When the default platform has fewer devices than requested (one real TPU
+    chip but an 8-shard dry run), fall back to the CPU backend, which serves
+    virtual devices under --xla_force_host_platform_device_count."""
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                try:
+                    cpu = jax.devices("cpu")
+                except RuntimeError:
+                    cpu = []
+                if len(cpu) >= n_devices:
+                    devices = cpu
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices but only {len(devices)} exist "
+                    "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                    "before jax initializes to get virtual CPU devices)")
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
 

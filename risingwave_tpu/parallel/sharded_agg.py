@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.vnode import VNODE_COUNT, compute_vnodes_jnp
 from ..device.agg_step import DeviceAggSpec, _acc_cast, _bucket, epoch_core
-from ..device.sorted_state import EMPTY_KEY, SortedState
+from ..device.sorted_state import EMPTY_KEY, SortedState, sanitize_keys
 from .mesh import SHARD_AXIS, shard_of_vnode
 
 
@@ -164,7 +164,7 @@ class ShardedHashAgg:
             raise ValueError(
                 "retraction through an append-only (min/max) device agg — "
                 "use the exact host path (aggregate/minput.rs analog)")
-        self._rows.append((keys.astype(np.int64), signs.astype(np.int32),
+        self._rows.append((sanitize_keys(keys), signs.astype(np.int32),
                            [(np.asarray(v), np.asarray(m)) for v, m in inputs]))
 
     def _grow(self, capacity: int) -> None:

@@ -178,7 +178,7 @@ class Database:
                                        name=f"Source({stmt.name})")
         if not has_pk:
             src = RowIdGenExecutor(src, row_id_index=len(fields) - 1,
-                                   shard=tid & 0xFFFF)
+                                   shard=tid & 0x3FF)
         if stmt.watermark is not None:
             col, delay_expr = stmt.watermark
             ns = Namespace.of_schema(schema, stmt.name)

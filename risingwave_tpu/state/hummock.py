@@ -43,23 +43,31 @@ class SpillStateStore(MemoryStateStore):
         super().__init__()
         self.dir = directory
         os.makedirs(os.path.join(directory, "runs"), exist_ok=True)
-        self._deltas: Dict[int, Dict[bytes, Optional[Tuple]]] = {}
+        # keyed by (epoch, table) so committing epoch N persists exactly the
+        # deltas ingested for epochs <= N — data already ingested for N+1
+        # must NOT become durable under N's checkpoint ('uncommitted epochs
+        # vanish' recovery contract)
+        self._deltas: Dict[Tuple[int, int],
+                           Dict[bytes, Optional[Tuple]]] = {}
         self._manifest: Dict[str, Any] = {"committed_epoch": 0, "tables": {}}
         self._file_seq = 0
         self._recover()
 
     # ---- write path -----------------------------------------------------
     def ingest_batch(self, table_id, batch, epoch):
-        d = self._deltas.setdefault(table_id, {})
+        d = self._deltas.setdefault((epoch, table_id), {})
         for key, row in batch:
             d[key] = row
         super().ingest_batch(table_id, batch, epoch)
 
     def commit_epoch(self, epoch):
         garbage: List[str] = []
-        for tid, delta in self._deltas.items():
+        ready = sorted(k for k in self._deltas if k[0] <= epoch)
+        for ep_tid in ready:
+            delta = self._deltas.pop(ep_tid)
             if not delta:
                 continue
+            tid = ep_tid[1]
             # the sequence number makes names unique even when two commits
             # share an epoch (e.g. back-to-back DDL) — a same-named run
             # would silently overwrite its predecessor
@@ -70,7 +78,6 @@ class SpillStateStore(MemoryStateStore):
             runs.append(name)
             if len(runs) > COMPACT_THRESHOLD:
                 garbage += self._compact(tid, epoch)
-        self._deltas.clear()
         self._manifest["committed_epoch"] = max(
             self._manifest["committed_epoch"], epoch)
         self._write_manifest()
@@ -107,11 +114,16 @@ class SpillStateStore(MemoryStateStore):
 
     # ---- compaction -----------------------------------------------------
     def _compact(self, table_id: int, epoch: int) -> List[str]:
-        """Merge all runs into one base snapshot; tombstones drop out.
-        Returns the now-unreferenced run files (deleted by the caller AFTER
-        the new manifest is durable)."""
-        t = self._table(table_id)
-        items = [(k, v) for k, v in t.iter_range(None, None)]
+        """Merge all committed runs into one base snapshot; tombstones drop
+        out. Merges from the DURABLE run files — not the live memtable,
+        which may already hold uncommitted future-epoch writes that must not
+        leak into the base. Returns the now-unreferenced run files (deleted
+        by the caller AFTER the new manifest is durable)."""
+        merged: Dict[Any, Optional[Tuple]] = {}
+        for name in self._manifest["tables"][str(table_id)]:
+            for key, row in self._read_run(name):
+                merged[key] = row
+        items = sorted((k, v) for k, v in merged.items() if v is not None)
         self._file_seq += 1
         name = f"t{table_id}_e{epoch}_{self._file_seq}.base"
         self._write_run(name, items)

@@ -7,8 +7,8 @@ table. Three backends, selected like `store_impl.rs:60-76`:
 * `MemoryStateStore` — ordered in-memory tables (tests + hot working set);
 * `SpillStateStore` (state/hummock.py) — LSM-lite: memtable + sorted-run
   files on the local "object store" with checkpoint manifests;
-* device mirrors (device/hash_table.py) — HBM-resident projections of hot
-  operator state, rebuilt from the host store on recovery.
+* device mirrors (device/sorted_state.py) — HBM-resident projections of
+  hot operator state, rebuilt from the host store on recovery.
 
 Keys are raw bytes (vnode prefix + memcomparable pk); values are decoded row
 tuples on the hot path (value-encoding happens only at checkpoint, unlike the

@@ -1,0 +1,118 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+
+from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
+from risingwave_tpu.core.chunk import Column
+from risingwave_tpu.state import SpillStateStore
+
+
+def test_rowid_layout_fits_63_bits_and_monotonic():
+    from risingwave_tpu.ops.simple import RowIdGenExecutor
+    from risingwave_tpu.ops.executor import Executor
+
+    class _Stub(Executor):
+        def __init__(self):
+            super().__init__(Schema.of(("v", T.INT64)))
+
+    gen = RowIdGenExecutor(_Stub(), row_id_index=1, shard=0x3FF)
+    chunk = StreamChunk.from_rows([T.INT64],
+                                  [(Op.INSERT, (i,)) for i in range(5000)])
+    (out,) = list(gen.on_chunk(chunk))
+    ids = out.columns[1].values.astype(np.int64)
+    assert (ids > 0).all(), "row ids must not wrap negative"
+    assert (np.diff(ids) > 0).all(), "row ids must be strictly increasing"
+    # a second chunk continues above the first even after seq overflow
+    (out2,) = list(gen.on_chunk(chunk))
+    assert out2.columns[1].values.astype(np.int64)[0] > ids[-1]
+
+
+def test_watermark_filter_drops_null_ts_once_watermark_set():
+    from risingwave_tpu.ops.watermark import WatermarkFilterExecutor
+    from risingwave_tpu.ops.executor import Executor
+
+    class _Stub(Executor):
+        def __init__(self):
+            super().__init__(Schema.of(("ts", T.INT64)))
+
+    f = WatermarkFilterExecutor(_Stub(), time_col=0, delay=0)
+    c1 = StreamChunk.from_rows([T.INT64], [(Op.INSERT, (100,))])
+    list(f.on_chunk(c1))
+    assert f.watermark == 100
+    c2 = StreamChunk.from_rows([T.INT64],
+                               [(Op.INSERT, (None,)), (Op.INSERT, (150,))])
+    outs = list(f.on_chunk(c2))
+    rows = [r for ch in outs for _, r in ch.op_rows()]
+    assert rows == [(150,)], "NULL event-time rows must be dropped " \
+        "(reference filter `ts >= watermark` is not-true for NULL)"
+
+
+def test_null_ts_passes_before_first_watermark():
+    from risingwave_tpu.ops.watermark import WatermarkFilterExecutor
+    from risingwave_tpu.ops.executor import Executor
+
+    class _Stub(Executor):
+        def __init__(self):
+            super().__init__(Schema.of(("ts", T.INT64)))
+
+    f = WatermarkFilterExecutor(_Stub(), time_col=0, delay=0)
+    c = StreamChunk.from_rows([T.INT64], [(Op.INSERT, (None,))])
+    outs = list(f.on_chunk(c))
+    rows = [r for ch in outs for _, r in ch.op_rows()]
+    assert rows == [(None,)]
+
+
+def test_spill_store_future_epoch_delta_not_committed_early(tmp_path):
+    """Data ingested for epoch N+1 must not become durable when committing
+    epoch N (ADVICE: _deltas keyed by table only broke the 'uncommitted
+    epochs vanish' contract)."""
+    d = str(tmp_path)
+    st = SpillStateStore(d)
+    st.ingest_batch(1, [(b"a", (1,))], epoch=100)
+    st.ingest_batch(1, [(b"b", (2,))], epoch=200)   # next epoch, early
+    st.commit_epoch(100)
+    st2 = SpillStateStore(d)
+    assert st2.get(1, b"a") == (1,)
+    assert st2.get(1, b"b") is None, \
+        "epoch-200 delta leaked into the epoch-100 checkpoint"
+    # ...and it IS durable once its own epoch commits
+    st.commit_epoch(200)
+    st3 = SpillStateStore(d)
+    assert st3.get(1, b"b") == (2,)
+
+
+def test_compaction_does_not_leak_uncommitted_future_epoch(tmp_path):
+    """_compact must merge from durable runs, not the live memtable, or a
+    future epoch's ingested-but-uncommitted rows become durable early."""
+    d = str(tmp_path)
+    st = SpillStateStore(d)
+    st.ingest_batch(1, [(b"future", (99,))], epoch=1000)  # not committed
+    for ep in range(1, 12):   # push past COMPACT_THRESHOLD
+        st.ingest_batch(1, [(f"k{ep}".encode(), (ep,))], epoch=ep)
+        st.commit_epoch(ep)
+    st2 = SpillStateStore(d)  # crash before epoch 1000 commits
+    assert st2.get(1, b"future") is None, \
+        "compaction leaked an uncommitted future-epoch row into the base"
+    assert st2.get(1, b"k5") == (5,)
+
+
+def test_device_agg_key_at_sentinel_not_lost():
+    import jax.numpy as jnp
+    from risingwave_tpu.device.agg_step import DeviceAggSpec, DeviceHashAgg
+    from risingwave_tpu.device.sorted_state import EMPTY_KEY
+    spec = DeviceAggSpec.build(["count_star"], [np.int64])
+    agg = DeviceHashAgg(spec, capacity=16)
+    keys = np.array([np.iinfo(np.int64).max, 5], dtype=np.int64)
+    vals = np.array([1, 1], dtype=np.int64)
+    agg.push_rows(keys, np.ones(2, np.int32), [(vals, np.ones(2, bool))])
+    ch = agg.flush_epoch()
+    assert int(ch["count"]) == 2, "int64-max key must survive (remapped)"
+
+
+def test_hash64_never_hits_device_empty_sentinel():
+    from risingwave_tpu.core.vnode import column_hash64, hash_columns64
+    from risingwave_tpu.device.sorted_state import EMPTY_KEY
+    col = Column.from_list(T.VARCHAR, [f"s{i}" for i in range(1000)] + [None])
+    h = column_hash64(col).view(np.int64)
+    assert not (h == EMPTY_KEY).any()
+    h2 = hash_columns64([col, col]).view(np.int64)
+    assert not (h2 == EMPTY_KEY).any()
