@@ -1,0 +1,47 @@
+"""Runtime configuration.
+
+The start of the reference's 3-tier config system (`src/common/src/
+config.rs:137` node config, `system_param/mod.rs:97` cluster params,
+`session_config/` session vars). The device tier here governs the
+SQL->device dispatch seam: whether eligible plan fragments lower onto the
+TPU executors and over which mesh.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+
+@dataclass
+class DeviceConfig:
+    """Device-path lowering config (the `from_proto` dispatch policy).
+
+    mesh      — jax.sharding.Mesh to shard operator state over; None = one
+                chip (still jitted epoch steps, no collectives).
+    capacity  — initial per-operator state slots (grows by pow2 on demand).
+    minmax    — lower min/max aggregates (requires the retractable
+                candidate-buffer state; off until it lands).
+    """
+    mesh: Optional[Any] = None
+    capacity: int = 1024
+    minmax: bool = False
+
+
+def resolve_device(device) -> Optional[DeviceConfig]:
+    """Normalize the Database(device=...) argument.
+
+    None | "off"      -> host-only execution
+    "on" | "single"   -> device path on one chip
+    int n             -> device path sharded over an n-device mesh
+    DeviceConfig      -> as given
+    """
+    if device is None or device == "off":
+        return None
+    if isinstance(device, DeviceConfig):
+        return device
+    if device in ("on", "single"):
+        return DeviceConfig()
+    if isinstance(device, int):
+        from .parallel import make_mesh
+        return DeviceConfig(mesh=make_mesh(device))
+    raise ValueError(f"bad device config {device!r}")

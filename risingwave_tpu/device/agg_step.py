@@ -162,6 +162,10 @@ def epoch_core(spec: DeviceAggSpec, state: SortedState,
         "old_found": old_found, "new_found": new_found,
         "old_out": tuple(old_out), "old_null": tuple(old_null),
         "new_out": tuple(new_out), "new_null": tuple(new_null),
+        # raw payload columns at the touched keys — the SQL executor derives
+        # outputs host-side from these (exact Decimal semantics for int
+        # sum/avg) and persists them to the state table for recovery
+        "old_vals": tuple(old_vals), "new_vals": tuple(new_vals),
     }
     return new_state, needed, changes
 
@@ -198,6 +202,25 @@ class DeviceHashAgg:
         self._keys: List[np.ndarray] = []
         self._signs: List[np.ndarray] = []
         self._inputs: List[List[Tuple[np.ndarray, np.ndarray]]] = []
+
+    def load_state(self, keys: np.ndarray,
+                   vals: Sequence[np.ndarray]) -> None:
+        """Recovery: install (key, payload...) rows as the current state
+        (rows come from the persisted state table at the committed epoch)."""
+        keys = sanitize_keys(keys)
+        order = np.argsort(keys, kind="stable")
+        n = len(keys)
+        cap = _bucket(max(n, self.state.capacity))
+        st = self.spec.make_state(cap)
+        new_keys = np.asarray(st.keys).copy()
+        new_keys[:n] = keys[order]
+        new_vals = []
+        for v0, v in zip(st.vals, vals):
+            arr = np.asarray(v0).copy()
+            arr[:n] = np.asarray(v)[order]
+            new_vals.append(jnp.asarray(arr))
+        self.state = SortedState(jnp.asarray(new_keys),
+                                 jnp.asarray(np.int32(n)), tuple(new_vals))
 
     def push_rows(self, keys: np.ndarray, signs: np.ndarray,
                   inputs: Sequence[Tuple[np.ndarray, np.ndarray]]) -> None:

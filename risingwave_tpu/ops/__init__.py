@@ -8,6 +8,7 @@ from .exchange import Channel, DispatchExecutor, MergeExecutor
 from .source import BarrierInjector, SourceExecutor, SourceReader
 from .agg import (HashAggExecutor, SimpleAggExecutor,
                   StatelessSimpleAggExecutor)
+from .device_agg import DeviceHashAggExecutor, device_agg_eligible
 from .join import HashJoinExecutor, JoinType
 from .topn import AppendOnlyDedupExecutor, TopNExecutor
 from .watermark import WatermarkFilterExecutor
@@ -20,6 +21,7 @@ __all__ = [
     "FilterExecutor", "ProjectExecutor", "RowIdGenExecutor", "UnionExecutor",
     "ValuesExecutor", "BarrierInjector", "SourceExecutor", "SourceReader",
     "HashAggExecutor", "SimpleAggExecutor", "StatelessSimpleAggExecutor",
+    "DeviceHashAggExecutor", "device_agg_eligible",
     "HashJoinExecutor", "JoinType", "AppendOnlyDedupExecutor", "TopNExecutor",
     "HopWindowExecutor", "OverWindowExecutor", "WindowFuncCall",
     "WatermarkFilterExecutor", "Channel", "DispatchExecutor", "MergeExecutor",

@@ -1,0 +1,291 @@
+"""DeviceHashAggExecutor — the SQL-visible TPU aggregation executor.
+
+This is the dispatch seam the reference wires in `from_proto/mod.rs:151-197`
+(NodeBody::HashAgg -> HashAggExecutor): the planner lowers an eligible
+aggregation fragment onto this executor instead of the per-row host
+`HashAggExecutor`. Protocol-identical from the outside — consumes
+Chunk|Barrier|Watermark, emits barrier-aligned change chunks, commits its
+state table — but the group maintenance runs as ONE jitted XLA program per
+epoch (`device/agg_step.py`; sharded over a mesh via
+`parallel/sharded_agg.py`).
+
+Exactness contract:
+* group keys: lossless bit-packing for narrow keys, hash64 + host decode
+  dictionary with collision DETECTION otherwise (`device/key_codec.py`);
+* outputs are derived host-side from the raw device payload columns, so
+  integer sum/avg keep the exact Decimal semantics of the host path
+  (`expr/agg.py`); float aggregation order differs (segment-reduce vs
+  arrival order) — the same non-associativity the reference accepts across
+  parallel actors;
+* recovery: payload columns persist per dirty key per barrier into the
+  state table (the `minput.rs` partial-state analog, not opaque pickles).
+"""
+from __future__ import annotations
+
+from decimal import Decimal
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import dtypes as T
+from ..core.chunk import Op, StreamChunk, StreamChunkBuilder
+from ..core.dtypes import DataType, TypeKind
+from ..core.schema import Field, Schema
+from ..expr.agg import AggCall
+from ..state.state_table import StateTable
+from .executor import Executor, UnaryExecutor
+from .message import Barrier, Message, Watermark
+
+_SUMMABLE = (TypeKind.INT16, TypeKind.INT32, TypeKind.INT64, TypeKind.SERIAL,
+             TypeKind.FLOAT32, TypeKind.FLOAT64)
+
+
+def _spec_kinds(calls: Sequence[AggCall]) -> List[str]:
+    """Host AggCall kinds -> device spec kinds (count(*) has arg None)."""
+    return ["count_star" if c.kind == "count" and c.arg is None else c.kind
+            for c in calls]
+
+
+def device_agg_eligible(calls: Sequence[AggCall],
+                        include_minmax: bool = False) -> bool:
+    """Can this aggregation fragment run on the device path?
+
+    count/sum/avg are exact under retraction. min/max are gated on
+    `include_minmax` until the retractable candidate-buffer state lands
+    (the `minput.rs` analog); DISTINCT/filtered calls and exotic kinds stay
+    on the exact host path.
+    """
+    for c in calls:
+        if c.distinct or c.filter is not None:
+            return False
+        if c.kind == "count":
+            continue                      # needs only the validity mask
+        if c.kind in ("sum", "avg"):
+            if c.arg is None or c.arg.return_type.kind not in _SUMMABLE:
+                return False
+        elif c.kind in ("min", "max"):
+            if not include_minmax or c.arg is None:
+                return False
+            rt = c.arg.return_type
+            if rt.device_dtype is None or rt.kind == TypeKind.BOOLEAN:
+                return False
+        else:
+            return False
+    return True
+
+
+def device_payload_dtypes(calls: Sequence[AggCall]) -> List[DataType]:
+    """SQL dtypes of the persisted device payload columns (state-table
+    layout; must match DeviceAggSpec.build's column order)."""
+    from ..device.agg_step import DeviceAggSpec
+    spec = DeviceAggSpec.build(_spec_kinds(calls),
+                               [_arg_np_dtype(c) for c in calls])
+    out = []
+    for d in spec.dtypes:
+        out.append(T.FLOAT64 if np.issubdtype(np.dtype(d), np.floating)
+                   else T.INT64)
+    return out
+
+
+def _arg_np_dtype(c: AggCall):
+    if c.arg is None or c.arg.return_type.device_dtype is None:
+        return np.int64
+    dt = np.dtype(c.arg.return_type.device_dtype)
+    return np.float64 if np.issubdtype(dt, np.floating) else np.int64
+
+
+class DeviceHashAggExecutor(UnaryExecutor):
+    """TPU-resident group-by aggregation behind the executor protocol."""
+
+    def __init__(self, input: Executor, group_key_indices: Sequence[int],
+                 calls: Sequence[AggCall],
+                 state_table: Optional[StateTable] = None,
+                 mesh: Optional[Any] = None, capacity: int = 1024):
+        in_schema = input.schema
+        fields = [in_schema.fields[i] for i in group_key_indices]
+        fields += [Field(f"agg#{i}", c.return_type)
+                   for i, c in enumerate(calls)]
+        super().__init__(input, Schema(fields), "DeviceHashAgg")
+        self.group_key_indices = list(group_key_indices)
+        self.calls = list(calls)
+        self.state_table = state_table
+        self._recovered = state_table is None
+        self._key_dtypes = [in_schema.fields[i].dtype
+                            for i in group_key_indices]
+
+        from ..device.agg_step import DeviceAggSpec
+        from ..device.key_codec import make_codec
+        self.spec = DeviceAggSpec.build(_spec_kinds(calls),
+                                        [_arg_np_dtype(c) for c in calls])
+        self.codec = make_codec(self._key_dtypes)
+        # int64 accumulator overflow guard: running bound on the total
+        # absolute magnitude ever pushed into integer sum columns. The host
+        # path accumulates in unbounded Decimal; the device wraps at 2^63.
+        # The bound is conservative (ignores retraction cancellation), so
+        # staying under 2^62 PROVES no wrap occurred; crossing it fails
+        # loudly instead of silently diverging.
+        self._int_sum_bound = 0
+        self._int_sum_calls = [i for i, (c, dc) in
+                               enumerate(zip(calls, self.spec.calls))
+                               if c.kind in ("sum", "avg")
+                               and not np.issubdtype(
+                                   np.dtype(dc.acc_dtype), np.floating)]
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel.sharded_agg import ShardedHashAgg
+            self.engine: Any = ShardedHashAgg(self.spec, mesh,
+                                              capacity=capacity)
+        else:
+            from ..device.agg_step import DeviceHashAgg
+            self.engine = DeviceHashAgg(self.spec, capacity=capacity)
+
+    # ---- recovery -------------------------------------------------------
+    def _recover(self) -> None:
+        if self._recovered:
+            return
+        self._recovered = True
+        rows = list(self.state_table.iter_all())
+        if not rows:
+            return
+        nk = len(self.group_key_indices)
+        key_rows = [r[:nk] for r in rows]
+        keys = self.codec.encode_rows(key_rows)
+        self.codec.observe_rows(keys, key_rows)
+        vals = []
+        for j, d in enumerate(self.spec.dtypes):
+            npd = (np.float64 if np.issubdtype(np.dtype(d), np.floating)
+                   else np.int64)
+            vals.append(np.array([r[nk + j] for r in rows], dtype=npd))
+        self.engine.load_state(keys, vals)
+
+    # ---- data plane -----------------------------------------------------
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        self._recover()
+        chunk = chunk.compact()
+        data = chunk.data_chunk()
+        key_cols = [chunk.columns[i] for i in self.group_key_indices]
+        keys = self.codec.encode_columns(key_cols)
+        self.codec.observe_columns(keys, key_cols)
+        inputs = []
+        for c in self.calls:
+            if c.arg is None:
+                z = np.zeros(chunk.capacity, np.int64)
+                inputs.append((z, np.ones(chunk.capacity, bool)))
+            else:
+                col = c.arg.eval(data)
+                npd = _arg_np_dtype(c)
+                vals = col.values.astype(npd, copy=False) \
+                    if col.dtype.np_dtype != np.dtype(object) \
+                    else np.zeros(chunk.capacity, npd)
+                vals = np.where(col.validity, vals, 0).astype(npd)
+                inputs.append((vals, col.validity))
+        for ci in self._int_sum_calls:
+            v = inputs[ci][0]
+            # float64 magnitude estimate with multiplicative slack covers
+            # its rounding error; the 2x headroom to 2^63 does the rest
+            self._int_sum_bound += int(
+                np.abs(v.astype(np.float64)).sum() * 1.000001) + 1
+            if self._int_sum_bound >= 1 << 62:
+                raise OverflowError(
+                    "device integer sum accumulator cannot prove no-wrap "
+                    "(total pushed magnitude >= 2^62); run this query with "
+                    "device='off' for unbounded Decimal accumulation")
+        self.engine.push_rows(keys, chunk.signs(), inputs)
+        return iter(())
+
+    # ---- output derivation (exact host semantics from raw payloads) ----
+    def _format_row(self, vals: Sequence[np.ndarray], i: int) -> Tuple:
+        out: List[Any] = []
+        for call, dc in zip(self.calls, self.spec.calls):
+            rt = call.return_type
+            if call.kind == "count":
+                out.append(int(vals[dc.cols[0]][i]))
+                continue
+            if call.kind in ("sum", "avg"):
+                acc = vals[dc.cols[0]][i]
+                n = int(vals[dc.cols[1]][i])
+                if n <= 0:
+                    out.append(None)
+                elif call.kind == "sum":
+                    if rt.kind == TypeKind.DECIMAL:
+                        out.append(Decimal(int(acc)))
+                    elif rt.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+                        out.append(float(acc))
+                    else:
+                        out.append(int(acc))
+                else:  # avg
+                    if rt.kind == TypeKind.DECIMAL:
+                        out.append(Decimal(int(acc)) / Decimal(n))
+                    else:
+                        out.append(float(acc) / n)
+            else:  # min / max
+                n = int(vals[dc.cols[1]][i])
+                if n <= 0:
+                    out.append(None)
+                else:
+                    v = vals[dc.cols[0]][i]
+                    out.append(float(v) if rt.kind in
+                               (TypeKind.FLOAT32, TypeKind.FLOAT64)
+                               else int(v))
+        return tuple(out)
+
+    def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
+        self._recover()
+        ch = self.engine.flush_epoch()
+        if ch is not None:
+            yield from self._emit_changes(ch, barrier)
+        if self.state_table is not None:
+            self.state_table.commit(barrier.epoch.curr)
+
+    def _emit_changes(self, ch: Dict[str, Any],
+                      barrier: Barrier) -> Iterator[Message]:
+        from ..device.sorted_state import EMPTY_KEY
+        keys = np.asarray(ch["keys"]).reshape(-1)
+        old_found = np.asarray(ch["old_found"]).reshape(-1)
+        new_found = np.asarray(ch["new_found"]).reshape(-1)
+        old_vals = [np.asarray(v).reshape(-1) for v in ch["old_vals"]]
+        new_vals = [np.asarray(v).reshape(-1) for v in ch["new_vals"]]
+        live = (keys != EMPTY_KEY) & (old_found | new_found)
+        idxs = np.flatnonzero(live)
+        if len(idxs) == 0:
+            return
+        key_tuples = self.codec.decode(keys[idxs])
+        out = StreamChunkBuilder(self.schema.dtypes)
+        for i, kt in zip(idxs.tolist(), key_tuples):
+            of, nf = bool(old_found[i]), bool(new_found[i])
+            if nf:
+                new_row = kt + self._format_row(new_vals, i)
+            if of and nf:
+                old_row = kt + self._format_row(old_vals, i)
+                if old_row != new_row:
+                    out.append_update(old_row, new_row)
+                self._persist(kt, new_vals, i)
+            elif nf:
+                out.append_row(Op.INSERT, new_row)
+                self._persist(kt, new_vals, i)
+            else:  # group died this epoch
+                out.append_row(Op.DELETE, kt + self._format_row(old_vals, i))
+                if self.state_table is not None:
+                    self.state_table.delete(
+                        kt + tuple(self._payload_tuple(old_vals, i)))
+        dead = idxs[old_found[idxs] & ~new_found[idxs]]
+        if len(dead):
+            self.codec.forget(keys[dead])
+        for chunk in out.drain():
+            yield chunk
+
+    def _payload_tuple(self, vals: Sequence[np.ndarray], i: int) -> List[Any]:
+        out = []
+        for d, v in zip(self.spec.dtypes, vals):
+            out.append(float(v[i]) if np.issubdtype(np.dtype(d), np.floating)
+                       else int(v[i]))
+        return out
+
+    def _persist(self, kt: Tuple, vals: Sequence[np.ndarray], i: int) -> None:
+        if self.state_table is not None:
+            self.state_table.insert(kt + tuple(self._payload_tuple(vals, i)))
+
+    def on_watermark(self, wm: Watermark) -> Iterator[Message]:
+        if wm.col_idx in self.group_key_indices:
+            yield Watermark(self.group_key_indices.index(wm.col_idx),
+                            wm.dtype, wm.value)

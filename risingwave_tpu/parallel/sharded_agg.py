@@ -64,6 +64,7 @@ def make_sharded_agg_step(spec: DeviceAggSpec, mesh: Mesh,
     """
     n = mesh.devices.size
     ncalls = len(spec.calls)
+    npay = len(spec.kinds)
 
     def local_step(state, keys, signs, mask, inputs):
         # shard_map gives [1, ...] slices; drop the leading mesh axis
@@ -121,7 +122,9 @@ def make_sharded_agg_step(spec: DeviceAggSpec, mesh: Mesh,
              "old_out": tuple(sharded for _ in range(ncalls)),
              "old_null": tuple(sharded for _ in range(ncalls)),
              "new_out": tuple(sharded for _ in range(ncalls)),
-             "new_null": tuple(sharded for _ in range(ncalls))},
+             "new_null": tuple(sharded for _ in range(ncalls)),
+             "old_vals": tuple(sharded for _ in range(npay)),
+             "new_vals": tuple(sharded for _ in range(npay))},
         )
         fn = jax.shard_map(local_step, mesh=mesh,
                            in_specs=in_specs, out_specs=out_specs)
@@ -181,6 +184,35 @@ class ShardedHashAgg:
             vals.append(jax.device_put(
                 np.concatenate([np.asarray(v), padv], 1), self._sharding))
         self.state = SortedState(keys, st.count, tuple(vals))
+
+    def load_state(self, keys: np.ndarray,
+                   vals: Sequence[np.ndarray]) -> None:
+        """Recovery: place (key, payload...) rows on their owning shards
+        (vnode of the device key — must agree with the jitted exchange's
+        crc32_u64_jnp routing) and install as the sharded state."""
+        from ..core.vnode import crc32_bytes_matrix, _int_key_bytes
+        from .mesh import shard_of_vnode as _sov
+        keys = sanitize_keys(np.asarray(keys, np.int64))
+        vn = crc32_bytes_matrix(_int_key_bytes(keys)) % np.uint32(
+            self.vnode_count)
+        dest = _sov(vn.astype(np.int64), self.n, self.vnode_count)
+        per_shard = [np.flatnonzero(dest == s) for s in range(self.n)]
+        cap = _bucket(max([len(i) for i in per_shard] + [self.capacity]))
+        proto = self.spec.make_state(cap)
+        gkeys = np.broadcast_to(np.asarray(proto.keys)[None],
+                                (self.n, cap)).copy()
+        gvals = [np.broadcast_to(np.asarray(v)[None], (self.n, cap)).copy()
+                 for v in proto.vals]
+        counts = np.zeros(self.n, np.int32)
+        for s, idx in enumerate(per_shard):
+            order = idx[np.argsort(keys[idx], kind="stable")]
+            counts[s] = len(order)
+            gkeys[s, : len(order)] = keys[order]
+            for gv, v in zip(gvals, vals):
+                gv[s, : len(order)] = np.asarray(v)[order]
+        put = lambda a: jax.device_put(a, self._sharding)
+        self.state = SortedState(put(gkeys), put(counts),
+                                 tuple(put(v) for v in gvals))
 
     def rescale(self, new_mesh: Mesh) -> None:
         """Barrier-synchronized elastic re-shard onto a different mesh

@@ -59,11 +59,21 @@ class _Backfill(Executor):
 class Database:
     def __init__(self, store: Optional[StateStore] = None,
                  data_dir: Optional[str] = None,
-                 checkpoint_frequency: int = 1):
+                 checkpoint_frequency: int = 1,
+                 device=None):
         if store is None:
             store = (SpillStateStore(data_dir) if data_dir
                      else MemoryStateStore())
         self.store = store
+        # SQL->TPU dispatch policy (config.resolve_device): None = host-only.
+        # Must match the value used when this data directory was created —
+        # device-path state tables persist raw payload columns, host-path
+        # tables persist pickled AggGroups — so the policy is recorded next
+        # to the durable store and validated on reopen (fail fast instead of
+        # corrupting recovered state).
+        from ..config import resolve_device
+        self.device = resolve_device(device)
+        self._check_device_marker()
         self.catalog = Catalog()
         self.injector = BarrierInjector(checkpoint_frequency)
         self.sinks: List[Tuple[str, Iterator[Message]]] = []   # job pumps
@@ -81,6 +91,35 @@ class Database:
         self._ddl_seq = 0
         self._replaying = False
         self._recover_catalog()
+
+    def _device_mode_str(self) -> str:
+        if self.device is None:
+            return "off"
+        mode = ("mesh:%d" % self.device.mesh.devices.size
+                if self.device.mesh is not None else "single")
+        return mode + (":minmax" if self.device.minmax else "")
+
+    def _check_device_marker(self) -> None:
+        """Durable stores record the dispatch policy that shaped their state
+        tables; a reopen under a different policy fails fast."""
+        import json
+        import os
+        d = getattr(self.store, "dir", None)
+        if d is None:
+            return
+        path = os.path.join(d, "device_mode.json")
+        mode = self._device_mode_str()
+        if os.path.exists(path):
+            with open(path) as f:
+                saved = json.load(f)["mode"]
+            if saved != mode:
+                raise ValueError(
+                    f"data directory was created with device={saved!r} but "
+                    f"reopened with device={mode!r}; state-table layouts "
+                    "differ between dispatch policies")
+        else:
+            with open(path, "w") as f:
+                json.dump({"mode": mode}, f)
 
     def _recover_catalog(self) -> None:
         entries = sorted(self._ddl_log.iter_all())
@@ -240,7 +279,8 @@ class Database:
                           list(dtypes), list(pk))
 
     def _create_mv(self, stmt: A.CreateMaterializedView) -> str:
-        planner = Planner(self._subscribe, make_state=self._make_state)
+        planner = Planner(self._subscribe, make_state=self._make_state,
+                          device=self.device)
         self._pending_subs = []
         execu, ns = planner.plan_select(stmt.query)
         schema = ns.schema()
@@ -268,8 +308,8 @@ class Database:
             execu, schema, _pk = self._subscribe(stmt.from_name)
         else:
             execu, ns = Planner(self._subscribe,
-                                make_state=self._make_state
-                                ).plan_select(stmt.query)
+                                make_state=self._make_state,
+                                device=self.device).plan_select(stmt.query)
             schema = ns.schema()
         rows: List[Tuple] = []
         self.sink_results[stmt.name] = rows

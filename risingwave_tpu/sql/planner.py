@@ -248,12 +248,41 @@ class Planner:
 
     def __init__(self, subscribe: Callable[[str], Tuple[Executor, Schema]],
                  make_state: Optional[Callable[[Sequence[DataType],
-                                                Sequence[int]], Any]] = None):
+                                                Sequence[int]], Any]] = None,
+                 device=None):
         self.subscribe = subscribe
         # state-table factory: (dtypes, pk) -> StateTable | None. Called in
         # a DETERMINISTIC order per statement so table ids line up when the
         # DDL log replays on recovery.
         self.make_state = make_state or (lambda dtypes, pk: None)
+        # DeviceConfig | None — the SQL->TPU dispatch seam (the reference's
+        # from_proto/mod.rs:151-197 analog): eligible HashAgg fragments
+        # lower onto DeviceHashAggExecutor. Must be stable across restarts
+        # of the same data directory (state-table layouts differ).
+        self.device = device
+
+    def _make_hash_agg(self, input: Executor, group_indices: List[int],
+                       calls: List[AggCall], gdtypes: List[DataType],
+                       eowc: bool = False, wc: Optional[int] = None
+                       ) -> Executor:
+        """Device-vs-host HashAgg dispatch. Both paths allocate exactly one
+        state table so table ids stay aligned across DDL-log replay."""
+        from ..ops.device_agg import (DeviceHashAggExecutor,
+                                      device_agg_eligible,
+                                      device_payload_dtypes)
+        if self.device is not None and not eowc \
+                and device_agg_eligible(calls, self.device.minmax):
+            st = self.make_state(gdtypes + device_payload_dtypes(calls),
+                                 list(range(len(group_indices))))
+            return DeviceHashAggExecutor(input, group_indices, calls,
+                                         state_table=st,
+                                         mesh=self.device.mesh,
+                                         capacity=self.device.capacity)
+        st = self.make_state(gdtypes + [T.BYTEA],
+                             list(range(len(group_indices))))
+        return HashAggExecutor(input, group_indices, calls, state_table=st,
+                               emit_on_window_close=eowc,
+                               window_col_in_group=wc)
 
     # ---- FROM -----------------------------------------------------------
     def _plan_table(self, ref: A.TableRef) -> Tuple[Executor, Namespace]:
@@ -393,10 +422,8 @@ class Planner:
                        out_sk, n_visible)
 
         if q.distinct:
-            st = self.make_state([c.dtype for c in ns.cols] + [T.BYTEA],
-                                 list(range(len(ns.cols))))
-            execu = HashAggExecutor(execu, list(range(len(ns.cols))), [],
-                                    state_table=st)
+            execu = self._make_hash_agg(execu, list(range(len(ns.cols))), [],
+                                        [c.dtype for c in ns.cols])
             # schema unchanged: group keys only
 
         if q.limit is not None:
@@ -447,11 +474,9 @@ class Planner:
             wc = _find_window_col(q.group_by)
         if group_exprs:
             gdtypes = [e.return_type for e in group_exprs]
-            st = self.make_state(gdtypes + [T.BYTEA],
-                                 list(range(len(group_exprs))))
-            agg: Executor = HashAggExecutor(
-                proj, list(range(len(group_exprs))), calls, state_table=st,
-                emit_on_window_close=eowc, window_col_in_group=wc)
+            agg: Executor = self._make_hash_agg(
+                proj, list(range(len(group_exprs))), calls, gdtypes,
+                eowc=eowc, wc=wc)
         else:
             st = self.make_state([T.INT64, T.BYTEA], [0])
             agg = SimpleAggExecutor(proj, calls, state_table=st)

@@ -11,10 +11,14 @@ E2E = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 SLT_FILES = sorted(glob.glob(os.path.join(E2E, "**", "*.slt"), recursive=True))
 
 
+@pytest.mark.parametrize("device", ["off", "on"])
 @pytest.mark.parametrize("path", SLT_FILES,
                          ids=[os.path.relpath(p, E2E) for p in SLT_FILES])
-def test_slt(path):
-    run_slt_file(path)
+def test_slt(path, device):
+    """The whole e2e suite must pass identically with the TPU dispatch seam
+    on — same SQL, same results, device HashAgg under eligible fragments."""
+    from risingwave_tpu.sql import Database
+    run_slt_file(path, db=Database(device=device))
 
 
 def test_mv_equals_batch_recompute_nexmark_datagen():
