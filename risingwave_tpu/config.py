@@ -19,12 +19,12 @@ class DeviceConfig:
     mesh      — jax.sharding.Mesh to shard operator state over; None = one
                 chip (still jitted epoch steps, no collectives).
     capacity  — initial per-operator state slots (grows by pow2 on demand).
-    minmax    — lower min/max aggregates (requires the retractable
-                candidate-buffer state; off until it lands).
+    minmax    — lower min/max aggregates onto the retractable sorted-
+                multiset state (device/minput.py).
     """
     mesh: Optional[Any] = None
     capacity: int = 1024
-    minmax: bool = False
+    minmax: bool = True
 
 
 def resolve_device(device) -> Optional[DeviceConfig]:

@@ -12,20 +12,24 @@ batching (parity is defined at barrier boundaries; intra-epoch order is free)
 is the optimization license, exactly the reference's shared-buffer trick.
 
 Supported device aggregates: count / count(col) / sum / avg (retractable),
-min / max (append-only — the same restriction the reference's value-state agg
-has before falling back to MaterializedInput, `aggregate/minput.rs`). The
-host executor keeps the exact path for everything else.
+min / max — either append-only single-extreme state (cheapest, the fused
+pipeline's choice) or exact-under-retraction via a sorted-multiset side
+state per input column (`device/minput.py`, the `MaterializedInput` analog,
+`aggregate/minput.rs`). The host executor keeps the exact path for
+everything else (decimals, strings, DISTINCT, exotic kinds).
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .minput import (SortedMultiset, ms_batch_reduce, ms_find,
+                     ms_group_minmax, ms_grow, ms_make, ms_merge)
 from .sorted_state import (EMPTY_KEY, ReduceKind, SortedState, batch_reduce,
                            grow_state, lookup, make_state, merge,
                            sanitize_keys)
@@ -41,6 +45,22 @@ class DeviceCall:
     kind: str                   # one of DEVICE_AGG_KINDS
     acc_dtype: Any              # jnp dtype of the accumulator / output
     cols: Tuple[int, ...]       # payload column indices (in state.vals)
+    minput: Optional[int] = None  # index into spec.minputs (retractable m/m)
+
+
+@dataclass(frozen=True)
+class MinputDesc:
+    """One retractable min/max multiset state (minput.py). Shared by every
+    min/max call over the same input column (ms_group_minmax returns both
+    extremes from one search); call_idx names the value-source call."""
+    call_idx: int
+
+
+class DeviceAggState(NamedTuple):
+    """Main sorted-run state + one sorted multiset per retractable
+    min/max call."""
+    main: SortedState
+    minputs: Tuple[SortedMultiset, ...]
 
 
 @dataclass(frozen=True)
@@ -48,25 +68,36 @@ class DeviceAggSpec:
     """Static layout of the state payload.
 
     Payload column 0 is always row_count (SUM of signs) — group liveness,
-    as in `agg_group.rs`. Each call then owns 1-2 columns:
+    as in `agg_group.rs`. Each call then owns payload columns:
       count      -> [valid_count SUM]
       sum        -> [sum SUM, valid_count SUM]     (NULL when no valid rows)
       avg        -> [sum SUM, valid_count SUM]
-      min / max  -> [extreme MIN/MAX, valid_count SUM]  (append-only)
+      min / max  -> append-only build: [extreme MIN/MAX, valid_count SUM];
+                    retractable build: [valid_count SUM] + a SortedMultiset
+                    side state (`spec.minputs`, the minput.rs analog)
     """
     calls: Tuple[DeviceCall, ...]
     kinds: Tuple[ReduceKind, ...]
     dtypes: Tuple[Any, ...]
     append_only: bool
+    minputs: Tuple[MinputDesc, ...] = ()
 
     @staticmethod
-    def build(call_kinds: Sequence[str], in_dtypes: Sequence[Any]
-              ) -> "DeviceAggSpec":
+    def build(call_kinds: Sequence[str], in_dtypes: Sequence[Any],
+              append_only: bool = True,
+              arg_ids: Optional[Sequence[Any]] = None) -> "DeviceAggSpec":
+        """append_only=True keeps min/max as one extreme column (cheapest;
+        raises on retraction). append_only=False gives min/max calls a
+        multiset side state — exact under deletes, the SQL default.
+        arg_ids (hashable per call) lets min(x) and max(x) over the same
+        column share one multiset."""
         kinds: List[ReduceKind] = [ReduceKind.SUM]       # row_count
         dtypes: List[Any] = [jnp.int64]
         calls: List[DeviceCall] = []
-        append_only = False
-        for k, dt in zip(call_kinds, in_dtypes):
+        minputs: List[MinputDesc] = []
+        minput_by_arg: Dict[Any, int] = {}
+        has_ao_minmax = False
+        for i, (k, dt) in enumerate(zip(call_kinds, in_dtypes)):
             if k not in DEVICE_AGG_KINDS:
                 raise ValueError(f"agg kind {k!r} has no device path")
             dt = jnp.dtype(dt)
@@ -81,18 +112,33 @@ class DeviceAggSpec:
                 kinds += [ReduceKind.SUM, ReduceKind.SUM]
                 dtypes += [acc, jnp.int64]
                 calls.append(DeviceCall(k, acc, (c0, c0 + 1)))
-            else:  # min / max
-                append_only = True
+            elif append_only:  # min / max, single-extreme state
+                has_ao_minmax = True
                 c0 = len(kinds)
                 kinds += [ReduceKind.MIN if k == "min" else ReduceKind.MAX,
                           ReduceKind.SUM]
                 dtypes += [acc, jnp.int64]
                 calls.append(DeviceCall(k, acc, (c0, c0 + 1)))
+            else:  # min / max, retractable multiset state
+                c0 = len(kinds)
+                kinds.append(ReduceKind.SUM); dtypes.append(jnp.int64)
+                aid = arg_ids[i] if arg_ids is not None else ("call", i)
+                mi = minput_by_arg.get(aid)
+                if mi is None:
+                    mi = len(minputs)
+                    minput_by_arg[aid] = mi
+                    minputs.append(MinputDesc(len(calls)))
+                calls.append(DeviceCall(k, acc, (c0,), minput=mi))
         return DeviceAggSpec(tuple(calls), tuple(kinds), tuple(dtypes),
-                             append_only)
+                             has_ao_minmax, tuple(minputs))
 
     def make_state(self, capacity: int) -> SortedState:
         return make_state(capacity, self.dtypes, self.kinds)
+
+    def make_full_state(self, capacity: int) -> DeviceAggState:
+        return DeviceAggState(self.make_state(capacity),
+                              tuple(ms_make(capacity)
+                                    for _ in self.minputs))
 
 
 def _row_deltas(spec: DeviceAggSpec, signs, mask,
@@ -113,6 +159,10 @@ def _row_deltas(spec: DeviceAggSpec, signs, mask,
             v = jnp.where(valid & mask, vals, 0).astype(call.acc_dtype)
             deltas[call.cols[0]] = v * sv.astype(call.acc_dtype)
             deltas[call.cols[1]] = sv
+        elif call.minput is not None:
+            # retractable min/max: main state keeps only valid_count; the
+            # values live in the multiset side state (epoch_core_full)
+            deltas[call.cols[0]] = sv
         else:  # min / max — append-only: neutral where invalid
             kind = spec.kinds[call.cols[0]]
             from .sorted_state import _neutral
@@ -139,6 +189,12 @@ def _outputs(spec: DeviceAggSpec, vals: Sequence[jax.Array]
             denom = jnp.where(cnt == 0, 1, cnt).astype(jnp.float64)
             outs.append(vals[call.cols[0]].astype(jnp.float64) / denom)
             nulls.append(cnt == 0)
+        elif call.minput is not None:
+            # placeholder: real values come from the multiset via
+            # epoch_core_full's minput change entries (SQL path formats
+            # host-side); NULL mask from valid_count is still meaningful
+            outs.append(jnp.zeros_like(vals[call.cols[0]]))
+            nulls.append(vals[call.cols[0]] == 0)
         else:
             outs.append(vals[call.cols[0]])
             nulls.append(vals[call.cols[1]] == 0)
@@ -170,6 +226,50 @@ def epoch_core(spec: DeviceAggSpec, state: SortedState,
     return new_state, needed, changes
 
 
+def epoch_core_full(spec: DeviceAggSpec, state: DeviceAggState,
+                    keys: jax.Array, signs: jax.Array, mask: jax.Array,
+                    inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """epoch_core + the retractable min/max multisets: one traced program
+    covering main-state merge and every minput's sort-merge + extremes.
+
+    changes gains, per minput i, a dict `minput{i}`:
+      old_min/old_max/new_min/new_max — group extremes (order-encoded
+      int64) aligned with changes["keys"], gated by the main valid_count;
+      u1/u2/u_cnt — touched (group, value) pairs and their post-merge
+      multiplicities (0 = pair died), for host-side state persistence.
+    """
+    new_main, needed, ch = epoch_core(spec, state.main, keys, signs, mask,
+                                      inputs)
+    s64 = jnp.where(mask, signs, 0).astype(jnp.int64)
+    new_ms: List[SortedMultiset] = []
+    ms_needed: List[jax.Array] = []
+    for mi, desc in enumerate(spec.minputs):
+        vals, valid = inputs[desc.call_idx]
+        u1, u2, ud = ms_batch_reduce(keys, vals.astype(jnp.int64), s64,
+                                     mask & valid)
+        old_f, old_mn, old_mx = ms_group_minmax(state.minputs[mi],
+                                                ch["keys"])
+        nms, need = ms_merge(state.minputs[mi], u1, u2, ud)
+        new_f, new_mn, new_mx = ms_group_minmax(nms, ch["keys"])
+        pf, pc = ms_find(nms, u1, u2)
+        ch[f"minput{mi}"] = {
+            "old_found": old_f, "old_min": old_mn, "old_max": old_mx,
+            "new_found": new_f, "new_min": new_mn, "new_max": new_mx,
+            "u1": u1, "u2": u2, "u_cnt": jnp.where(pf, pc, 0),
+        }
+        new_ms.append(nms)
+        ms_needed.append(need)
+    return (DeviceAggState(new_main, tuple(new_ms)),
+            (needed, tuple(ms_needed)), ch)
+
+
+@partial(jax.jit, static_argnames=("spec",))
+def agg_epoch_step_full(spec: DeviceAggSpec, state: DeviceAggState,
+                        keys: jax.Array, signs: jax.Array, mask: jax.Array,
+                        inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    return epoch_core_full(spec, state, keys, signs, mask, inputs)
+
+
 @partial(jax.jit, static_argnames=("spec",))
 def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
                    keys: jax.Array, signs: jax.Array, mask: jax.Array,
@@ -199,6 +299,8 @@ class DeviceHashAgg:
     def __init__(self, spec: DeviceAggSpec, capacity: int = 1024):
         self.spec = spec
         self.state = spec.make_state(capacity)
+        self.minputs: Tuple[SortedMultiset, ...] = tuple(
+            ms_make(capacity) for _ in spec.minputs)
         self._keys: List[np.ndarray] = []
         self._signs: List[np.ndarray] = []
         self._inputs: List[List[Tuple[np.ndarray, np.ndarray]]] = []
@@ -221,6 +323,24 @@ class DeviceHashAgg:
             new_vals.append(jnp.asarray(arr))
         self.state = SortedState(jnp.asarray(new_keys),
                                  jnp.asarray(np.int32(n)), tuple(new_vals))
+
+    def load_minput(self, mi: int, k1: np.ndarray, k2: np.ndarray,
+                    cnt: np.ndarray) -> None:
+        """Recovery: install a minput multiset's (group, value, count) rows.
+        Values (k2) are NOT sanitized — padding is k1-discriminated."""
+        k1 = sanitize_keys(k1)
+        k2 = np.asarray(k2, np.int64)
+        order = np.lexsort((k2, k1))
+        n = len(k1)
+        cap = _bucket(max(n, self.minputs[mi].capacity))
+        gk1 = np.full(cap, EMPTY_KEY, np.int64)
+        gk2 = np.full(cap, EMPTY_KEY, np.int64)
+        gc = np.zeros(cap, np.int64)
+        gk1[:n], gk2[:n] = k1[order], k2[order]
+        gc[:n] = np.asarray(cnt, np.int64)[order]
+        ms = SortedMultiset(jnp.asarray(gk1), jnp.asarray(gk2),
+                            jnp.asarray(np.int32(n)), jnp.asarray(gc))
+        self.minputs = self.minputs[:mi] + (ms,) + self.minputs[mi + 1:]
 
     def push_rows(self, keys: np.ndarray, signs: np.ndarray,
                   inputs: Sequence[Tuple[np.ndarray, np.ndarray]]) -> None:
@@ -253,14 +373,27 @@ class DeviceHashAgg:
         ins = tuple((jnp.asarray(np.pad(_acc_cast(v), (0, pad))),
                      jnp.asarray(np.pad(m.astype(bool), (0, pad))))
                     for v, m in ins)
+        jk, js, jm = jnp.asarray(keys), jnp.asarray(signs), jnp.asarray(mask)
         while True:
-            new_state, needed, changes = agg_epoch_step(
-                self.spec, self.state, jnp.asarray(keys), jnp.asarray(signs),
-                jnp.asarray(mask), ins)
-            n = int(needed)
-            if n <= self.state.capacity:
-                self.state = new_state
-                break
-            cap = _bucket(n, lo=self.state.capacity * 2)
-            self.state = grow_state(self.state, cap, self.spec.kinds)
-        return jax.tree_util.tree_map(np.asarray, changes)
+            full = DeviceAggState(self.state, self.minputs)
+            new_full, (needed, ms_needed), changes = agg_epoch_step_full(
+                self.spec, full, jk, js, jm, ins)
+            grown = False
+            if int(needed) > self.state.capacity:
+                self.state = grow_state(
+                    self.state, _bucket(int(needed),
+                                        lo=self.state.capacity * 2),
+                    self.spec.kinds)
+                grown = True
+            for i, nd in enumerate(ms_needed):
+                if int(nd) > self.minputs[i].capacity:
+                    ms = ms_grow(self.minputs[i],
+                                 _bucket(int(nd),
+                                         lo=self.minputs[i].capacity * 2))
+                    self.minputs = (self.minputs[:i] + (ms,)
+                                    + self.minputs[i + 1:])
+                    grown = True
+            if grown:
+                continue
+            self.state, self.minputs = new_full.main, new_full.minputs
+            return jax.tree_util.tree_map(np.asarray, changes)

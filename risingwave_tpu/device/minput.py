@@ -1,0 +1,151 @@
+"""Sorted multiset state — retractable device min/max.
+
+The device analog of the reference's `MaterializedInput` aggregate state
+(`src/stream/src/executor/aggregate/minput.rs`): instead of one extreme per
+group (append-only only), keep every distinct (group, value) pair with its
+multiplicity, ordered by (group, value) in fixed-capacity HBM arrays. Then
+
+* retraction is exact: deleting the current extreme decrements its count;
+  when it hits zero the pair compacts away and the next value — physically
+  adjacent in the sorted run — becomes the extreme;
+* the per-group min/max is a `searchsorted` range endpoint, not a scan;
+* maintenance per epoch is the same sort-merge pattern as
+  `sorted_state.py`, so it fuses into the one-program-per-epoch step.
+
+Floats participate via an order-preserving int64 encoding
+(`order_encode_f64`); the host decodes on output.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sorted_state import EMPTY_KEY
+
+_LOW63 = np.int64(0x7FFFFFFFFFFFFFFF)
+
+
+def order_encode_f64(v: np.ndarray) -> np.ndarray:
+    """Monotone float64 -> int64 (numpy): total order of the encoding
+    matches the float order (negatives flipped; -0.0 sorts just below 0.0,
+    NaN above +inf — the PG sort position)."""
+    bits = np.ascontiguousarray(v, dtype=np.float64).view(np.int64)
+    return np.where(bits >= 0, bits, bits ^ _LOW63)
+
+
+def order_decode_f64(k: np.ndarray) -> np.ndarray:
+    bits = np.where(k >= 0, k, k ^ _LOW63)
+    return np.ascontiguousarray(bits, dtype=np.int64).view(np.float64)
+
+
+class SortedMultiset(NamedTuple):
+    """(k1, k2) pairs sorted lexicographically; cnt > 0 multiplicities.
+    Slots >= count hold (EMPTY_KEY, EMPTY_KEY, 0)."""
+    k1: jax.Array                 # int64 (C,) group key
+    k2: jax.Array                 # int64 (C,) value (order-encoded)
+    count: jax.Array              # int32 scalar
+    cnt: jax.Array                # int64 (C,) multiplicity
+
+    @property
+    def capacity(self) -> int:
+        return self.k1.shape[0]
+
+
+def ms_make(capacity: int) -> SortedMultiset:
+    return SortedMultiset(
+        jnp.full((capacity,), EMPTY_KEY, jnp.int64),
+        jnp.full((capacity,), EMPTY_KEY, jnp.int64),
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((capacity,), jnp.int64))
+
+
+def ms_grow(ms: SortedMultiset, new_capacity: int) -> SortedMultiset:
+    pad = new_capacity - ms.capacity
+    assert pad >= 0
+    return SortedMultiset(
+        jnp.concatenate([ms.k1, jnp.full((pad,), EMPTY_KEY, jnp.int64)]),
+        jnp.concatenate([ms.k2, jnp.full((pad,), EMPTY_KEY, jnp.int64)]),
+        ms.count,
+        jnp.concatenate([ms.cnt, jnp.zeros((pad,), jnp.int64)]))
+
+
+def ms_batch_reduce(k1, k2, delta, mask):
+    """Rows -> unique (k1, k2) pairs with summed count deltas, sorted,
+    EMPTY-padded. delta is +1/-1 (sign) per row; masked rows neutralized."""
+    b = k1.shape[0]
+    k1 = jnp.where(mask, k1, EMPTY_KEY)
+    k2 = jnp.where(mask, k2, EMPTY_KEY)
+    delta = jnp.where(mask, delta, 0).astype(jnp.int64)
+    order = jnp.lexsort((k2, k1))
+    k1, k2, delta = k1[order], k2[order], delta[order]
+    same = jnp.concatenate([jnp.zeros((1,), bool),
+                            (k1[1:] == k1[:-1]) & (k2[1:] == k2[:-1])])
+    seg = jnp.cumsum(~same) - 1
+    ud = jax.ops.segment_sum(delta, seg, num_segments=b)
+    u1 = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(k1)
+    u2 = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(k2)
+    ud = jnp.where(u1 == EMPTY_KEY, 0, ud)
+    return u1, u2, ud
+
+
+def ms_merge(ms: SortedMultiset, u1, u2, ud
+             ) -> Tuple[SortedMultiset, jax.Array]:
+    """Merge unique pair deltas; pairs whose multiplicity reaches 0 compact
+    away. Returns (new_ms, needed) — needed > capacity means grow+retry."""
+    c = ms.capacity
+    dead = ud == 0
+    k1 = jnp.concatenate([ms.k1, jnp.where(dead, EMPTY_KEY, u1)])
+    k2 = jnp.concatenate([ms.k2, jnp.where(dead, EMPTY_KEY, u2)])
+    cnt = jnp.concatenate([ms.cnt, ud])
+    order = jnp.lexsort((k2, k1))
+    k1, k2, cnt = k1[order], k2[order], cnt[order]
+    same_next = jnp.concatenate(
+        [(k1[:-1] == k1[1:]) & (k2[:-1] == k2[1:]), jnp.zeros((1,), bool)])
+    same_prev = jnp.concatenate(
+        [jnp.zeros((1,), bool), (k1[1:] == k1[:-1]) & (k2[1:] == k2[:-1])])
+    nxt = jnp.concatenate([cnt[1:], cnt[-1:]])
+    merged = jnp.where(same_next, cnt + nxt, cnt)
+    alive = ~same_prev & (k1 != EMPTY_KEY) & (merged != 0)
+    dest = jnp.cumsum(alive) - 1
+    needed = jnp.sum(alive).astype(jnp.int32)
+    idx = jnp.where(alive, dest, k1.shape[0])
+    out = SortedMultiset(
+        jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(k1, mode="drop"),
+        jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(k2, mode="drop"),
+        jnp.minimum(needed, c),
+        jnp.zeros((c,), jnp.int64).at[idx].set(merged, mode="drop"))
+    return out, needed
+
+
+def ms_group_minmax(ms: SortedMultiset, groups):
+    """Per queried group: (found, min value, max value). Groups absent from
+    the multiset return found=False (gate on it). k1 is itself sorted
+    because the pairs are lexicographic."""
+    lo = jnp.searchsorted(ms.k1, groups, side="left")
+    hi = jnp.searchsorted(ms.k1, groups, side="right")
+    found = (hi > lo) & (groups != EMPTY_KEY)
+    lo_c = jnp.minimum(lo, ms.capacity - 1)
+    hi_c = jnp.clip(hi - 1, 0, ms.capacity - 1)
+    return found, ms.k2[lo_c], ms.k2[hi_c]
+
+
+def ms_find(ms: SortedMultiset, q1, q2):
+    """Composite binary search: multiplicity of each (q1, q2) pair (0 when
+    absent). Unrolled log2(C) steps — static shapes, jit-safe."""
+    c = ms.capacity
+    lo = jnp.zeros(q1.shape, jnp.int32)
+    hi = jnp.full(q1.shape, c, jnp.int32)
+    steps = max(1, (c - 1).bit_length() + 1)
+    for _ in range(steps):
+        mid = (lo + hi) // 2
+        mid_c = jnp.minimum(mid, c - 1)
+        m1, m2 = ms.k1[mid_c], ms.k2[mid_c]
+        less = (m1 < q1) | ((m1 == q1) & (m2 < q2))
+        lo = jnp.where(less, mid + 1, lo)
+        hi = jnp.where(less, hi, mid)
+    lo_c = jnp.minimum(lo, c - 1)
+    found = (ms.k1[lo_c] == q1) & (ms.k2[lo_c] == q2) & (q1 != EMPTY_KEY)
+    return found, jnp.where(found, ms.cnt[lo_c], 0)

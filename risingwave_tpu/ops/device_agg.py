@@ -47,13 +47,13 @@ def _spec_kinds(calls: Sequence[AggCall]) -> List[str]:
 
 
 def device_agg_eligible(calls: Sequence[AggCall],
-                        include_minmax: bool = False) -> bool:
+                        include_minmax: bool = True) -> bool:
     """Can this aggregation fragment run on the device path?
 
-    count/sum/avg are exact under retraction. min/max are gated on
-    `include_minmax` until the retractable candidate-buffer state lands
-    (the `minput.rs` analog); DISTINCT/filtered calls and exotic kinds stay
-    on the exact host path.
+    count/sum/avg are exact under retraction; min/max are exact via the
+    sorted-multiset side state (`device/minput.py`, the `minput.rs`
+    analog). DISTINCT/filtered calls and exotic kinds stay on the exact
+    host path.
     """
     for c in calls:
         if c.distinct or c.filter is not None:
@@ -74,17 +74,33 @@ def device_agg_eligible(calls: Sequence[AggCall],
     return True
 
 
+def _build_sql_spec(calls: Sequence[AggCall]):
+    """The retractable (SQL-default) device spec for these calls. min/max
+    over the same input column (InputRef) share one multiset."""
+    from ..device.agg_step import DeviceAggSpec
+    from ..expr.expression import InputRef
+    arg_ids = [("ref", c.arg.index) if isinstance(c.arg, InputRef)
+               else ("call", i) for i, c in enumerate(calls)]
+    return DeviceAggSpec.build(_spec_kinds(calls),
+                               [_arg_np_dtype(c) for c in calls],
+                               append_only=False, arg_ids=arg_ids)
+
+
 def device_payload_dtypes(calls: Sequence[AggCall]) -> List[DataType]:
     """SQL dtypes of the persisted device payload columns (state-table
     layout; must match DeviceAggSpec.build's column order)."""
-    from ..device.agg_step import DeviceAggSpec
-    spec = DeviceAggSpec.build(_spec_kinds(calls),
-                               [_arg_np_dtype(c) for c in calls])
+    spec = _build_sql_spec(calls)
     out = []
     for d in spec.dtypes:
         out.append(T.FLOAT64 if np.issubdtype(np.dtype(d), np.floating)
                    else T.INT64)
     return out
+
+
+def device_minput_count(calls: Sequence[AggCall]) -> int:
+    """How many minput side tables the executor persists (one per
+    retractable min/max call): rows are (group..., encoded value, count)."""
+    return len(_build_sql_spec(calls).minputs)
 
 
 def _arg_np_dtype(c: AggCall):
@@ -100,6 +116,7 @@ class DeviceHashAggExecutor(UnaryExecutor):
     def __init__(self, input: Executor, group_key_indices: Sequence[int],
                  calls: Sequence[AggCall],
                  state_table: Optional[StateTable] = None,
+                 minput_tables: Sequence[StateTable] = (),
                  mesh: Optional[Any] = None, capacity: int = 1024):
         in_schema = input.schema
         fields = [in_schema.fields[i] for i in group_key_indices]
@@ -109,14 +126,21 @@ class DeviceHashAggExecutor(UnaryExecutor):
         self.group_key_indices = list(group_key_indices)
         self.calls = list(calls)
         self.state_table = state_table
+        self.minput_tables = list(minput_tables)
         self._recovered = state_table is None
         self._key_dtypes = [in_schema.fields[i].dtype
                             for i in group_key_indices]
 
-        from ..device.agg_step import DeviceAggSpec
         from ..device.key_codec import make_codec
-        self.spec = DeviceAggSpec.build(_spec_kinds(calls),
-                                        [_arg_np_dtype(c) for c in calls])
+        self.spec = _build_sql_spec(calls)
+        assert len(self.minput_tables) in (0, len(self.spec.minputs)), \
+            "one minput state table per retractable min/max call"
+        # call_idx -> is the minput value order-encoded from floats?
+        self._minput_float = {
+            ci: np.issubdtype(
+                np.dtype(calls[ci].arg.return_type.device_dtype),
+                np.floating)
+            for ci, dc in enumerate(self.spec.calls) if dc.minput is not None}
         self.codec = make_codec(self._key_dtypes)
         # int64 accumulator overflow guard: running bound on the total
         # absolute magnitude ever pushed into integer sum columns. The host
@@ -144,19 +168,28 @@ class DeviceHashAggExecutor(UnaryExecutor):
         if self._recovered:
             return
         self._recovered = True
-        rows = list(self.state_table.iter_all())
-        if not rows:
-            return
         nk = len(self.group_key_indices)
-        key_rows = [r[:nk] for r in rows]
-        keys = self.codec.encode_rows(key_rows)
-        self.codec.observe_rows(keys, key_rows)
-        vals = []
-        for j, d in enumerate(self.spec.dtypes):
-            npd = (np.float64 if np.issubdtype(np.dtype(d), np.floating)
-                   else np.int64)
-            vals.append(np.array([r[nk + j] for r in rows], dtype=npd))
-        self.engine.load_state(keys, vals)
+        rows = list(self.state_table.iter_all())
+        if rows:
+            key_rows = [r[:nk] for r in rows]
+            keys = self.codec.encode_rows(key_rows)
+            self.codec.observe_rows(keys, key_rows)
+            vals = []
+            for j, d in enumerate(self.spec.dtypes):
+                npd = (np.float64 if np.issubdtype(np.dtype(d), np.floating)
+                       else np.int64)
+                vals.append(np.array([r[nk + j] for r in rows], dtype=npd))
+            self.engine.load_state(keys, vals)
+        for mi, tbl in enumerate(self.minput_tables):
+            mrows = list(tbl.iter_all())
+            if not mrows:
+                continue
+            key_rows = [r[:nk] for r in mrows]
+            k1 = self.codec.encode_rows(key_rows)
+            self.codec.observe_rows(k1, key_rows)
+            k2 = np.array([r[nk] for r in mrows], dtype=np.int64)
+            cnt = np.array([r[nk + 1] for r in mrows], dtype=np.int64)
+            self.engine.load_minput(mi, k1, k2, cnt)
 
     # ---- data plane -----------------------------------------------------
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
@@ -167,18 +200,31 @@ class DeviceHashAggExecutor(UnaryExecutor):
         keys = self.codec.encode_columns(key_cols)
         self.codec.observe_columns(keys, key_cols)
         inputs = []
-        for c in self.calls:
+        for ci, c in enumerate(self.calls):
             if c.arg is None:
                 z = np.zeros(chunk.capacity, np.int64)
                 inputs.append((z, np.ones(chunk.capacity, bool)))
-            else:
-                col = c.arg.eval(data)
-                npd = _arg_np_dtype(c)
-                vals = col.values.astype(npd, copy=False) \
-                    if col.dtype.np_dtype != np.dtype(object) \
-                    else np.zeros(chunk.capacity, npd)
-                vals = np.where(col.validity, vals, 0).astype(npd)
-                inputs.append((vals, col.validity))
+                continue
+            col = c.arg.eval(data)
+            if self.spec.calls[ci].minput is not None:
+                # minput value: order-preserving int64 encoding (floats via
+                # order_encode). No sentinel remap — multiset padding is
+                # discriminated by the GROUP key (k1) alone, so a value
+                # equal to int64 max is legitimate and preserved exactly.
+                from ..device.minput import order_encode_f64
+                if self._minput_float[ci]:
+                    enc = order_encode_f64(col.values.astype(np.float64))
+                else:
+                    enc = col.values.astype(np.int64, copy=False)
+                vals = np.where(col.validity, enc, 0)
+                inputs.append((vals.astype(np.int64), col.validity))
+                continue
+            npd = _arg_np_dtype(c)
+            vals = col.values.astype(npd, copy=False) \
+                if col.dtype.np_dtype != np.dtype(object) \
+                else np.zeros(chunk.capacity, npd)
+            vals = np.where(col.validity, vals, 0).astype(npd)
+            inputs.append((vals, col.validity))
         for ci in self._int_sum_calls:
             v = inputs[ci][0]
             # float64 magnitude estimate with multiplicative slack covers
@@ -194,9 +240,10 @@ class DeviceHashAggExecutor(UnaryExecutor):
         return iter(())
 
     # ---- output derivation (exact host semantics from raw payloads) ----
-    def _format_row(self, vals: Sequence[np.ndarray], i: int) -> Tuple:
+    def _format_row(self, vals: Sequence[np.ndarray], i: int,
+                    mm: Optional[Dict[int, np.ndarray]] = None) -> Tuple:
         out: List[Any] = []
-        for call, dc in zip(self.calls, self.spec.calls):
+        for ci, (call, dc) in enumerate(zip(self.calls, self.spec.calls)):
             rt = call.return_type
             if call.kind == "count":
                 out.append(int(vals[dc.cols[0]][i]))
@@ -218,15 +265,18 @@ class DeviceHashAggExecutor(UnaryExecutor):
                         out.append(Decimal(int(acc)) / Decimal(n))
                     else:
                         out.append(float(acc) / n)
-            else:  # min / max
-                n = int(vals[dc.cols[1]][i])
-                if n <= 0:
+            else:  # min / max: extreme from the multiset change arrays
+                n = int(vals[dc.cols[0]][i])
+                if n <= 0 or mm is None:
                     out.append(None)
                 else:
-                    v = vals[dc.cols[0]][i]
-                    out.append(float(v) if rt.kind in
-                               (TypeKind.FLOAT32, TypeKind.FLOAT64)
-                               else int(v))
+                    enc = int(mm[ci][i])
+                    if self._minput_float[ci]:
+                        from ..device.minput import order_decode_f64
+                        out.append(float(order_decode_f64(
+                            np.array([enc], dtype=np.int64))[0]))
+                    else:
+                        out.append(enc)
         return tuple(out)
 
     def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
@@ -236,6 +286,8 @@ class DeviceHashAggExecutor(UnaryExecutor):
             yield from self._emit_changes(ch, barrier)
         if self.state_table is not None:
             self.state_table.commit(barrier.epoch.curr)
+        for tbl in self.minput_tables:
+            tbl.commit(barrier.epoch.curr)
 
     def _emit_changes(self, ch: Dict[str, Any],
                       barrier: Barrier) -> Iterator[Message]:
@@ -249,14 +301,26 @@ class DeviceHashAggExecutor(UnaryExecutor):
         idxs = np.flatnonzero(live)
         if len(idxs) == 0:
             return
+        # per-call extreme arrays (encoded) for old/new formatting; min and
+        # max calls over one column read opposite ends of a shared multiset
+        mm_old: Dict[int, np.ndarray] = {}
+        mm_new: Dict[int, np.ndarray] = {}
+        for ci, dc in enumerate(self.spec.calls):
+            if dc.minput is None:
+                continue
+            sub = ch[f"minput{dc.minput}"]
+            which = ("old_max", "new_max") if self.calls[ci].kind == "max" \
+                else ("old_min", "new_min")
+            mm_old[ci] = np.asarray(sub[which[0]]).reshape(-1)
+            mm_new[ci] = np.asarray(sub[which[1]]).reshape(-1)
         key_tuples = self.codec.decode(keys[idxs])
         out = StreamChunkBuilder(self.schema.dtypes)
         for i, kt in zip(idxs.tolist(), key_tuples):
             of, nf = bool(old_found[i]), bool(new_found[i])
             if nf:
-                new_row = kt + self._format_row(new_vals, i)
+                new_row = kt + self._format_row(new_vals, i, mm_new)
             if of and nf:
-                old_row = kt + self._format_row(old_vals, i)
+                old_row = kt + self._format_row(old_vals, i, mm_old)
                 if old_row != new_row:
                     out.append_update(old_row, new_row)
                 self._persist(kt, new_vals, i)
@@ -264,15 +328,40 @@ class DeviceHashAggExecutor(UnaryExecutor):
                 out.append_row(Op.INSERT, new_row)
                 self._persist(kt, new_vals, i)
             else:  # group died this epoch
-                out.append_row(Op.DELETE, kt + self._format_row(old_vals, i))
+                out.append_row(Op.DELETE,
+                               kt + self._format_row(old_vals, i, mm_old))
                 if self.state_table is not None:
                     self.state_table.delete(
                         kt + tuple(self._payload_tuple(old_vals, i)))
+        self._persist_minputs(ch)
         dead = idxs[old_found[idxs] & ~new_found[idxs]]
         if len(dead):
             self.codec.forget(keys[dead])
         for chunk in out.drain():
             yield chunk
+
+    def _persist_minputs(self, ch: Dict[str, Any]) -> None:
+        """Upsert/delete the touched (group, value, count) multiset pairs
+        into the per-minput state tables (decode before dead-key forget)."""
+        if not self.minput_tables:
+            return
+        from ..device.sorted_state import EMPTY_KEY
+        for mi in range(len(self.spec.minputs)):
+            sub = ch[f"minput{mi}"]
+            u1 = np.asarray(sub["u1"]).reshape(-1)
+            u2 = np.asarray(sub["u2"]).reshape(-1)
+            uc = np.asarray(sub["u_cnt"]).reshape(-1)
+            sel = np.flatnonzero(u1 != EMPTY_KEY)
+            if len(sel) == 0:
+                continue
+            gts = self.codec.decode(u1[sel])
+            tbl = self.minput_tables[mi]
+            for j, gt in zip(sel.tolist(), gts):
+                row = gt + (int(u2[j]), int(uc[j]))
+                if uc[j] == 0:
+                    tbl.delete(row)
+                else:
+                    tbl.insert(row)
 
     def _payload_tuple(self, vals: Sequence[np.ndarray], i: int) -> List[Any]:
         out = []

@@ -66,3 +66,35 @@ def reshard_state(state: SortedState, kinds, new_mesh: Mesh,
         jax.device_put(new_keys, sharding),
         jax.device_put(counts.astype(np.int32), sharding),
         tuple(jax.device_put(v, sharding) for v in new_vals))
+
+
+def reshard_multiset(ms, new_mesh: Mesh, vnode_count: int = VNODE_COUNT,
+                     min_capacity: int = 64):
+    """Redistribute a [n_old, C] sharded SortedMultiset (retractable
+    min/max side state) onto `new_mesh` — pairs follow their GROUP key's
+    vnode, the same routing as the main state's rows."""
+    from ..device.minput import SortedMultiset
+    n_new = new_mesh.devices.size
+    k1 = np.asarray(ms.k1).reshape(-1)
+    k2 = np.asarray(ms.k2).reshape(-1)
+    cnt = np.asarray(ms.cnt).reshape(-1)
+    live = k1 != EMPTY_KEY
+    k1, k2, cnt = k1[live], k2[live], cnt[live]
+    dest = shard_of_vnode(_vnode_of_keys(k1, vnode_count), n_new, vnode_count)
+    counts = np.bincount(dest, minlength=n_new)
+    cap = max(min_capacity, 1 << int(max(1, counts.max()) - 1).bit_length())
+    nk1 = np.full((n_new, cap), EMPTY_KEY, dtype=np.int64)
+    nk2 = np.full((n_new, cap), EMPTY_KEY, dtype=np.int64)
+    ncnt = np.zeros((n_new, cap), dtype=np.int64)
+    for s in range(n_new):
+        sel = dest == s
+        order = np.lexsort((k2[sel], k1[sel]))
+        n = int(sel.sum())
+        nk1[s, :n] = k1[sel][order]
+        nk2[s, :n] = k2[sel][order]
+        ncnt[s, :n] = cnt[sel][order]
+    sharding = NamedSharding(new_mesh, P(SHARD_AXIS))
+    return SortedMultiset(
+        jax.device_put(nk1, sharding), jax.device_put(nk2, sharding),
+        jax.device_put(counts.astype(np.int32), sharding),
+        jax.device_put(ncnt, sharding))

@@ -24,7 +24,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.vnode import VNODE_COUNT, compute_vnodes_jnp
-from ..device.agg_step import DeviceAggSpec, _acc_cast, _bucket, epoch_core
+from ..device.agg_step import (DeviceAggSpec, DeviceAggState, _acc_cast,
+                               _bucket, epoch_core_full)
+from ..device.minput import SortedMultiset, ms_make
 from ..device.sorted_state import EMPTY_KEY, SortedState, sanitize_keys
 from .mesh import SHARD_AXIS, shard_of_vnode
 
@@ -65,11 +67,14 @@ def make_sharded_agg_step(spec: DeviceAggSpec, mesh: Mesh,
     n = mesh.devices.size
     ncalls = len(spec.calls)
     npay = len(spec.kinds)
+    nms = len(spec.minputs)
 
-    def local_step(state, keys, signs, mask, inputs):
+    def local_step(state, minputs, keys, signs, mask, inputs):
         # shard_map gives [1, ...] slices; drop the leading mesh axis
         st = SortedState(state.keys[0], state.count[0],
                          tuple(v[0] for v in state.vals))
+        mss = tuple(SortedMultiset(m.k1[0], m.k2[0], m.count[0], m.cnt[0])
+                    for m in minputs)
         keys, signs, mask = keys[0], signs[0], mask[0]
         inputs = tuple((v[0], m[0]) for v, m in inputs)
         b = keys.shape[0]
@@ -94,41 +99,48 @@ def make_sharded_agg_step(spec: DeviceAggSpec, mesh: Mesh,
                         for i in range(ncalls))
 
         # ---- per-shard agg epoch apply (shared core with agg_step) ----
-        new_st, needed, ch = epoch_core(spec, st, rkeys, rsigns, rmask,
-                                        rinputs)
+        full = DeviceAggState(st, mss)
+        new_full, (needed, ms_needed), ch = epoch_core_full(
+            spec, full, rkeys, rsigns, rmask, rinputs)
 
         ex = lambda x: x[None]    # re-add the mesh axis for out_specs
         changes = jax.tree_util.tree_map(
             ex, {**ch, "count": ch["count"][None]})
+        new_st = new_full.main
         out_state = SortedState(ex(new_st.keys), ex(new_st.count),
                                 tuple(ex(v) for v in new_st.vals))
-        return out_state, ex(needed[None]), changes
+        out_ms = tuple(SortedMultiset(ex(m.k1), ex(m.k2), ex(m.count),
+                                      ex(m.cnt)) for m in new_full.minputs)
+        return (out_state, out_ms, ex(needed[None]),
+                tuple(ex(nd[None]) for nd in ms_needed), changes)
 
     sharded = P(SHARD_AXIS)
 
-    def step(state, keys, signs, mask, inputs):
-        in_specs = (
-            SortedState(sharded, sharded,
-                        tuple(sharded for _ in state.vals)),
-            sharded, sharded, sharded,
-            tuple((sharded, sharded) for _ in inputs),
-        )
-        out_specs = (
-            SortedState(sharded, sharded,
-                        tuple(sharded for _ in state.vals)),
-            sharded,
-            {"keys": sharded, "count": sharded,
-             "old_found": sharded, "new_found": sharded,
-             "old_out": tuple(sharded for _ in range(ncalls)),
-             "old_null": tuple(sharded for _ in range(ncalls)),
-             "new_out": tuple(sharded for _ in range(ncalls)),
-             "new_null": tuple(sharded for _ in range(ncalls)),
-             "old_vals": tuple(sharded for _ in range(npay)),
-             "new_vals": tuple(sharded for _ in range(npay))},
-        )
+    def step(state, minputs, keys, signs, mask, inputs):
+        main_spec = SortedState(sharded, sharded,
+                                tuple(sharded for _ in state.vals))
+        ms_spec = tuple(SortedMultiset(sharded, sharded, sharded, sharded)
+                        for _ in range(nms))
+        in_specs = (main_spec, ms_spec, sharded, sharded, sharded,
+                    tuple((sharded, sharded) for _ in inputs))
+        ch_spec = {"keys": sharded, "count": sharded,
+                   "old_found": sharded, "new_found": sharded,
+                   "old_out": tuple(sharded for _ in range(ncalls)),
+                   "old_null": tuple(sharded for _ in range(ncalls)),
+                   "new_out": tuple(sharded for _ in range(ncalls)),
+                   "new_null": tuple(sharded for _ in range(ncalls)),
+                   "old_vals": tuple(sharded for _ in range(npay)),
+                   "new_vals": tuple(sharded for _ in range(npay))}
+        for mi in range(nms):
+            ch_spec[f"minput{mi}"] = {
+                k: sharded for k in ("old_found", "old_min", "old_max",
+                                     "new_found", "new_min", "new_max",
+                                     "u1", "u2", "u_cnt")}
+        out_specs = (main_spec, ms_spec, sharded,
+                     tuple(sharded for _ in range(nms)), ch_spec)
         fn = jax.shard_map(local_step, mesh=mesh,
                            in_specs=in_specs, out_specs=out_specs)
-        return fn(state, keys, signs, mask, inputs)
+        return fn(state, minputs, keys, signs, mask, inputs)
 
     return jax.jit(step)
 
@@ -145,6 +157,8 @@ class ShardedHashAgg:
         self._step = make_sharded_agg_step(spec, mesh, vnode_count)
         self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self.state = self._make_state(capacity)
+        self.minputs: Tuple[SortedMultiset, ...] = tuple(
+            self._make_minput(capacity) for _ in spec.minputs)
         self._rows: List[Tuple[np.ndarray, ...]] = []
 
     def _make_state(self, capacity: int) -> SortedState:
@@ -156,6 +170,53 @@ class ShardedHashAgg:
         cnt = jax.device_put(np.zeros(self.n, np.int32), self._sharding)
         return SortedState(tile(st.keys), cnt,
                            tuple(tile(v) for v in st.vals))
+
+    def _make_minput(self, capacity: int) -> SortedMultiset:
+        ms = ms_make(capacity)
+        tile = lambda x: jax.device_put(
+            np.broadcast_to(np.asarray(x)[None],
+                            (self.n,) + x.shape).copy(), self._sharding)
+        cnt = jax.device_put(np.zeros(self.n, np.int32), self._sharding)
+        return SortedMultiset(tile(ms.k1), tile(ms.k2), cnt, tile(ms.cnt))
+
+    def _grow_minput(self, mi: int, capacity: int) -> None:
+        ms = self.minputs[mi]
+        pad = capacity - ms.k1.shape[1]
+        padk = np.full((self.n, pad), EMPTY_KEY, dtype=np.int64)
+        padc = np.zeros((self.n, pad), dtype=np.int64)
+        put = lambda a, p: jax.device_put(
+            np.concatenate([np.asarray(a), p], 1), self._sharding)
+        new = SortedMultiset(put(ms.k1, padk), put(ms.k2, padk),
+                             ms.count, put(ms.cnt, padc))
+        self.minputs = self.minputs[:mi] + (new,) + self.minputs[mi + 1:]
+
+    def load_minput(self, mi: int, k1: np.ndarray, k2: np.ndarray,
+                    cnt: np.ndarray) -> None:
+        """Recovery: place (group, value, count) pairs on the shard owning
+        the GROUP key's vnode (same routing as the main state)."""
+        from ..core.vnode import crc32_bytes_matrix, _int_key_bytes
+        k1 = sanitize_keys(np.asarray(k1, np.int64))
+        k2 = np.asarray(k2, np.int64)   # values are k1-discriminated
+        cnt = np.asarray(cnt, np.int64)
+        vn = crc32_bytes_matrix(_int_key_bytes(k1)) % np.uint32(
+            self.vnode_count)
+        dest = shard_of_vnode(vn.astype(np.int64), self.n, self.vnode_count)
+        per = [np.flatnonzero(dest == s) for s in range(self.n)]
+        cap = _bucket(max([len(i) for i in per]
+                          + [self.minputs[mi].k1.shape[1]]))
+        gk1 = np.full((self.n, cap), EMPTY_KEY, np.int64)
+        gk2 = np.full((self.n, cap), EMPTY_KEY, np.int64)
+        gc = np.zeros((self.n, cap), np.int64)
+        counts = np.zeros(self.n, np.int32)
+        for s, idx in enumerate(per):
+            order = idx[np.lexsort((k2[idx], k1[idx]))]
+            counts[s] = len(order)
+            gk1[s, : len(order)] = k1[order]
+            gk2[s, : len(order)] = k2[order]
+            gc[s, : len(order)] = cnt[order]
+        put = lambda a: jax.device_put(a, self._sharding)
+        new = SortedMultiset(put(gk1), put(gk2), put(counts), put(gc))
+        self.minputs = self.minputs[:mi] + (new,) + self.minputs[mi + 1:]
 
     @property
     def capacity(self) -> int:
@@ -218,9 +279,11 @@ class ShardedHashAgg:
         """Barrier-synchronized elastic re-shard onto a different mesh
         (`scale.rs:2329` analog). Epoch buffers must be flushed first."""
         assert not self._rows, "rescale must happen at a barrier boundary"
-        from .rescale import reshard_state
+        from .rescale import reshard_multiset, reshard_state
         self.state = reshard_state(self.state, self.spec.kinds, new_mesh,
                                    self.vnode_count)
+        self.minputs = tuple(reshard_multiset(m, new_mesh, self.vnode_count)
+                             for m in self.minputs)
         self.mesh = new_mesh
         self.n = new_mesh.devices.size
         self._step = make_sharded_agg_step(self.spec, new_mesh,
@@ -251,11 +314,20 @@ class ShardedHashAgg:
         gins = tuple((shard2d(_acc_cast(v), 0),
                       shard2d(m.astype(bool), False)) for v, m in ins)
         while True:
-            new_state, needed, changes = self._step(
-                self.state, gkeys, gsigns, mask, gins)
+            new_state, new_ms, needed, ms_needed, changes = self._step(
+                self.state, self.minputs, gkeys, gsigns, mask, gins)
+            grown = False
             nmax = int(np.max(np.asarray(needed)))
-            if nmax <= self.capacity:
-                self.state = new_state
-                break
-            self._grow(_bucket(nmax, lo=self.capacity * 2))
-        return jax.tree_util.tree_map(np.asarray, changes)
+            if nmax > self.capacity:
+                self._grow(_bucket(nmax, lo=self.capacity * 2))
+                grown = True
+            for mi, nd in enumerate(ms_needed):
+                m = int(np.max(np.asarray(nd)))
+                cap = self.minputs[mi].k1.shape[1]
+                if m > cap:
+                    self._grow_minput(mi, _bucket(m, lo=cap * 2))
+                    grown = True
+            if grown:
+                continue
+            self.state, self.minputs = new_state, new_ms
+            return jax.tree_util.tree_map(np.asarray, changes)

@@ -265,17 +265,27 @@ class Planner:
                        calls: List[AggCall], gdtypes: List[DataType],
                        eowc: bool = False, wc: Optional[int] = None
                        ) -> Executor:
-        """Device-vs-host HashAgg dispatch. Both paths allocate exactly one
-        state table so table ids stay aligned across DDL-log replay."""
+        """Device-vs-host HashAgg dispatch. State-table allocation order is
+        deterministic PER DISPATCH POLICY (host: one pickled-state table;
+        device: payload table + one table per min/max input column), and the
+        policy is recorded in the data directory and validated on reopen
+        (Database._check_device_marker) — so DDL-log replay always re-runs
+        under the policy that shaped the tables."""
         from ..ops.device_agg import (DeviceHashAggExecutor,
                                       device_agg_eligible,
+                                      device_minput_count,
                                       device_payload_dtypes)
         if self.device is not None and not eowc \
                 and device_agg_eligible(calls, self.device.minmax):
             st = self.make_state(gdtypes + device_payload_dtypes(calls),
                                  list(range(len(group_indices))))
+            # one (group..., encoded value, count) table per retractable
+            # min/max call — pk covers group + value
+            mts = [self.make_state(gdtypes + [T.INT64, T.INT64],
+                                   list(range(len(group_indices) + 1)))
+                   for _ in range(device_minput_count(calls))]
             return DeviceHashAggExecutor(input, group_indices, calls,
-                                         state_table=st,
+                                         state_table=st, minput_tables=mts,
                                          mesh=self.device.mesh,
                                          capacity=self.device.capacity)
         st = self.make_state(gdtypes + [T.BYTEA],

@@ -114,6 +114,74 @@ def test_device_agg_nexmark_parity_sharded():
     assert a == b and len(a) > 10
 
 
+@pytest.mark.parametrize("device", DEVICES[1:])
+def test_device_minmax_retractable(device):
+    """min/max with deletes/updates: the sorted-multiset (minput.rs analog)
+    recovers the next extreme exactly — no host fallback."""
+    rng = np.random.default_rng(11)
+    host, dev = _mk("off"), _mk(device)
+    both = (host, dev)
+    _mirror(both, "CREATE TABLE t (k INT, v BIGINT, f DOUBLE)")
+    _mirror(both, "CREATE MATERIALIZED VIEW mv AS SELECT k, min(v) AS mn, "
+            "max(v) AS mx, min(f) AS fmn, max(f) AS fmx, count(*) AS c "
+            "FROM t GROUP BY k")
+    for _ in range(4):
+        rows = []
+        for _ in range(30):
+            k = int(rng.integers(0, 5))
+            v = "NULL" if rng.random() < 0.1 else int(rng.integers(-50, 50))
+            f = round(float(rng.standard_normal()), 3)
+            rows.append(f"({k}, {v}, {f})")
+        _mirror(both, f"INSERT INTO t VALUES {', '.join(rows)}")
+        _mirror(both, f"DELETE FROM t WHERE v > {int(rng.integers(0, 40))} "
+                f"AND k = {int(rng.integers(0, 5))}")
+        _mirror(both, f"UPDATE t SET v = v - 7 WHERE k = "
+                f"{int(rng.integers(0, 5))}")
+    a = sorted(host.query("SELECT * FROM mv"), key=repr)
+    b = sorted(dev.query("SELECT * FROM mv"), key=repr)
+    assert a == b and len(a) > 0
+
+
+def test_device_minmax_extreme_values_exact():
+    """int64 max/min as aggregate VALUES must round-trip exactly (values are
+    k1-discriminated in the multiset, never sentinel-remapped)."""
+    host, dev = _mk("off"), _mk("on")
+    both = (host, dev)
+    _mirror(both, "CREATE TABLE t (k INT, v BIGINT)")
+    _mirror(both, "CREATE MATERIALIZED VIEW mv AS SELECT k, min(v) AS mn, "
+            "max(v) AS mx FROM t GROUP BY k")
+    big, small = 2**63 - 1, -(2**63) + 1
+    _mirror(both, f"INSERT INTO t VALUES (1, {big}), (1, {small}), (1, 0)")
+    assert sorted(dev.query("SELECT * FROM mv")) == \
+        sorted(host.query("SELECT * FROM mv")) == [(1, small, big)]
+    _mirror(both, f"DELETE FROM t WHERE v = {big}")
+    assert sorted(dev.query("SELECT * FROM mv")) == [(1, small, 0)]
+
+
+def test_minmax_same_column_share_one_multiset():
+    from risingwave_tpu.expr import AggCall, InputRef
+    from risingwave_tpu.core import dtypes as T
+    from risingwave_tpu.ops.device_agg import _build_sql_spec
+    calls = [AggCall("min", InputRef(1, T.INT64)),
+             AggCall("max", InputRef(1, T.INT64)),
+             AggCall("max", InputRef(2, T.INT64))]
+    spec = _build_sql_spec(calls)
+    assert len(spec.minputs) == 2   # v-column shared, second column separate
+
+
+@pytest.mark.parametrize("device", ["on", 8])
+def test_device_minmax_recovery(tmp_path, device):
+    d = str(tmp_path)
+    db = Database(data_dir=d, device=device)
+    db.run("CREATE TABLE t (k INT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, max(v) AS m "
+           "FROM t GROUP BY k")
+    db.run("INSERT INTO t VALUES (1, 10), (1, 20), (2, 7)")
+    db2 = Database(data_dir=d, device=device)
+    db2.run("DELETE FROM t WHERE v = 20")   # retract the recovered max
+    assert sorted(db2.query("SELECT * FROM mv")) == [(1, 10), (2, 7)]
+
+
 def test_planner_lowers_eligible_fragment_to_device():
     """The dispatch seam actually engages: the MV's executor tree contains a
     DeviceHashAggExecutor when the device path is on (grep-proof for
