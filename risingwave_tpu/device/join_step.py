@@ -132,14 +132,17 @@ def probe(side: JoinSide, qjk, qmask, m: int):
     return row_c, jnp.clip(sidx, 0, side.jk.shape[0] - 1), mask, total
 
 
-@partial(jax.jit, static_argnames=("m",))
-def join_epoch_step(a: JoinSide, b: JoinSide,
-                    a_jk, a_pk, a_sign, a_mask, a_vals,
-                    b_jk, b_pk, b_sign, b_mask, b_vals, m: int):
+def join_core(a: JoinSide, b: JoinSide,
+              a_jk, a_pk, a_sign, a_mask, a_vals,
+              b_jk, b_pk, b_sign, b_mask, b_vals, m: int):
     """One epoch of both sides' rows -> (new states, pair change set).
+    Unjitted core, shared by the single-chip step below and the shard-local
+    body of parallel/sharded_join.py.
 
     Pair change set: for each emitted pair, sign = producing delta's sign
-    (+1 insert pair, -1 retract pair); payloads gathered from both sides.
+    (+1 insert pair, -1 retract pair); payloads gathered from both sides,
+    plus both sides' pks so a payload-free (SQL) run can materialize rows
+    host-side.
     """
     dajk, dapk, dasign, davals = batch_reduce_rows(a_jk, a_pk, a_sign,
                                                    a_mask, a_vals)
@@ -150,6 +153,7 @@ def join_epoch_step(a: JoinSide, b: JoinSide,
     out1 = {
         "sign": jnp.where(m1, dasign[r1], 0),
         "jk": dajk[r1],
+        "a_pk": dapk[r1], "b_pk": b.pk[s1],
         "a_vals": tuple(v[r1] for v in davals),
         "b_vals": tuple(v[s1] for v in b.vals),
         "mask": m1,
@@ -161,6 +165,7 @@ def join_epoch_step(a: JoinSide, b: JoinSide,
     out2 = {
         "sign": jnp.where(m2, dbsign[r2], 0),
         "jk": dbjk[r2],
+        "a_pk": new_a.pk[s2], "b_pk": dbpk[r2],
         "a_vals": tuple(v[s2] for v in new_a.vals),
         "b_vals": tuple(v[r2] for v in dbvals),
         "mask": m2,
@@ -168,6 +173,14 @@ def join_epoch_step(a: JoinSide, b: JoinSide,
     needed = {"a": needed_a, "b": needed_b,
               "pairs": jnp.maximum(need1, need2)}
     return new_a, new_b, out1, out2, needed
+
+
+@partial(jax.jit, static_argnames=("m",))
+def join_epoch_step(a: JoinSide, b: JoinSide,
+                    a_jk, a_pk, a_sign, a_mask, a_vals,
+                    b_jk, b_pk, b_sign, b_mask, b_vals, m: int):
+    return join_core(a, b, a_jk, a_pk, a_sign, a_mask, a_vals,
+                     b_jk, b_pk, b_sign, b_mask, b_vals, m)
 
 
 class DeviceHashJoin:
@@ -179,6 +192,31 @@ class DeviceHashJoin:
         self.b = make_side(capacity, b_dtypes)
         self.m = pair_capacity
         self._buf = {"a": [], "b": []}
+
+    def load_side(self, side: str, jk, pk, vals=()) -> None:
+        """Recovery: install a side's (jk, pk, payload...) rows as current
+        state (sorted by (jk, pk))."""
+        jk = sanitize_keys(np.asarray(jk, np.int64))
+        pk = sanitize_keys(np.asarray(pk, np.int64))
+        order = np.lexsort((pk, jk))
+        n = len(jk)
+        cur = self.a if side == "a" else self.b
+        from .agg_step import _bucket
+        cap = _bucket(max(n, cur.jk.shape[0]))
+        gjk = np.full(cap, EMPTY_KEY, np.int64)
+        gpk = np.full(cap, EMPTY_KEY, np.int64)
+        gjk[:n], gpk[:n] = jk[order], pk[order]
+        gvals = []
+        for v0, v in zip(cur.vals, vals):
+            arr = np.zeros(cap, np.asarray(v0).dtype)
+            arr[:n] = np.asarray(v)[order]
+            gvals.append(jnp.asarray(arr))
+        new = JoinSide(jnp.asarray(gjk), jnp.asarray(gpk),
+                       jnp.asarray(np.int32(n)), tuple(gvals))
+        if side == "a":
+            self.a = new
+        else:
+            self.b = new
 
     def push_rows(self, side: str, jk, pk, signs, vals) -> None:
         self._buf[side].append((sanitize_keys(np.asarray(jk, np.int64)),

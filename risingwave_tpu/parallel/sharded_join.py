@@ -1,0 +1,241 @@
+"""Sharded hash-join: two-sided exchange + per-shard join as ONE jitted step.
+
+Device analog of the reference's north-star two-sided join path
+(`dispatch.rs:843` hash dispatch on both inputs -> `merge.rs:235` alignment
+-> `hash_join.rs:575-686` eq-join): inside a `shard_map` over the mesh each
+shard
+
+  1. CRC32-hashes BOTH sides' local rows by join key -> destination shards,
+  2. buckets each side into a [n_shards, B] send buffer,
+  3. two `lax.all_to_all`s swap the buckets over ICI,
+  4. runs the sorted-multimap join epoch (`device/join_step.join_core`) on
+     its own state shards.
+
+Both sides route by the same key hash, so every (jk-equal) pair meets on
+exactly one shard and the pair change set is exchange-free afterwards.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.vnode import VNODE_COUNT, compute_vnodes_jnp
+from ..device.agg_step import _acc_cast, _bucket
+from ..device.join_step import (JoinSide, grow_side, join_core, make_side,
+                                sanitize_keys)
+from ..device.sorted_state import EMPTY_KEY
+from .mesh import SHARD_AXIS, shard_of_vnode
+from .sharded_agg import _bucketize
+
+
+def make_sharded_join_step(n_a_vals: int, n_b_vals: int, mesh: Mesh,
+                           m: int, vnode_count: int = VNODE_COUNT):
+    """Jitted distributed join epoch step. All arrays sharded on axis 0:
+    sides' states are JoinSides of [n_shards, C] arrays; each input side is
+    ([n_shards, B] jk/pk/sign/mask, tuple of [n_shards, B] vals)."""
+    n = mesh.devices.size
+
+    def exchange(jk, pk, signs, mask, vals):
+        vn = compute_vnodes_jnp(jk, vnode_count)
+        dest = shard_of_vnode(vn, n, vnode_count).astype(jnp.int32)
+        flat = [jk, pk, signs.astype(jnp.int32)]
+        fills: List[Any] = [EMPTY_KEY, EMPTY_KEY, 0]
+        for v in vals:
+            flat.append(v)
+            fills.append(0)
+        bufs = _bucketize(dest, mask, n, flat, fills)
+        recv = [jax.lax.all_to_all(x, SHARD_AXIS, split_axis=0,
+                                   concat_axis=0, tiled=False) for x in bufs]
+        rb = n * jk.shape[0]
+        rjk = recv[0].reshape(rb)
+        rpk = recv[1].reshape(rb)
+        rsign = recv[2].reshape(rb)
+        rmask = rjk != EMPTY_KEY
+        rvals = tuple(r.reshape(rb) for r in recv[3:])
+        return rjk, rpk, rsign, rmask, rvals
+
+    def local_step(a, b, a_in, b_in):
+        drop = lambda s: JoinSide(s.jk[0], s.pk[0], s.count[0],
+                                  tuple(v[0] for v in s.vals))
+        sa, sb = drop(a), drop(b)
+        # unpack [1, ...] shard slices
+        a_jk, a_pk, a_sg, a_mask = (a_in[0][0], a_in[1][0], a_in[2][0],
+                                    a_in[3][0])
+        a_vals = tuple(v[0] for v in a_in[4])
+        b_jk, b_pk, b_sg, b_mask = (b_in[0][0], b_in[1][0], b_in[2][0],
+                                    b_in[3][0])
+        b_vals = tuple(v[0] for v in b_in[4])
+
+        ra = exchange(a_jk, a_pk, a_sg, a_mask, a_vals)
+        rb = exchange(b_jk, b_pk, b_sg, b_mask, b_vals)
+
+        new_a, new_b, o1, o2, needed = join_core(
+            sa, sb, ra[0], ra[1], ra[2], ra[3], ra[4],
+            rb[0], rb[1], rb[2], rb[3], rb[4], m)
+
+        ex = lambda x: x[None]
+        lift = lambda s: JoinSide(ex(s.jk), ex(s.pk), ex(s.count),
+                                  tuple(ex(v) for v in s.vals))
+        o1 = jax.tree_util.tree_map(ex, o1)
+        o2 = jax.tree_util.tree_map(ex, o2)
+        needed = jax.tree_util.tree_map(lambda x: ex(x[None]), needed)
+        return lift(new_a), lift(new_b), o1, o2, needed
+
+    sharded = P(SHARD_AXIS)
+
+    def step(a, b, a_in, b_in):
+        side_spec = lambda s: JoinSide(sharded, sharded, sharded,
+                                       tuple(sharded for _ in s.vals))
+        in_spec = lambda nv: (sharded, sharded, sharded, sharded,
+                              tuple(sharded for _ in range(nv)))
+        out_pairs = lambda nv_a, nv_b: {
+            "sign": sharded, "jk": sharded, "a_pk": sharded, "b_pk": sharded,
+            "a_vals": tuple(sharded for _ in range(nv_a)),
+            "b_vals": tuple(sharded for _ in range(nv_b)),
+            "mask": sharded}
+        in_specs = (side_spec(a), side_spec(b),
+                    in_spec(n_a_vals), in_spec(n_b_vals))
+        out_specs = (side_spec(a), side_spec(b),
+                     out_pairs(n_a_vals, n_b_vals),
+                     out_pairs(n_a_vals, n_b_vals),
+                     {"a": sharded, "b": sharded, "pairs": sharded})
+        fn = jax.shard_map(local_step, mesh=mesh,
+                           in_specs=in_specs, out_specs=out_specs)
+        return fn(a, b, a_in, b_in)
+
+    return jax.jit(step)
+
+
+class ShardedHashJoin:
+    """Host wrapper: sharded two-sided state + epoch buffering + growth.
+    API-compatible with device/join_step.DeviceHashJoin."""
+
+    def __init__(self, a_dtypes: Sequence, b_dtypes: Sequence, mesh: Mesh,
+                 capacity: int = 1024, pair_capacity: int = 4096,
+                 vnode_count: int = VNODE_COUNT):
+        self.mesh = mesh
+        self.n = mesh.devices.size
+        self.vnode_count = vnode_count
+        self.m = pair_capacity
+        self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self.a = self._make_side(capacity, a_dtypes)
+        self.b = self._make_side(capacity, b_dtypes)
+        self._steps: Dict[int, Any] = {}
+        self._buf: Dict[str, List] = {"a": [], "b": []}
+
+    def _make_side(self, capacity: int, dtypes: Sequence) -> JoinSide:
+        s = make_side(capacity, dtypes)
+        tile = lambda x: jax.device_put(
+            np.broadcast_to(np.asarray(x)[None],
+                            (self.n,) + x.shape).copy(), self._sharding)
+        cnt = jax.device_put(np.zeros(self.n, np.int32), self._sharding)
+        return JoinSide(tile(s.jk), tile(s.pk), cnt,
+                        tuple(tile(v) for v in s.vals))
+
+    def _grow_side(self, which: str, capacity: int) -> None:
+        s = getattr(self, which)
+        pad = capacity - s.jk.shape[1]
+        padk = np.full((self.n, pad), EMPTY_KEY, dtype=np.int64)
+        put = lambda arr, p: jax.device_put(
+            np.concatenate([np.asarray(arr), p], 1), self._sharding)
+        vals = tuple(put(v, np.zeros((self.n, pad), np.asarray(v).dtype))
+                     for v in s.vals)
+        setattr(self, which, JoinSide(put(s.jk, padk), put(s.pk, padk),
+                                      s.count, vals))
+
+    def load_side(self, side: str, jk, pk, vals=()) -> None:
+        """Recovery: place rows on the shard owning their join key's vnode."""
+        from ..core.vnode import crc32_bytes_matrix, _int_key_bytes
+        which = "a" if side == "a" else "b"
+        cur = getattr(self, which)
+        jk = sanitize_keys(np.asarray(jk, np.int64))
+        pk = sanitize_keys(np.asarray(pk, np.int64))
+        vn = crc32_bytes_matrix(_int_key_bytes(jk)) % np.uint32(
+            self.vnode_count)
+        dest = shard_of_vnode(vn.astype(np.int64), self.n, self.vnode_count)
+        per = [np.flatnonzero(dest == s) for s in range(self.n)]
+        cap = _bucket(max([len(i) for i in per] + [cur.jk.shape[1]]))
+        gjk = np.full((self.n, cap), EMPTY_KEY, np.int64)
+        gpk = np.full((self.n, cap), EMPTY_KEY, np.int64)
+        gvals = [np.zeros((self.n, cap), np.asarray(v).dtype)
+                 for v in cur.vals]
+        counts = np.zeros(self.n, np.int32)
+        for s, idx in enumerate(per):
+            order = idx[np.lexsort((pk[idx], jk[idx]))]
+            counts[s] = len(order)
+            gjk[s, : len(order)] = jk[order]
+            gpk[s, : len(order)] = pk[order]
+            for gv, v in zip(gvals, vals):
+                gv[s, : len(order)] = np.asarray(v)[order]
+        put = lambda a: jax.device_put(a, self._sharding)
+        setattr(self, which, JoinSide(put(gjk), put(gpk), put(counts),
+                                      tuple(put(v) for v in gvals)))
+
+    def push_rows(self, side: str, jk, pk, signs, vals) -> None:
+        self._buf[side].append((sanitize_keys(np.asarray(jk, np.int64)),
+                                sanitize_keys(np.asarray(pk, np.int64)),
+                                np.asarray(signs, np.int32),
+                                [np.asarray(v) for v in vals]))
+
+    def _shard2d(self, arr: np.ndarray, per: int, fill) -> jax.Array:
+        out = np.full((self.n, per), fill, dtype=arr.dtype)
+        for s in range(self.n):
+            piece = arr[s::self.n]
+            out[s, : len(piece)] = piece
+        return jax.device_put(out, self._sharding)
+
+    def _pack_side(self, buf, nvals, per):
+        if buf:
+            jk = np.concatenate([x[0] for x in buf])
+            pk = np.concatenate([x[1] for x in buf])
+            sg = np.concatenate([x[2] for x in buf])
+            vals = [np.concatenate([x[3][i] for x in buf])
+                    for i in range(nvals)]
+        else:
+            jk = pk = np.zeros(0, np.int64)
+            sg = np.zeros(0, np.int32)
+            vals = [np.zeros(0, np.int64)] * nvals
+        mask = np.ones(len(jk), bool)
+        return (self._shard2d(jk, per, EMPTY_KEY),
+                self._shard2d(pk, per, EMPTY_KEY),
+                self._shard2d(sg, per, 0),
+                self._shard2d(mask, per, False),
+                tuple(self._shard2d(_acc_cast(v), per, 0) for v in vals))
+
+    def flush_epoch(self):
+        na, nb = len(self.a.vals), len(self.b.vals)
+        bufs = self._buf
+        self._buf = {"a": [], "b": []}
+        total = max([sum(len(x[0]) for x in bufs[s]) for s in ("a", "b")]
+                    + [1])
+        per = _bucket(-(-total // self.n), lo=64)
+        A = self._pack_side(bufs["a"], na, per)
+        B = self._pack_side(bufs["b"], nb, per)
+        while True:
+            step = self._steps.get(self.m)
+            if step is None:
+                step = self._steps[self.m] = make_sharded_join_step(
+                    na, nb, self.mesh, self.m, self.vnode_count)
+            new_a, new_b, o1, o2, needed = step(self.a, self.b, A, B)
+            np_ = int(np.max(np.asarray(needed["pairs"])))
+            if np_ > self.m:
+                self.m = _bucket(np_, lo=self.m * 2)
+                continue
+            grown = False
+            na_ = int(np.max(np.asarray(needed["a"])))
+            nb_ = int(np.max(np.asarray(needed["b"])))
+            if na_ > self.a.jk.shape[1]:
+                self._grow_side("a", _bucket(na_, lo=self.a.jk.shape[1] * 2))
+                grown = True
+            if nb_ > self.b.jk.shape[1]:
+                self._grow_side("b", _bucket(nb_, lo=self.b.jk.shape[1] * 2))
+                grown = True
+            if grown:
+                continue
+            self.a, self.b = new_a, new_b
+            return (jax.tree_util.tree_map(np.asarray, o1),
+                    jax.tree_util.tree_map(np.asarray, o2))

@@ -361,12 +361,23 @@ class Planner:
             cond = Binder(ns).bind(node)
         ldtypes = [c.dtype for c in lns.cols]
         rdtypes = [c.dtype for c in rns.cols]
-        execu = HashJoinExecutor(
-            lexec, rexec, lkeys, rkeys, _JOIN_KIND[ref.kind], condition=cond,
-            left_state=self.make_state(ldtypes + [T.INT64],
-                                       list(range(len(ldtypes)))),
-            right_state=self.make_state(rdtypes + [T.INT64],
-                                        list(range(len(rdtypes)))))
+        # both dispatch paths share one state-table layout (row + degree,
+        # pk = whole row), so the device policy doesn't reshape join state
+        left_state = self.make_state(ldtypes + [T.INT64],
+                                     list(range(len(ldtypes))))
+        right_state = self.make_state(rdtypes + [T.INT64],
+                                      list(range(len(rdtypes))))
+        if self.device is not None and ref.kind == "inner":
+            from ..ops.device_join import DeviceHashJoinExecutor
+            execu: Executor = DeviceHashJoinExecutor(
+                lexec, rexec, lkeys, rkeys, condition=cond,
+                left_state=left_state, right_state=right_state,
+                mesh=self.device.mesh, capacity=self.device.capacity)
+        else:
+            execu = HashJoinExecutor(
+                lexec, rexec, lkeys, rkeys, _JOIN_KIND[ref.kind],
+                condition=cond,
+                left_state=left_state, right_state=right_state)
         return execu, ns
 
     # ---- SELECT ---------------------------------------------------------
