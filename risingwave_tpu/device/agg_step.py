@@ -324,6 +324,20 @@ class DeviceHashAgg:
         self.state = SortedState(jnp.asarray(new_keys),
                                  jnp.asarray(np.int32(n)), tuple(new_vals))
 
+    def live_main(self) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """Host pull of the live (key, payload...) rows — watermark state
+        cleaning filters these and re-installs via load_state."""
+        n = int(self.state.count)
+        return (np.asarray(self.state.keys)[:n],
+                [np.asarray(v)[:n] for v in self.state.vals])
+
+    def live_minput(self, mi: int) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+        ms = self.minputs[mi]
+        n = int(ms.count)
+        return (np.asarray(ms.k1)[:n], np.asarray(ms.k2)[:n],
+                np.asarray(ms.cnt)[:n])
+
     def load_minput(self, mi: int, k1: np.ndarray, k2: np.ndarray,
                     cnt: np.ndarray) -> None:
         """Recovery: install a minput multiset's (group, value, count) rows.

@@ -193,6 +193,12 @@ class DeviceHashJoin:
         self.m = pair_capacity
         self._buf = {"a": [], "b": []}
 
+    def live_side(self, side: str) -> Tuple[np.ndarray, np.ndarray]:
+        """Host pull of a side's live (jk, pk) rows (state cleaning)."""
+        s = self.a if side == "a" else self.b
+        n = int(s.count)
+        return np.asarray(s.jk)[:n], np.asarray(s.pk)[:n]
+
     def load_side(self, side: str, jk, pk, vals=()) -> None:
         """Recovery: install a side's (jk, pk, payload...) rows as current
         state (sorted by (jk, pk))."""

@@ -128,6 +128,11 @@ class HashAggExecutor(UnaryExecutor):
         # order without scanning all live groups (SortBuffer analog)
         self._window_heap: List[Tuple[Any, int, Tuple]] = []
         self._heap_seq = 0
+        # watermark-driven state cleaning (`state_table.rs:1002` analog):
+        # a watermark on a group-key column proves groups below it can
+        # never change again — their state is dropped at the next barrier
+        # (the MV keeps the rows; no retraction is emitted)
+        self._clean_wm: Optional[Tuple[int, Any]] = None   # (group_pos, val)
 
     # ---- state persistence (pickled AggGroup per group key) ----
     def _recover(self) -> None:
@@ -212,12 +217,25 @@ class HashAggExecutor(UnaryExecutor):
             for key, g in self.dirty.items():
                 self._emit_group(out, key, g)
             self.dirty.clear()
+            self._clean_state()
         for chunk in out.drain():
             yield chunk
         if wm_out is not None:
             yield wm_out
         if self.state_table is not None:
             self.state_table.commit(barrier.epoch.curr)
+
+    def _clean_state(self) -> None:
+        if self._clean_wm is None:
+            return
+        gi, wv = self._clean_wm
+        self._clean_wm = None
+        dead = [k for k in self.groups
+                if k[gi] is not None and k[gi] < wv]
+        for k in dead:
+            g = self.groups.pop(k)
+            if self.state_table is not None:
+                self.state_table.delete(k + (pickle.dumps(g),))
 
     def _emit_eowc(self, out: StreamChunkBuilder) -> None:
         """Emit only groups whose window column is closed by the watermark;
@@ -247,8 +265,9 @@ class HashAggExecutor(UnaryExecutor):
             self.window_watermark = wm.value
             self._wm_dtype = wm.dtype
         elif wm.col_idx in self.group_key_indices:
-            yield Watermark(self.group_key_indices.index(wm.col_idx), wm.dtype,
-                            wm.value)
+            gi = self.group_key_indices.index(wm.col_idx)
+            self._clean_wm = (gi, wm.value)
+            yield Watermark(gi, wm.dtype, wm.value)
 
 
 class SimpleAggExecutor(UnaryExecutor):

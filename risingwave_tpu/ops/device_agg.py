@@ -130,6 +130,7 @@ class DeviceHashAggExecutor(UnaryExecutor):
         self._recovered = state_table is None
         self._key_dtypes = [in_schema.fields[i].dtype
                             for i in group_key_indices]
+        self._clean_wm: Optional[Tuple[int, Any]] = None
 
         from ..device.key_codec import make_codec
         self.spec = _build_sql_spec(calls)
@@ -284,6 +285,7 @@ class DeviceHashAggExecutor(UnaryExecutor):
         ch = self.engine.flush_epoch()
         if ch is not None:
             yield from self._emit_changes(ch, barrier)
+        self._clean_state()
         if self.state_table is not None:
             self.state_table.commit(barrier.epoch.curr)
         for tbl in self.minput_tables:
@@ -374,7 +376,43 @@ class DeviceHashAggExecutor(UnaryExecutor):
         if self.state_table is not None:
             self.state_table.insert(kt + tuple(self._payload_tuple(vals, i)))
 
+    # ---- watermark state cleaning (state_table.rs:1002 analog) ----------
+    def _clean_state(self) -> None:
+        """Drop groups proven final by a group-key watermark: filter the
+        live device rows host-side and re-install via load_state /
+        load_minput (no retraction — the MV keeps the rows)."""
+        if self._clean_wm is None:
+            return
+        gi, wv = self._clean_wm
+        self._clean_wm = None
+        keys, vals = self.engine.live_main()
+        if len(keys) == 0:
+            return
+        tuples = self.codec.decode(keys)
+        drop = np.array([t[gi] is not None and t[gi] < wv for t in tuples])
+        if not drop.any():
+            return
+        keep = ~drop
+        self.engine.load_state(keys[keep], [v[keep] for v in vals])
+        dropped = set(keys[drop].tolist())
+        for mi in range(len(self.spec.minputs)):
+            k1, k2, cnt = self.engine.live_minput(mi)
+            mdrop = np.isin(k1, keys[drop])
+            self.engine.load_minput(mi, k1[~mdrop], k2[~mdrop], cnt[~mdrop])
+            if mi < len(self.minput_tables):
+                tbl = self.minput_tables[mi]
+                gts = self.codec.decode(k1[mdrop])
+                for gt, v in zip(gts, k2[mdrop].tolist()):
+                    tbl.delete(gt + (int(v), 0))
+        if self.state_table is not None:
+            zeros = tuple(0.0 if np.issubdtype(np.dtype(d), np.floating)
+                          else 0 for d in self.spec.dtypes)
+            for i in np.flatnonzero(drop).tolist():
+                self.state_table.delete(tuples[i] + zeros)
+        self.codec.forget(np.fromiter(dropped, dtype=np.int64))
+
     def on_watermark(self, wm: Watermark) -> Iterator[Message]:
         if wm.col_idx in self.group_key_indices:
-            yield Watermark(self.group_key_indices.index(wm.col_idx),
-                            wm.dtype, wm.value)
+            gi = self.group_key_indices.index(wm.col_idx)
+            self._clean_wm = (gi, wm.value)
+            yield Watermark(gi, wm.dtype, wm.value)

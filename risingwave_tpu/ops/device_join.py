@@ -97,6 +97,11 @@ class DeviceHashJoinExecutor(Executor):
         # live in device state) keeps its cache entry.
         self._epoch_net: Dict[str, Dict[int, Tuple[int, Tuple]]] = \
             {"a": {}, "b": {}}
+        # watermark min-alignment on equi-key pairs + state cleaning (same
+        # contract as the host HashJoinExecutor)
+        self._wm: Dict[str, Dict[int, Any]] = {"a": {}, "b": {}}
+        self._emitted_wm: Dict[int, Any] = {}
+        self._clean_wm: Dict[int, Any] = {}
 
     # ---- recovery -------------------------------------------------------
     def _recover(self) -> None:
@@ -217,6 +222,56 @@ class DeviceHashJoinExecutor(Executor):
                 st.commit(barrier.epoch.curr)
             net.clear()
 
+    def _on_watermark(self, side: str, wm: Watermark) -> Iterator[Message]:
+        """Equi-key watermark min-alignment; non-key watermarks don't
+        survive a join (old state rows resurface in the output)."""
+        keys = self.key_idx[side]
+        if wm.col_idx not in keys:
+            return
+        kp = keys.index(wm.col_idx)
+        self._wm[side][kp] = wm.value
+        ov = self._wm["b" if side == "a" else "a"].get(kp)
+        if ov is None:
+            return
+        low = min(wm.value, ov)
+        prev = self._emitted_wm.get(kp)
+        if prev is not None and low <= prev:
+            return
+        self._emitted_wm[kp] = low
+        self._clean_wm[kp] = low
+        nl = len(self.left_exec.schema)
+        yield Watermark(self.key_idx["a"][kp], wm.dtype, low)
+        yield Watermark(nl + self.key_idx["b"][kp], wm.dtype, low)
+
+    def _clean_state(self) -> None:
+        """Drop state rows below the aligned key watermark: filter the host
+        row caches, re-install the device multimaps via load_side, delete
+        the persisted rows."""
+        if not self._clean_wm:
+            return
+        for side in ("a", "b"):
+            key_cols = self.key_idx[side]
+            d = self.dicts[side]
+            dead = []
+            for h, row in d.rows.items():
+                for kp, wv in self._clean_wm.items():
+                    v = row[key_cols[kp]]
+                    if v is not None and v < wv:
+                        dead.append(h)
+                        break
+            if not dead:
+                continue
+            dead_set = set(dead)
+            st = self.state_tables[side]
+            for h in dead:
+                if st is not None:
+                    st.delete(d.rows[h] + (0,))
+                d.remove(h)
+            jk, pk = self.engine.live_side(side)
+            keep = ~np.isin(pk, np.fromiter(dead_set, dtype=np.int64))
+            self.engine.load_side(side, jk[keep], pk[keep])
+        self._clean_wm.clear()
+
     # ---- barrier-aligned two-input loop (hash_join.rs:575-686) ----------
     def execute(self) -> Iterator[Message]:
         self._recover()
@@ -238,10 +293,12 @@ class DeviceHashJoinExecutor(Executor):
                     if isinstance(msg, StreamChunk):
                         if msg.cardinality:
                             self._process_chunk(side, msg)
-                    # watermarks: min-alignment handled with task #5
+                    elif isinstance(msg, Watermark):
+                        yield from self._on_watermark(side, msg)
             if barrier is None:
                 return
             yield from self._on_barrier(barrier)
+            self._clean_state()
             yield barrier.with_trace(self.name)
             if barrier.is_stop():
                 return

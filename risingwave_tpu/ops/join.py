@@ -150,6 +150,13 @@ class HashJoinExecutor(Executor):
             "r": JoinSide(right_keys, rpk, right.schema, right_state),
         }
         self.max_chunk_size = max_chunk_size
+        # watermark min-alignment on equi-key pairs (hash_join.rs derives
+        # output watermarks ONLY for key columns: state rows below both
+        # sides' key watermark can never match again — non-key watermarks
+        # don't survive a join because old state rows resurface in output).
+        self._wm: Dict[str, Dict[int, Any]] = {"l": {}, "r": {}}
+        self._emitted_wm: Dict[int, Any] = {}
+        self._clean_wm: Dict[int, Any] = {}   # key_pos -> aligned watermark
 
     # ---- condition eval, vectorized over all candidates of one input row ----
     def _filter_matches(self, side: str, row: Tuple,
@@ -274,12 +281,56 @@ class HashJoinExecutor(Executor):
                     if isinstance(msg, StreamChunk):
                         if msg.cardinality:
                             yield from self._process_chunk(side, msg)
-                    # watermarks: min-alignment TODO; dropped for now
+                    elif isinstance(msg, Watermark):
+                        yield from self._on_watermark(side, msg)
             if barrier is None:
                 return
+            self._clean_state()
             for s in self.sides.values():
                 if s.state_table is not None:
                     s.state_table.commit(barrier.epoch.curr)
             yield barrier.with_trace(self.name)
             if barrier.is_stop():
                 return
+
+    def _on_watermark(self, side: str, wm: Watermark) -> Iterator[Message]:
+        me = self.sides[side]
+        if wm.col_idx not in me.key_indices:
+            return
+        kp = me.key_indices.index(wm.col_idx)
+        self._wm[side][kp] = wm.value
+        other = "r" if side == "l" else "l"
+        ov = self._wm[other].get(kp)
+        if ov is None:
+            return
+        low = min(wm.value, ov)
+        prev = self._emitted_wm.get(kp)
+        if prev is not None and low <= prev:
+            return
+        self._emitted_wm[kp] = low
+        self._clean_wm[kp] = low
+        nl = len(self.left_exec.schema)
+        lcol = self.sides["l"].key_indices[kp]
+        rcol = self.sides["r"].key_indices[kp]
+        if self.join_type in (JoinType.INNER, JoinType.LEFT_OUTER,
+                              JoinType.RIGHT_OUTER, JoinType.FULL_OUTER):
+            yield Watermark(lcol, wm.dtype, low)
+            yield Watermark(nl + rcol, wm.dtype, low)
+        elif self.join_type in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI):
+            yield Watermark(lcol, wm.dtype, low)
+        else:
+            yield Watermark(rcol, wm.dtype, low)
+
+    def _clean_state(self) -> None:
+        """Drop state rows below the aligned key watermark — they can never
+        match a future row on either side (`state_table.rs:1002` analog)."""
+        if not self._clean_wm:
+            return
+        for kp, wv in self._clean_wm.items():
+            for s in self.sides.values():
+                dead = [k for k in s.table
+                        if k[kp] is not None and k[kp] < wv]
+                for k in dead:
+                    for e in s.table.pop(k).values():
+                        s.delete_state(e)
+        self._clean_wm.clear()

@@ -190,6 +190,30 @@ class ShardedHashAgg:
                              ms.count, put(ms.cnt, padc))
         self.minputs = self.minputs[:mi] + (new,) + self.minputs[mi + 1:]
 
+    @staticmethod
+    def _flatten_sharded(counts: np.ndarray, arrs: Sequence[np.ndarray]
+                         ) -> List[np.ndarray]:
+        """[n, C] arrays + per-shard live counts -> concatenated live rows."""
+        pieces = [[a[s, : int(counts[s])] for s in range(len(counts))]
+                  for a in arrs]
+        return [np.concatenate(p) if p else np.zeros(0) for p in pieces]
+
+    def live_main(self) -> Tuple[np.ndarray, List[np.ndarray]]:
+        counts = np.asarray(self.state.count)
+        arrs = [np.asarray(self.state.keys)] + \
+            [np.asarray(v) for v in self.state.vals]
+        flat = self._flatten_sharded(counts, arrs)
+        return flat[0], flat[1:]
+
+    def live_minput(self, mi: int) -> Tuple[np.ndarray, np.ndarray,
+                                            np.ndarray]:
+        ms = self.minputs[mi]
+        counts = np.asarray(ms.count)
+        flat = self._flatten_sharded(counts, [np.asarray(ms.k1),
+                                              np.asarray(ms.k2),
+                                              np.asarray(ms.cnt)])
+        return flat[0], flat[1], flat[2]
+
     def load_minput(self, mi: int, k1: np.ndarray, k2: np.ndarray,
                     cnt: np.ndarray) -> None:
         """Recovery: place (group, value, count) pairs on the shard owning

@@ -147,6 +147,13 @@ class ShardedHashJoin:
         setattr(self, which, JoinSide(put(s.jk, padk), put(s.pk, padk),
                                       s.count, vals))
 
+    def live_side(self, side: str) -> Tuple[np.ndarray, np.ndarray]:
+        s = getattr(self, "a" if side == "a" else "b")
+        counts = np.asarray(s.count)
+        jks = [np.asarray(s.jk)[i, : int(counts[i])] for i in range(self.n)]
+        pks = [np.asarray(s.pk)[i, : int(counts[i])] for i in range(self.n)]
+        return np.concatenate(jks), np.concatenate(pks)
+
     def load_side(self, side: str, jk, pk, vals=()) -> None:
         """Recovery: place rows on the shard owning their join key's vnode."""
         from ..core.vnode import crc32_bytes_matrix, _int_key_bytes

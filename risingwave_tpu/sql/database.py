@@ -556,7 +556,7 @@ def _eval_const(e: A.ExprNode, dtype: Optional[DataType]):
 def _extract_delay(bound, time_idx: int) -> int:
     """WATERMARK FOR c AS c - INTERVAL '...' -> delay usecs."""
     from ..expr.expression import FunctionCall, InputRef, Literal
-    if isinstance(bound, FunctionCall) and bound.name == "subtract":
+    if isinstance(bound, FunctionCall) and "subtract" in bound.name:
         a, b = bound.args
         if isinstance(b, Literal):
             iv = b.value

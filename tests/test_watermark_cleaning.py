@@ -1,0 +1,162 @@
+"""Watermarks through joins + watermark-driven state cleaning
+(VERDICT #6: EOWC works downstream of a join; windowed state stops
+growing)."""
+import numpy as np
+import pytest
+
+from risingwave_tpu.sql import Database
+
+TS0 = 1_600_000_000_000_000  # usecs
+
+
+def _ts(sec: int) -> str:
+    import datetime
+    dt = datetime.datetime.fromtimestamp(TS0 // 1_000_000 + sec,
+                                         datetime.timezone.utc)
+    return dt.strftime("'%Y-%m-%d %H:%M:%S'")
+
+
+def _mk_joined(device):
+    db = Database(device=device)
+    db.run("CREATE TABLE a (ts TIMESTAMP, k INT, "
+           "WATERMARK FOR ts AS ts - INTERVAL '0 seconds') "
+           "WITH (connector='dml')")
+    db.run("CREATE TABLE b (ts TIMESTAMP, v BIGINT, "
+           "WATERMARK FOR ts AS ts - INTERVAL '0 seconds') "
+           "WITH (connector='dml')")
+    db.run("CREATE MATERIALIZED VIEW j AS SELECT a.ts, a.k, b.v "
+           "FROM a JOIN b ON a.ts = b.ts")
+    return db
+
+
+@pytest.mark.parametrize("device", ["off", "on", 8])
+def test_watermark_propagates_through_join(device):
+    """The join must emit the min-aligned key watermark — a downstream
+    EOWC-style consumer of the join output sees time advance."""
+    from risingwave_tpu.ops.message import Watermark
+    db = _mk_joined(device)
+    mat = db.catalog.get("j").runtime["shared"].upstream
+
+    seen = []
+    orig = mat.on_watermark
+
+    def spy(wm):
+        seen.append((wm.col_idx, wm.value))
+        return orig(wm)
+
+    mat.on_watermark = spy
+    db.run(f"INSERT INTO a VALUES ({_ts(10)}, 1)")
+    db.run(f"INSERT INTO b VALUES ({_ts(5)}, 100)")
+    db.run(f"INSERT INTO a VALUES ({_ts(20)}, 2)")
+    db.run(f"INSERT INTO b VALUES ({_ts(30)}, 200)")
+    db.run("FLUSH")
+    assert seen, "join swallowed all watermarks"
+    # aligned watermark = min(left_wm, right_wm); both output positions
+    cols = {c for c, _ in seen}
+    assert 0 in cols, "left key column watermark missing"
+    vals = [v for _, v in seen]
+    assert vals == sorted(vals), "watermark must be monotone"
+    assert max(vals) <= TS0 + 20 * 1_000_000
+
+
+@pytest.mark.parametrize("device", ["off", "on", 8])
+def test_join_state_cleaned_below_watermark(device):
+    """Rows below the aligned key watermark can never match again — both
+    sides' state must shrink (soak: bounded, not monotonic)."""
+    db = _mk_joined(device)
+    mat = db.catalog.get("j").runtime["shared"].upstream
+
+    def find_join(e):
+        stack = [e]
+        while stack:
+            x = stack.pop()
+            if type(x).__name__ in ("HashJoinExecutor",
+                                    "DeviceHashJoinExecutor"):
+                return x
+            for attr in ("input", "port", "left", "right"):
+                if getattr(x, attr, None) is not None:
+                    stack.append(getattr(x, attr))
+        raise AssertionError("join not found")
+
+    join = find_join(mat.input if hasattr(mat, "input") else mat)
+    sizes = []
+    for t in range(0, 40, 2):
+        db.run(f"INSERT INTO a VALUES ({_ts(t)}, {t})")
+        db.run(f"INSERT INTO b VALUES ({_ts(t)}, {t * 10})")
+        db.run("FLUSH")
+        if hasattr(join, "sides"):         # host path
+            n = sum(len(d) for s in join.sides.values()
+                    for d in s.table.values())
+        else:                              # device path
+            n = sum(len(join.dicts[s].rows) for s in ("a", "b"))
+        sizes.append(n)
+    # with cleaning the state stays bounded by a small constant; without
+    # it, 20 inserts/side would make 40 stored rows
+    assert sizes[-1] <= 6, sizes
+    assert max(sizes) < 12, sizes
+    # and results are still exact
+    oracle = sorted(db.query(
+        "SELECT a.ts, a.k, b.v FROM a JOIN b ON a.ts = b.ts"))
+    assert sorted(db.query("SELECT * FROM j")) == oracle
+    assert len(oracle) == 20
+
+
+@pytest.mark.parametrize("device", ["off", "on", 8])
+def test_windowed_agg_state_cleaned(device):
+    """Non-EOWC TUMBLE aggregation: group state for closed windows is
+    dropped at barriers (the MV keeps its rows)."""
+    db = Database(device=device)
+    db.run("CREATE TABLE t (ts TIMESTAMP, v BIGINT, "
+           "WATERMARK FOR ts AS ts - INTERVAL '0 seconds') "
+           "WITH (connector='dml')")
+    db.run("CREATE MATERIALIZED VIEW w AS SELECT window_start, count(*) AS c,"
+           " max(v) AS m FROM TUMBLE(t, ts, INTERVAL '2 seconds') "
+           "GROUP BY window_start")
+    mat = db.catalog.get("w").runtime["shared"].upstream
+
+    def find_agg(e):
+        stack = [e]
+        while stack:
+            x = stack.pop()
+            if type(x).__name__ in ("HashAggExecutor",
+                                    "DeviceHashAggExecutor"):
+                return x
+            for attr in ("input", "port"):
+                if getattr(x, attr, None) is not None:
+                    stack.append(getattr(x, attr))
+        raise AssertionError("agg not found")
+
+    agg = find_agg(mat)
+    sizes = []
+    for t in range(0, 60, 2):
+        db.run(f"INSERT INTO t VALUES ({_ts(t)}, {t})")
+        db.run("FLUSH")
+        if hasattr(agg, "groups"):
+            sizes.append(len(agg.groups))
+        else:
+            sizes.append(len(agg.engine.live_main()[0]))
+    assert sizes[-1] <= 4, sizes        # only open windows retain state
+    assert max(sizes) <= 6, sizes
+    # MV keeps every closed window's row
+    rows = db.query("SELECT * FROM w")
+    assert len(rows) >= 25
+    assert sum(c for _, c, _ in rows) == 30
+
+
+@pytest.mark.parametrize("device", ["off", "on"])
+def test_eowc_downstream_of_join(device):
+    """EMIT ON WINDOW CLOSE over a join: without watermark alignment in the
+    join this stalls forever (round-1 VERDICT weak point #6)."""
+    db = _mk_joined(device)
+    db.run("CREATE MATERIALIZED VIEW e AS SELECT window_start, count(*) AS c"
+           " FROM TUMBLE(j, ts, INTERVAL '4 seconds') GROUP BY window_start"
+           " EMIT ON WINDOW CLOSE")
+    for t in range(0, 20, 2):
+        db.run(f"INSERT INTO a VALUES ({_ts(t)}, {t})")
+        db.run(f"INSERT INTO b VALUES ({_ts(t)}, {t})")
+        db.run("FLUSH")
+    rows = sorted(db.query("SELECT * FROM e"))
+    # windows fully below the aligned watermark (18s) have closed: at least
+    # [0,4), [4,8), [8,12), [12,16) with 2 joined rows each
+    assert len(rows) >= 4, rows
+    assert all(c == 2 for _, c in rows), rows
