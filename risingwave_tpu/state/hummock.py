@@ -1,4 +1,4 @@
-"""SpillStateStore — durable LSM-lite state store.
+"""SpillStateStore — durable LSM state store with a real disk read path.
 
 Re-design of Hummock (`src/storage/src/hummock/`) scoped to what the TPU
 runtime needs from it:
@@ -9,38 +9,212 @@ runtime needs from it:
   epoch's per-table delta as one sorted run file, then atomically advances
   the manifest (`HummockManager::commit_epoch` analog,
   `src/meta/src/hummock/manager/commit_epoch.rs:71`);
-* recovery = replay committed runs in epoch order (uncommitted epochs
-  vanish, exactly the checkpoint contract);
-* compaction merges a table's runs into one base snapshot once the run
-  count passes a threshold (`hummock/compactor/` analog, trivially tiered);
-* reads serve from memory — host RAM is the cache tier above the spill
-  tier, the `foyer` block-cache analog; run files are never read on the
-  hot path.
+* run files are block-structured SSTs (`hummock/sstable/{builder,block}.rs`
+  analog): sorted (key, row|None) entries split into compressed blocks with
+  a sparse first-key index in the footer, so point reads touch one block
+  and range reads stream blocks — state larger than RAM stays on disk;
+* reads merge the uncommitted epoch deltas (shared-buffer analog) over the
+  committed runs newest-first; a bounded LRU block cache
+  (`block_cache.rs` / foyer analog) is the only in-memory copy of
+  committed data;
+* recovery = read the manifest; no data is loaded until referenced
+  (uncommitted epochs vanish, exactly the checkpoint contract);
+* compaction streams a k-way merge of a table's runs into one base
+  snapshot once the run count passes a threshold (`hummock/compactor/`
+  analog, trivially tiered); tombstones drop out at the base.
 
-File format: zlib-compressed pickle of the sorted (key, row|None) delta
-list. The column-aware value encoding (`core/encoding.py`) remains the
-parity-tested wire format; spill files are a private on-disk format the
+File format: blocks of zlib-compressed pickled (key, row|None) lists, then
+a pickled index [(first_key, offset, length)], then an 8-byte big-endian
+index offset. The column-aware value encoding (`core/encoding.py`) remains
+the parity-tested wire format; spill files are a private on-disk format the
 same way the reference's SST blocks are.
 """
 from __future__ import annotations
 
+import bisect
+import heapq
 import json
 import os
 import pickle
+import struct
 import zlib
+from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
-from .store import KeyedTable, MemoryStateStore
+from .store import StateStore
 
 MANIFEST = "MANIFEST.json"
 COMPACT_THRESHOLD = 8
+BLOCK_ROWS = 256           # entries per block (block.rs targets ~64KB)
+DEFAULT_CACHE_BLOCKS = 4096  # LRU capacity (~1M cached entries)
+
+_MISS = object()           # sentinel: key not present in this source
 
 
-class SpillStateStore(MemoryStateStore):
-    """Durable store: MemoryStateStore working set + epoch-run spill dir."""
+class BlockCache:
+    """Bounded LRU over decompressed blocks, keyed (run_name, block_no)."""
 
-    def __init__(self, directory: str):
-        super().__init__()
+    def __init__(self, capacity: int = DEFAULT_CACHE_BLOCKS):
+        self.capacity = capacity
+        self._blocks: "OrderedDict[Tuple[str, int], List]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Tuple[str, int]):
+        blk = self._blocks.get(key)
+        if blk is not None:
+            self._blocks.move_to_end(key)
+            self.hits += 1
+        else:
+            self.misses += 1
+        return blk
+
+    def put(self, key: Tuple[str, int], block: List) -> None:
+        self._blocks[key] = block
+        self._blocks.move_to_end(key)
+        while len(self._blocks) > self.capacity:
+            self._blocks.popitem(last=False)
+
+    def drop_run(self, name: str) -> None:
+        for k in [k for k in self._blocks if k[0] == name]:
+            del self._blocks[k]
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+
+class _RunWriter:
+    """Streaming block writer: add() in key order, finish() atomically."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = open(path + ".tmp", "wb")
+        self._index: List[Tuple[bytes, int, int]] = []
+        self._buf: List[Tuple[bytes, Optional[Tuple]]] = []
+        self._off = 0
+        self.count = 0
+
+    def add(self, key: bytes, row: Optional[Tuple]) -> None:
+        self._buf.append((key, row))
+        self.count += 1
+        if len(self._buf) >= BLOCK_ROWS:
+            self._flush_block()
+
+    def _flush_block(self) -> None:
+        if not self._buf:
+            return
+        blob = zlib.compress(pickle.dumps(self._buf, protocol=4), 1)
+        self._index.append((self._buf[0][0], self._off, len(blob)))
+        self._f.write(blob)
+        self._off += len(blob)
+        self._buf = []
+
+    def finish(self) -> None:
+        self._flush_block()
+        idx_blob = pickle.dumps((self._index, self.count), protocol=4)
+        self._f.write(idx_blob)
+        self._f.write(struct.pack(">Q", self._off))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        os.replace(self.path + ".tmp", self.path)
+
+    def abort(self) -> None:
+        self._f.close()
+        try:
+            os.remove(self.path + ".tmp")
+        except FileNotFoundError:
+            pass
+
+
+class RunReader:
+    """Block-indexed reads from one run file. The index (sparse: one key per
+    block) loads on open; blocks load on demand through the cache."""
+
+    def __init__(self, name: str, path: str, cache: BlockCache):
+        self.name = name
+        self.path = path
+        self.cache = cache
+        with open(path, "rb") as f:
+            f.seek(-8, os.SEEK_END)
+            end = f.tell()
+            (idx_off,) = struct.unpack(">Q", f.read(8))
+            f.seek(idx_off)
+            self.index, self.count = pickle.loads(f.read(end - idx_off))
+        self._first_keys = [e[0] for e in self.index]
+
+    def _block(self, i: int) -> List[Tuple[bytes, Optional[Tuple]]]:
+        blk = self.cache.get((self.name, i))
+        if blk is None:
+            _, off, length = self.index[i]
+            with open(self.path, "rb") as f:
+                f.seek(off)
+                blk = pickle.loads(zlib.decompress(f.read(length)))
+            self.cache.put((self.name, i), blk)
+        return blk
+
+    def get(self, key: bytes):
+        """Value, None (tombstone), or _MISS."""
+        i = bisect.bisect_right(self._first_keys, key) - 1
+        if i < 0:
+            return _MISS
+        blk = self._block(i)
+        j = bisect.bisect_left(blk, (key,))
+        if j < len(blk) and blk[j][0] == key:
+            return blk[j][1]
+        return _MISS
+
+    def iter_range(self, start: Optional[bytes], end: Optional[bytes]
+                   ) -> Iterator[Tuple[bytes, Optional[Tuple]]]:
+        if not self.index:
+            return
+        i = 0
+        if start is not None:
+            i = max(0, bisect.bisect_right(self._first_keys, start) - 1)
+        while i < len(self.index):
+            if end is not None and self._first_keys[i] >= end:
+                return
+            for k, v in self._block(i):
+                if start is not None and k < start:
+                    continue
+                if end is not None and k >= end:
+                    return
+                yield k, v
+            i += 1
+
+
+def _merge(sources: List[Iterator[Tuple[bytes, Optional[Tuple]]]]
+           ) -> Iterator[Tuple[bytes, Optional[Tuple]]]:
+    """K-way merge, earlier source wins on key ties (newest first) —
+    `hummock/iterator/merge_inner.rs` analog. Yields tombstones."""
+    heap: List[Tuple[bytes, int]] = []
+    cur: List[Optional[Tuple[Optional[Tuple], Iterator]]] = []
+    for pri, it in enumerate(sources):
+        nxt = next(it, None)
+        cur.append(None)
+        if nxt is not None:
+            heap.append((nxt[0], pri))
+            cur[pri] = (nxt[1], it)
+    heapq.heapify(heap)
+    last: Optional[bytes] = None
+    while heap:
+        k, pri = heapq.heappop(heap)
+        v, it = cur[pri]
+        nxt = next(it, None)
+        if nxt is not None:
+            cur[pri] = (nxt[1], it)
+            heapq.heappush(heap, (nxt[0], pri))
+        if k == last:
+            continue  # shadowed by a newer source
+        last = k
+        yield k, v
+
+
+class SpillStateStore(StateStore):
+    """Durable store: epoch-delta memtables over block-indexed spill runs."""
+
+    def __init__(self, directory: str,
+                 cache_blocks: int = DEFAULT_CACHE_BLOCKS):
         self.dir = directory
         os.makedirs(os.path.join(directory, "runs"), exist_ok=True)
         # keyed by (epoch, table) so committing epoch N persists exactly the
@@ -49,8 +223,12 @@ class SpillStateStore(MemoryStateStore):
         # vanish' recovery contract)
         self._deltas: Dict[Tuple[int, int],
                            Dict[bytes, Optional[Tuple]]] = {}
-        self._manifest: Dict[str, Any] = {"committed_epoch": 0, "tables": {}}
+        self._manifest: Dict[str, Any] = {"committed_epoch": 0, "tables": {},
+                                          "counts": {}}
         self._file_seq = 0
+        self.committed_epoch = 0
+        self.cache = BlockCache(cache_blocks)
+        self._readers: Dict[str, RunReader] = {}
         self._recover()
 
     # ---- write path -----------------------------------------------------
@@ -58,7 +236,6 @@ class SpillStateStore(MemoryStateStore):
         d = self._deltas.setdefault((epoch, table_id), {})
         for key, row in batch:
             d[key] = row
-        super().ingest_batch(table_id, batch, epoch)
 
     def commit_epoch(self, epoch):
         garbage: List[str] = []
@@ -73,9 +250,18 @@ class SpillStateStore(MemoryStateStore):
             # would silently overwrite its predecessor
             self._file_seq += 1
             name = f"t{tid}_e{epoch}_{self._file_seq}.run"
-            self._write_run(name, sorted(delta.items()))
+            w = _RunWriter(self._run_path(name))
+            for key, row in sorted(delta.items()):
+                w.add(key, row)
+            w.finish()
             runs = self._manifest["tables"].setdefault(str(tid), [])
             runs.append(name)
+            # approximate live-count bookkeeping (exact after compaction):
+            # inserts may overwrite and deletes may miss, so clamp at 0
+            cnt = self._manifest["counts"].get(str(tid), 0)
+            cnt += sum(1 if row is not None else -1
+                       for row in delta.values())
+            self._manifest["counts"][str(tid)] = max(0, cnt)
             if len(runs) > COMPACT_THRESHOLD:
                 garbage += self._compact(tid, epoch)
         self._manifest["committed_epoch"] = max(
@@ -85,24 +271,62 @@ class SpillStateStore(MemoryStateStore):
         # references them is durable (crash between compact and manifest
         # write must leave the previous version fully readable)
         self._gc(garbage)
-        super().commit_epoch(epoch)
+        self.committed_epoch = max(self.committed_epoch, epoch)
+
+    # ---- read path ------------------------------------------------------
+    def _delta_sources(self, table_id: int) -> List[Dict]:
+        """This table's epoch deltas, newest epoch first (shared buffer)."""
+        eps = sorted((e for e, t in self._deltas if t == table_id),
+                     reverse=True)
+        return [self._deltas[(e, table_id)] for e in eps]
+
+    def _run_readers(self, table_id: int) -> List[RunReader]:
+        """This table's runs, newest first."""
+        out = []
+        for name in reversed(self._manifest["tables"].get(str(table_id), [])):
+            r = self._readers.get(name)
+            if r is None:
+                r = self._readers[name] = RunReader(
+                    name, self._run_path(name), self.cache)
+            out.append(r)
+        return out
+
+    def get(self, table_id: int, key: bytes) -> Optional[Tuple]:
+        for d in self._delta_sources(table_id):
+            if key in d:
+                return d[key]
+        for r in self._run_readers(table_id):
+            v = r.get(key)
+            if v is not _MISS:
+                return v
+        return None
+
+    def iter_range(self, table_id: int, start: Optional[bytes],
+                   end: Optional[bytes]
+                   ) -> Iterator[Tuple[bytes, Tuple]]:
+        sources: List[Iterator] = []
+        for d in self._delta_sources(table_id):
+            items = sorted((k, v) for k, v in d.items()
+                           if (start is None or k >= start)
+                           and (end is None or k < end))
+            sources.append(iter(items))
+        for r in self._run_readers(table_id):
+            sources.append(r.iter_range(start, end))
+        for k, v in _merge(sources):
+            if v is not None:
+                yield k, v
+
+    def table_len(self, table_id: int) -> int:
+        # approximate between compactions (see commit_epoch); uncommitted
+        # deltas counted the same way
+        n = self._manifest["counts"].get(str(table_id), 0)
+        for d in self._delta_sources(table_id):
+            n += sum(1 if v is not None else -1 for v in d.values())
+        return max(0, n)
 
     # ---- files ----------------------------------------------------------
     def _run_path(self, name: str) -> str:
         return os.path.join(self.dir, "runs", name)
-
-    def _write_run(self, name: str, items: List) -> None:
-        blob = zlib.compress(pickle.dumps(items, protocol=4), 1)
-        tmp = self._run_path(name) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._run_path(name))
-
-    def _read_run(self, name: str) -> List:
-        with open(self._run_path(name), "rb") as f:
-            return pickle.loads(zlib.decompress(f.read()))
 
     def _write_manifest(self) -> None:
         tmp = os.path.join(self.dir, MANIFEST + ".tmp")
@@ -114,25 +338,28 @@ class SpillStateStore(MemoryStateStore):
 
     # ---- compaction -----------------------------------------------------
     def _compact(self, table_id: int, epoch: int) -> List[str]:
-        """Merge all committed runs into one base snapshot; tombstones drop
-        out. Merges from the DURABLE run files — not the live memtable,
-        which may already hold uncommitted future-epoch writes that must not
-        leak into the base. Returns the now-unreferenced run files (deleted
-        by the caller AFTER the new manifest is durable)."""
-        merged: Dict[Any, Optional[Tuple]] = {}
-        for name in self._manifest["tables"][str(table_id)]:
-            for key, row in self._read_run(name):
-                merged[key] = row
-        items = sorted((k, v) for k, v in merged.items() if v is not None)
+        """Stream-merge all committed runs into one base snapshot;
+        tombstones drop out. Streaming keeps peak memory at one block per
+        input run + one output block, so tables far larger than RAM
+        compact fine. Returns the now-unreferenced run files (deleted by
+        the caller AFTER the new manifest is durable)."""
+        names = self._manifest["tables"][str(table_id)]
+        readers = self._run_readers(table_id)  # newest first = merge pri
         self._file_seq += 1
-        name = f"t{table_id}_e{epoch}_{self._file_seq}.base"
-        self._write_run(name, items)
-        old = self._manifest["tables"][str(table_id)]
-        self._manifest["tables"][str(table_id)] = [name]
-        return old
+        base = f"t{table_id}_e{epoch}_{self._file_seq}.base"
+        w = _RunWriter(self._run_path(base))
+        for k, v in _merge([r.iter_range(None, None) for r in readers]):
+            if v is not None:
+                w.add(k, v)
+        w.finish()
+        self._manifest["tables"][str(table_id)] = [base]
+        self._manifest["counts"][str(table_id)] = w.count  # exact again
+        return list(names)
 
     def _gc(self, names: Sequence[str]) -> None:
         for n in names:
+            self._readers.pop(n, None)
+            self.cache.drop_run(n)
             try:
                 os.remove(self._run_path(n))
             except FileNotFoundError:
@@ -140,19 +367,13 @@ class SpillStateStore(MemoryStateStore):
 
     # ---- recovery -------------------------------------------------------
     def _recover(self) -> None:
+        """Read the manifest; data stays on disk until referenced."""
         path = os.path.join(self.dir, MANIFEST)
         if not os.path.exists(path):
             return
         with open(path) as f:
             self._manifest = json.load(f)
-        for tid_s, runs in self._manifest["tables"].items():
-            t = self._table(int(tid_s))
-            for name in runs:
-                for key, row in self._read_run(name):
-                    if row is None:
-                        t.delete(key)
-                    else:
-                        t.put(key, row)
+        self._manifest.setdefault("counts", {})
         self.committed_epoch = self._manifest["committed_epoch"]
         for runs in self._manifest["tables"].values():
             for name in runs:

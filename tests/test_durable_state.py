@@ -89,3 +89,58 @@ def test_agg_job_recovery_over_spill_store(tmp_path):
     job2.run_until_idle()
     rows = sorted(BatchScan(mv, None).rows())
     assert rows == [(1, 2, 15), (2, 1, 20)]
+
+
+def test_state_larger_than_cache(tmp_path):
+    """Point + range reads on a table far larger than the block cache:
+    reads hit disk through the block index; the in-memory working set stays
+    bounded (VERDICT r1 item 7 — state > RAM must work)."""
+    from risingwave_tpu.state.hummock import BLOCK_ROWS
+
+    d = str(tmp_path)
+    st = SpillStateStore(d, cache_blocks=4)  # cache = 4 blocks (~1k rows)
+    n = BLOCK_ROWS * 40  # ~10k rows across several commits
+    per_commit = n // 4
+    for c in range(4):
+        batch = [(b"k%08d" % i, (i, i * 2))
+                 for i in range(c * per_commit, (c + 1) * per_commit)]
+        st.ingest_batch(7, batch, epoch=(c + 1) * 10)
+        st.commit_epoch((c + 1) * 10)
+    # reopen: recovery must NOT materialize the table
+    st2 = SpillStateStore(d, cache_blocks=4)
+    assert len(st2.cache) == 0  # nothing loaded yet
+    # point reads all over the key space
+    for i in [0, 1, per_commit - 1, per_commit, n // 2, n - 1]:
+        assert st2.get(7, b"k%08d" % i) == (i, i * 2)
+    assert st2.get(7, b"k%08d" % n) is None
+    assert len(st2.cache) <= 4  # bounded working set
+    # range read across a commit boundary
+    lo, hi = per_commit - 5, per_commit + 5
+    got = list(st2.iter_range(7, b"k%08d" % lo, b"k%08d" % hi))
+    assert [k for k, _ in got] == [b"k%08d" % i for i in range(lo, hi)]
+    assert len(st2.cache) <= 4
+    # full scan streams correctly
+    assert sum(1 for _ in st2.iter_range(7, None, None)) == n
+
+
+def test_overwrites_and_tombstones_across_runs(tmp_path):
+    """Newest run wins per key; tombstones shadow older runs and drop out
+    at compaction."""
+    d = str(tmp_path)
+    st = SpillStateStore(d)
+    st.ingest_batch(5, [(b"a", (1,)), (b"b", (1,)), (b"c", (1,))], epoch=10)
+    st.commit_epoch(10)
+    st.ingest_batch(5, [(b"a", (2,)), (b"b", None)], epoch=20)
+    st.commit_epoch(20)
+    assert st.get(5, b"a") == (2,)
+    assert st.get(5, b"b") is None
+    assert [k for k, _ in st.iter_range(5, None, None)] == [b"a", b"c"]
+    # uncommitted delta overlays committed runs (shared-buffer read)
+    st.ingest_batch(5, [(b"a", None), (b"d", (9,))], epoch=30)
+    assert st.get(5, b"a") is None
+    assert st.get(5, b"d") == (9,)
+    assert [k for k, _ in st.iter_range(5, None, None)] == [b"c", b"d"]
+    # ...but vanishes on crash (not committed)
+    st2 = SpillStateStore(d)
+    assert st2.get(5, b"a") == (2,)
+    assert st2.get(5, b"d") is None
