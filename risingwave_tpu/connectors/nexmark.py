@@ -55,6 +55,29 @@ LAST_NAMES = ["shultz", "abrams", "spencer", "white", "bartels", "walton",
               "smith", "jones", "noris"]
 CHANNELS = ["apple", "google", "facebook", "baidu"]
 
+# Precomputed object-dtype pools: string columns are produced by fancy
+# indexing (C speed), never per-row Python. NAME/EMAIL pools are the
+# first x last cross product, indexed fi * len(LAST_NAMES) + li.
+_CH_POOL = np.array(CHANNELS, dtype=object)
+_URL_POOL = np.array([f"https://www.nexmark.com/{c}/item.htm?query=1"
+                      for c in CHANNELS], dtype=object)
+_CITY_POOL = np.array(US_CITIES, dtype=object)
+_STATE_POOL = np.array(US_STATES, dtype=object)
+_NAME_POOL = np.array([f"{a} {b}" for a in FIRST_NAMES for b in LAST_NAMES],
+                      dtype=object)
+_EMAIL_POOL = np.array([f"{a}@{b}.com" for a in FIRST_NAMES
+                        for b in LAST_NAMES], dtype=object)
+
+
+def _obj_col(values: np.ndarray) -> Column:
+    """VARCHAR column from an all-valid object array (skips the per-row
+    null scan Column would otherwise do)."""
+    return Column(T.VARCHAR, values, np.ones(len(values), dtype=np.bool_))
+
+
+def _empty_strings(n: int) -> Column:
+    return _obj_col(np.full(n, "", dtype=object))
+
 PERSON_SCHEMA = Schema.of(
     ("id", T.INT64), ("name", T.VARCHAR), ("email_address", T.VARCHAR),
     ("credit_card", T.VARCHAR), ("city", T.VARCHAR), ("state", T.VARCHAR),
@@ -114,9 +137,10 @@ class NexmarkGenerator:
         return (self.cfg.base_time_usecs
                 + event_ids * self.cfg.inter_event_gap_usecs).astype(np.int64)
 
-    def _strings(self, r: np.ndarray, pool: List[str]) -> List[str]:
+    def _strings(self, r: np.ndarray, pool: np.ndarray) -> np.ndarray:
+        """Pool lookup via fancy indexing -> object array (no Python loop)."""
         idx = (r % np.uint64(len(pool))).astype(np.int64)
-        return [pool[i] for i in idx]
+        return pool[idx]
 
     def gen_persons(self, event_ids: np.ndarray) -> StreamChunk:
         n = len(event_ids)
@@ -125,24 +149,20 @@ class NexmarkGenerator:
         ts = self._timestamps(event_ids)
         cols = [Column(T.INT64, ids)]
         if self.cfg.strings_on:
-            first = self._strings(self._rand(ids, 1), FIRST_NAMES)
-            last = self._strings(self._rand(ids, 2), LAST_NAMES)
-            names = [f"{a} {b}" for a, b in zip(first, last)]
-            emails = [f"{a}@{b}.com" for a, b in zip(first, last)]
-            cc = [format(int(v) % 10**16, "016d") for v in self._rand(ids, 3)]
-            city = self._strings(self._rand(ids, 4), US_CITIES)
-            state = self._strings(self._rand(ids, 5), US_STATES)
-            extra = ["" for _ in range(n)]
-            cols += [Column.from_list(T.VARCHAR, names),
-                     Column.from_list(T.VARCHAR, emails),
-                     Column.from_list(T.VARCHAR, cc),
-                     Column.from_list(T.VARCHAR, city),
-                     Column.from_list(T.VARCHAR, state)]
+            fi = (self._rand(ids, 1) % np.uint64(len(FIRST_NAMES)))
+            li = (self._rand(ids, 2) % np.uint64(len(LAST_NAMES)))
+            combo = (fi * np.uint64(len(LAST_NAMES)) + li).astype(np.int64)
+            cc = np.char.zfill(
+                (self._rand(ids, 3) % np.uint64(10**16)).astype("U16"), 16)
+            cols += [_obj_col(_NAME_POOL[combo]),
+                     _obj_col(_EMAIL_POOL[combo]),
+                     _obj_col(cc.astype(object)),
+                     _obj_col(self._strings(self._rand(ids, 4), _CITY_POOL)),
+                     _obj_col(self._strings(self._rand(ids, 5), _STATE_POOL))]
         else:
-            empty = Column.from_list(T.VARCHAR, [""] * n)
-            cols += [empty] * 5
+            cols += [_empty_strings(n)] * 5
         cols.append(Column(T.TIMESTAMP, ts))
-        cols.append(Column.from_list(T.VARCHAR, [""] * n))
+        cols.append(_empty_strings(n))
         return StreamChunk(np.zeros(n, dtype=np.int8), cols)
 
     def gen_auctions(self, event_ids: np.ndarray) -> StreamChunk:
@@ -169,17 +189,18 @@ class NexmarkGenerator:
                         * self.cfg.inter_event_gap_usecs)
         cols = [Column(T.INT64, ids)]
         if self.cfg.strings_on:
-            item = ["item-" + str(int(i)) for i in ids]
-            desc = ["desc-" + str(int(v) % 1000) for v in self._rand(ids, 15)]
-            cols += [Column.from_list(T.VARCHAR, item),
-                     Column.from_list(T.VARCHAR, desc)]
+            item = np.char.add("item-", ids.astype("U20"))
+            desc = np.char.add(
+                "desc-", (self._rand(ids, 15) % np.uint64(1000)).astype("U4"))
+            cols += [_obj_col(item.astype(object)),
+                     _obj_col(desc.astype(object))]
         else:
-            empty = Column.from_list(T.VARCHAR, [""] * n)
+            empty = _empty_strings(n)
             cols += [empty, empty]
         cols += [Column(T.INT64, initial_bid), Column(T.INT64, reserve),
                  Column(T.TIMESTAMP, ts), Column(T.TIMESTAMP, expires),
                  Column(T.INT64, seller), Column(T.INT64, category),
-                 Column.from_list(T.VARCHAR, [""] * n)]
+                 _empty_strings(n)]
         return StreamChunk(np.zeros(n, dtype=np.int8), cols)
 
     def gen_bids(self, event_ids: np.ndarray) -> StreamChunk:
@@ -209,15 +230,13 @@ class NexmarkGenerator:
         cols = [Column(T.INT64, auction), Column(T.INT64, bidder),
                 Column(T.INT64, price)]
         if self.cfg.strings_on:
-            channel = self._strings(self._rand(event_ids, 25), CHANNELS)
-            url = [f"https://www.nexmark.com/{c}/item.htm?query=1" for c in channel]
-            cols += [Column.from_list(T.VARCHAR, channel),
-                     Column.from_list(T.VARCHAR, url)]
+            ci = (self._rand(event_ids, 25)
+                  % np.uint64(len(_CH_POOL))).astype(np.int64)
+            cols += [_obj_col(_CH_POOL[ci]), _obj_col(_URL_POOL[ci])]
         else:
-            empty = Column.from_list(T.VARCHAR, [""] * n)
+            empty = _empty_strings(n)
             cols += [empty, empty]
-        cols += [Column(T.TIMESTAMP, ts),
-                 Column.from_list(T.VARCHAR, [""] * n)]
+        cols += [Column(T.TIMESTAMP, ts), _empty_strings(n)]
         return StreamChunk(np.zeros(n, dtype=np.int8), cols)
 
     def gen_range(self, start_event: int, end_event: int

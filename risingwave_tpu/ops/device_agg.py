@@ -47,13 +47,16 @@ def _spec_kinds(calls: Sequence[AggCall]) -> List[str]:
 
 
 def device_agg_eligible(calls: Sequence[AggCall],
-                        include_minmax: bool = True) -> bool:
+                        include_minmax: bool = True,
+                        append_only: bool = False) -> bool:
     """Can this aggregation fragment run on the device path?
 
     count/sum/avg are exact under retraction; min/max are exact via the
     sorted-multiset side state (`device/minput.py`, the `minput.rs`
-    analog). DISTINCT/filtered calls and exotic kinds stay on the exact
-    host path.
+    analog) — or, over an append-only input, via a single monotone extreme
+    column (the reference's append-only agg specialization,
+    `aggregate/agg_impl.rs`), which needs no side state at all.
+    DISTINCT/filtered calls and exotic kinds stay on the exact host path.
     """
     for c in calls:
         if c.distinct or c.filter is not None:
@@ -64,7 +67,7 @@ def device_agg_eligible(calls: Sequence[AggCall],
             if c.arg is None or c.arg.return_type.kind not in _SUMMABLE:
                 return False
         elif c.kind in ("min", "max"):
-            if not include_minmax or c.arg is None:
+            if not (include_minmax or append_only) or c.arg is None:
                 return False
             rt = c.arg.return_type
             if rt.device_dtype is None or rt.kind == TypeKind.BOOLEAN:
@@ -74,22 +77,24 @@ def device_agg_eligible(calls: Sequence[AggCall],
     return True
 
 
-def _build_sql_spec(calls: Sequence[AggCall]):
-    """The retractable (SQL-default) device spec for these calls. min/max
-    over the same input column (InputRef) share one multiset."""
+def _build_sql_spec(calls: Sequence[AggCall], append_only: bool = False):
+    """The device spec for these calls. Retractable (SQL default) unless
+    the input fragment is append-only; retractable min/max over the same
+    input column (InputRef) share one multiset."""
     from ..device.agg_step import DeviceAggSpec
     from ..expr.expression import InputRef
     arg_ids = [("ref", c.arg.index) if isinstance(c.arg, InputRef)
                else ("call", i) for i, c in enumerate(calls)]
     return DeviceAggSpec.build(_spec_kinds(calls),
                                [_arg_np_dtype(c) for c in calls],
-                               append_only=False, arg_ids=arg_ids)
+                               append_only=append_only, arg_ids=arg_ids)
 
 
-def device_payload_dtypes(calls: Sequence[AggCall]) -> List[DataType]:
+def device_payload_dtypes(calls: Sequence[AggCall],
+                          append_only: bool = False) -> List[DataType]:
     """SQL dtypes of the persisted device payload columns (state-table
     layout; must match DeviceAggSpec.build's column order)."""
-    spec = _build_sql_spec(calls)
+    spec = _build_sql_spec(calls, append_only)
     out = []
     for d in spec.dtypes:
         out.append(T.FLOAT64 if np.issubdtype(np.dtype(d), np.floating)
@@ -97,10 +102,11 @@ def device_payload_dtypes(calls: Sequence[AggCall]) -> List[DataType]:
     return out
 
 
-def device_minput_count(calls: Sequence[AggCall]) -> int:
+def device_minput_count(calls: Sequence[AggCall],
+                        append_only: bool = False) -> int:
     """How many minput side tables the executor persists (one per
     retractable min/max call): rows are (group..., encoded value, count)."""
-    return len(_build_sql_spec(calls).minputs)
+    return len(_build_sql_spec(calls, append_only).minputs)
 
 
 def _arg_np_dtype(c: AggCall):
@@ -117,7 +123,8 @@ class DeviceHashAggExecutor(UnaryExecutor):
                  calls: Sequence[AggCall],
                  state_table: Optional[StateTable] = None,
                  minput_tables: Sequence[StateTable] = (),
-                 mesh: Optional[Any] = None, capacity: int = 1024):
+                 mesh: Optional[Any] = None, capacity: int = 1024,
+                 append_only: bool = False):
         in_schema = input.schema
         fields = [in_schema.fields[i] for i in group_key_indices]
         fields += [Field(f"agg#{i}", c.return_type)
@@ -131,9 +138,10 @@ class DeviceHashAggExecutor(UnaryExecutor):
         self._key_dtypes = [in_schema.fields[i].dtype
                             for i in group_key_indices]
         self._clean_wm: Optional[Tuple[int, Any]] = None
+        self.input_append_only = append_only
 
         from ..device.key_codec import make_codec
-        self.spec = _build_sql_spec(calls)
+        self.spec = _build_sql_spec(calls, append_only)
         assert len(self.minput_tables) in (0, len(self.spec.minputs)), \
             "one minput state table per retractable min/max call"
         # call_idx -> is the minput value order-encoded from floats?
@@ -266,7 +274,8 @@ class DeviceHashAggExecutor(UnaryExecutor):
                         out.append(Decimal(int(acc)) / Decimal(n))
                     else:
                         out.append(float(acc) / n)
-            else:  # min / max: extreme from the multiset change arrays
+            elif dc.minput is not None:
+                # retractable min/max: extreme from the multiset changes
                 n = int(vals[dc.cols[0]][i])
                 if n <= 0 or mm is None:
                     out.append(None)
@@ -278,6 +287,14 @@ class DeviceHashAggExecutor(UnaryExecutor):
                             np.array([enc], dtype=np.int64))[0]))
                     else:
                         out.append(enc)
+            else:  # min / max, append-only: monotone extreme column
+                n = int(vals[dc.cols[1]][i])
+                if n <= 0:
+                    out.append(None)
+                elif np.issubdtype(np.dtype(dc.acc_dtype), np.floating):
+                    out.append(float(vals[dc.cols[0]][i]))
+                else:
+                    out.append(int(vals[dc.cols[0]][i]))
         return tuple(out)
 
     def on_barrier(self, barrier: Barrier) -> Iterator[Message]:

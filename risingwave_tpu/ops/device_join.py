@@ -74,6 +74,8 @@ class DeviceHashJoinExecutor(Executor):
                  max_chunk_size: int = 1024):
         schema = left.schema.concat(right.schema)
         super().__init__(schema, "DeviceHashJoin")
+        # INNER join of append-only inputs never retracts
+        self.append_only = left.append_only and right.append_only
         self.left_exec, self.right_exec = left, right
         self.key_idx = {"a": list(left_keys), "b": list(right_keys)}
         self.condition = condition

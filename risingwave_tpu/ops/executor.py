@@ -24,6 +24,12 @@ from .message import Barrier, Message, Watermark
 class Executor:
     """Base: `execute()` yields Chunk | Barrier | Watermark."""
 
+    # True when this stream can never emit DELETE / UPDATE rows — the
+    # reference's append-only plan property (derived bottom-up over the
+    # plan, `generic/agg.rs` `input.append_only()`). Lets the device agg
+    # keep min/max as a single extreme column instead of a multiset.
+    append_only = False
+
     def __init__(self, schema: Schema, name: str = ""):
         self.schema = schema
         self.name = name or type(self).__name__
@@ -108,6 +114,7 @@ class SharedStreamPort(Executor):
         super().__init__(shared.upstream.schema, f"tee({shared.upstream.name})")
         self.shared = shared
         self.buf = buf
+        self.append_only = shared.upstream.append_only
 
     def execute(self) -> Iterator[Message]:
         while True:

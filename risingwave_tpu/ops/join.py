@@ -140,6 +140,12 @@ class HashJoinExecutor(Executor):
         else:
             schema = left.schema.concat(right.schema)
         super().__init__(schema, f"HashJoin[{join_type.value}]")
+        # inner/semi joins of append-only inputs only ever insert; outer
+        # joins retract their NULL-padded rows, anti joins retract on probe
+        self.append_only = (left.append_only and right.append_only
+                            and join_type in (JoinType.INNER,
+                                              JoinType.LEFT_SEMI,
+                                              JoinType.RIGHT_SEMI))
         self.left_exec, self.right_exec = left, right
         self.join_type = join_type
         self.condition = condition

@@ -29,6 +29,12 @@ class MaterializeExecutor(UnaryExecutor):
                  conflict: ConflictBehavior = ConflictBehavior.NO_CHECK,
                  name: str = "Materialize"):
         super().__init__(input, input.schema, name)
+        # conflict rewriting (OVERWRITE / DO_UPDATE_IF_NOT_NULL) can turn an
+        # insert into an update pair when a pk collides, so only the
+        # NO_CHECK path preserves the append-only property (creators use
+        # NO_CHECK when the pk is a minted rowid, which never collides)
+        self.append_only = (input.append_only
+                            and conflict == ConflictBehavior.NO_CHECK)
         self.table = table
         self.conflict = conflict
 

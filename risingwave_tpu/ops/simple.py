@@ -26,6 +26,7 @@ class ProjectExecutor(UnaryExecutor):
         names = names or [f"expr#{i}" for i in range(len(exprs))]
         schema = Schema([Field(n, e.return_type) for n, e in zip(names, exprs)])
         super().__init__(input, schema)
+        self.append_only = input.append_only
         self.exprs = list(exprs)
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
@@ -49,6 +50,7 @@ class FilterExecutor(UnaryExecutor):
 
     def __init__(self, input: Executor, predicate: Expr):
         super().__init__(input, input.schema)
+        self.append_only = input.append_only
         self.predicate = predicate
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
@@ -82,6 +84,7 @@ class UnionExecutor(Executor):
 
     def __init__(self, inputs: Sequence[Executor]):
         super().__init__(inputs[0].schema, "Union")
+        self.append_only = all(i.append_only for i in inputs)
         self.inputs = list(inputs)
 
     def execute(self) -> Iterator[Message]:
@@ -117,6 +120,7 @@ class ValuesExecutor(Executor):
     def __init__(self, schema: Schema, rows: Sequence[Sequence],
                  barrier_source: "Executor"):
         super().__init__(schema, "Values")
+        self.append_only = True
         self.rows = list(rows)
         self.barrier_source = barrier_source
 
@@ -152,6 +156,7 @@ class RowIdGenExecutor(UnaryExecutor):
 
     def __init__(self, input: Executor, row_id_index: int, shard: int = 0):
         super().__init__(input, input.schema)
+        self.append_only = input.append_only
         self.row_id_index = row_id_index
         # logical counter = millis * 2^12 + seq; monotonic, clock-anchored
         self._counter = self._now_ms() << self._SEQ_BITS
@@ -199,6 +204,7 @@ class ExpandExecutor(UnaryExecutor):
         fields = [Field(f.name, f.dtype) for f in in_schema.fields]
         fields.append(Field("flag", T.INT64))
         super().__init__(input, Schema(fields), "Expand")
+        self.append_only = input.append_only
         self.subsets = [list(s) for s in subsets]
 
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:

@@ -86,8 +86,11 @@ class SourceExecutor(Executor):
     def __init__(self, schema: Schema, reader: SourceReader,
                  injector: BarrierInjector,
                  split_state_table: Optional[StateTable] = None,
-                 name: str = "Source"):
+                 name: str = "Source", append_only: bool = False):
         super().__init__(schema, name)
+        # connector sources only ever insert; DML tables push retractions
+        # through their reader, so the creator decides
+        self.append_only = append_only
         self.reader = reader
         self.injector = injector
         self.queue = injector.register()

@@ -139,6 +139,7 @@ class AppendOnlyDedupExecutor(UnaryExecutor):
     def __init__(self, input: Executor, key_indices: Sequence[int],
                  state_table: Optional[StateTable] = None):
         super().__init__(input, input.schema, "AppendOnlyDedup")
+        self.append_only = input.append_only
         self.key_indices = list(key_indices)
         self.seen: set = set()
         self.state_table = state_table

@@ -23,6 +23,7 @@ class WatermarkFilterExecutor(UnaryExecutor):
     def __init__(self, input: Executor, time_col: int, delay: int,
                  state_table: Optional[StateTable] = None):
         super().__init__(input, input.schema, "WatermarkFilter")
+        self.append_only = input.append_only
         self.time_col = time_col
         self.delay = delay
         self.watermark: Optional[Any] = None

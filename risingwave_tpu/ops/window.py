@@ -38,6 +38,7 @@ class HopWindowExecutor(UnaryExecutor):
         fields = list(in_schema.fields) + [
             Field("window_start", T.TIMESTAMP), Field("window_end", T.TIMESTAMP)]
         super().__init__(input, Schema(fields), "HopWindow")
+        self.append_only = input.append_only
         self.time_col = time_col
         self.hop_usecs = hop.total_usecs_approx()
         self.size_usecs = size.total_usecs_approx()

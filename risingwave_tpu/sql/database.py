@@ -47,6 +47,7 @@ class _Backfill(Executor):
 
     def __init__(self, snapshot: Optional[StreamChunk], port: Executor):
         super().__init__(port.schema, "Backfill")
+        self.append_only = port.append_only
         self.snapshot = snapshot
         self.port = port
 
@@ -214,7 +215,9 @@ class Database:
             [T.VARCHAR, T.VARCHAR], [0])
         src: Executor = SourceExecutor(schema, reader, self.injector,
                                        split_state_table=split_st,
-                                       name=f"Source({stmt.name})")
+                                       name=f"Source({stmt.name})",
+                                       append_only=(connector != "dml"
+                                                    or stmt.append_only))
         if not has_pk:
             src = RowIdGenExecutor(src, row_id_index=len(fields) - 1,
                                    shard=tid & 0x3FF)
@@ -229,8 +232,12 @@ class Database:
             src = WatermarkFilterExecutor(src, ti, delay, wm_st)
             obj.watermark_col = ti
         mv_table = StateTable(self.store, tid, schema.dtypes, pk)
+        # minted rowids never collide, so the conflict scan is pure
+        # overhead there — and NO_CHECK is what lets Materialize keep the
+        # append-only property for the device agg specialization
         mat = MaterializeExecutor(src, mv_table,
-                                  ConflictBehavior.OVERWRITE)
+                                  ConflictBehavior.NO_CHECK if not has_pk
+                                  else ConflictBehavior.OVERWRITE)
         shared = SharedStream(mat)
         obj.runtime = {"reader": reader if connector == "dml" else None,
                        "state_table": mv_table, "shared": shared,
@@ -366,6 +373,10 @@ class Database:
 
     def _delete(self, stmt: A.Delete) -> str:
         obj = self.catalog.get(stmt.table)
+        if obj.append_only:
+            raise ValueError(
+                f"table {stmt.table!r} is APPEND ONLY: DELETE is not "
+                "allowed (the plan property is load-bearing downstream)")
         reader: ListReader = obj.runtime["reader"]
         assert reader is not None
         # bind predicate against the table, evaluate over the current MV
@@ -396,6 +407,10 @@ class Database:
         """UPDATE = U-/U+ pairs through the source (row ids preserved, so
         downstream retraction works like the reference's DML update path)."""
         obj = self.catalog.get(stmt.table)
+        if obj.append_only:
+            raise ValueError(
+                f"table {stmt.table!r} is APPEND ONLY: UPDATE is not "
+                "allowed (the plan property is load-bearing downstream)")
         reader: ListReader = obj.runtime["reader"]
         assert reader is not None, f"{stmt.table} is not DML-writable"
         rows = list(obj.runtime["state_table"].iter_all())

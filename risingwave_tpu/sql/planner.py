@@ -275,19 +275,24 @@ class Planner:
                                       device_agg_eligible,
                                       device_minput_count,
                                       device_payload_dtypes)
+        # bottom-up append-only property (generic/agg.rs `input.append_only`):
+        # derived from the executor tree, so it is deterministic for a given
+        # DDL + dispatch policy and replays identically on recovery
+        ao = bool(input.append_only)
         if self.device is not None and not eowc \
-                and device_agg_eligible(calls, self.device.minmax):
-            st = self.make_state(gdtypes + device_payload_dtypes(calls),
+                and device_agg_eligible(calls, self.device.minmax, ao):
+            st = self.make_state(gdtypes + device_payload_dtypes(calls, ao),
                                  list(range(len(group_indices))))
             # one (group..., encoded value, count) table per retractable
             # min/max call — pk covers group + value
             mts = [self.make_state(gdtypes + [T.INT64, T.INT64],
                                    list(range(len(group_indices) + 1)))
-                   for _ in range(device_minput_count(calls))]
+                   for _ in range(device_minput_count(calls, ao))]
             return DeviceHashAggExecutor(input, group_indices, calls,
                                          state_table=st, minput_tables=mts,
                                          mesh=self.device.mesh,
-                                         capacity=self.device.capacity)
+                                         capacity=self.device.capacity,
+                                         append_only=ao)
         st = self.make_state(gdtypes + [T.BYTEA],
                              list(range(len(group_indices))))
         return HashAggExecutor(input, group_indices, calls, state_table=st,

@@ -135,21 +135,25 @@ class RunReader:
         self.name = name
         self.path = path
         self.cache = cache
-        with open(path, "rb") as f:
-            f.seek(-8, os.SEEK_END)
-            end = f.tell()
-            (idx_off,) = struct.unpack(">Q", f.read(8))
-            f.seek(idx_off)
-            self.index, self.count = pickle.loads(f.read(end - idx_off))
+        # one long-lived handle per run: cold scans touch every block, and
+        # an open/close pair per block would dominate the read path
+        self._f = open(path, "rb")
+        self._f.seek(-8, os.SEEK_END)
+        end = self._f.tell()
+        (idx_off,) = struct.unpack(">Q", self._f.read(8))
+        self._f.seek(idx_off)
+        self.index, self.count = pickle.loads(self._f.read(end - idx_off))
         self._first_keys = [e[0] for e in self.index]
+
+    def close(self) -> None:
+        self._f.close()
 
     def _block(self, i: int) -> List[Tuple[bytes, Optional[Tuple]]]:
         blk = self.cache.get((self.name, i))
         if blk is None:
             _, off, length = self.index[i]
-            with open(self.path, "rb") as f:
-                f.seek(off)
-                blk = pickle.loads(zlib.decompress(f.read(length)))
+            self._f.seek(off)
+            blk = pickle.loads(zlib.decompress(self._f.read(length)))
             self.cache.put((self.name, i), blk)
         return blk
 
@@ -358,7 +362,9 @@ class SpillStateStore(StateStore):
 
     def _gc(self, names: Sequence[str]) -> None:
         for n in names:
-            self._readers.pop(n, None)
+            r = self._readers.pop(n, None)
+            if r is not None:
+                r.close()
             self.cache.drop_run(n)
             try:
                 os.remove(self._run_path(n))

@@ -1,0 +1,134 @@
+"""Append-only plan-property derivation (the reference's
+`generic/agg.rs` `input.append_only()` specialization): connector sources
+are insert-only, the property propagates through stateless operators, and
+the device agg then keeps min/max as a single extreme column (no multiset
+side state) — the `aggregate/agg_impl.rs` append-only min/max analog."""
+import pytest
+
+from risingwave_tpu.sql import Database
+
+SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT, "
+       "channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR) "
+       "WITH (connector='nexmark', nexmark.table='bid', "
+       "nexmark.max.events='2000')")
+
+
+def _device_agg(db, mv):
+    e = db.catalog.get(mv).runtime["shared"].upstream
+    stack = [e]
+    while stack:
+        e = stack.pop()
+        if type(e).__name__ == "DeviceHashAggExecutor":
+            return e
+        for attr in ("input", "port", "left_exec", "right_exec"):
+            c = getattr(e, attr, None)
+            if c is not None:
+                stack.append(c)
+    return None
+
+
+def test_source_agg_uses_append_only_spec():
+    db = Database(device="on")
+    db.run(SRC)
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT auction, max(price) AS m, "
+           "min(price) AS mn FROM bid GROUP BY auction")
+    agg = _device_agg(db, "mv")
+    assert agg is not None
+    assert agg.spec.append_only and len(agg.spec.minputs) == 0
+
+
+def test_append_only_survives_filter_project_window():
+    db = Database(device="on")
+    db.run(SRC)
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT window_start, max(price) "
+           "AS m FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
+           "WHERE price > 200 GROUP BY window_start")
+    agg = _device_agg(db, "mv")
+    assert agg is not None and agg.spec.append_only
+
+
+def test_dml_table_agg_stays_retractable():
+    """Tables accept DELETE/UPDATE, so min/max must keep the multiset."""
+    db = Database(device="on")
+    db.run("CREATE TABLE t (k INT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, max(v) AS m "
+           "FROM t GROUP BY k")
+    agg = _device_agg(db, "mv")
+    assert agg is not None
+    assert not agg.spec.append_only and len(agg.spec.minputs) == 1
+
+
+def test_agg_output_breaks_append_only():
+    """An agg emits updates, so a second-level agg over it is retractable."""
+    db = Database(device="on")
+    db.run(SRC)
+    db.run("CREATE MATERIALIZED VIEW lvl1 AS SELECT auction, count(*) AS c "
+           "FROM bid GROUP BY auction")
+    db.run("CREATE MATERIALIZED VIEW lvl2 AS SELECT c, count(*) AS n "
+           "FROM lvl1 GROUP BY c")
+    agg = _device_agg(db, "lvl2")
+    assert agg is not None and not agg.spec.append_only
+
+
+def test_append_only_parity_with_host_tumble_minmax(nexmark_pair=None):
+    host, dev = Database(device="off"), Database(device="on")
+    for db in (host, dev):
+        db.run(SRC)
+        db.run("CREATE MATERIALIZED VIEW mv AS SELECT auction, max(price) "
+               "AS m, min(price) AS mn, count(*) AS c FROM bid "
+               "GROUP BY auction")
+        db.run("FLUSH")
+        db.run("FLUSH")
+    a = sorted(host.query("SELECT * FROM mv"))
+    b = sorted(dev.query("SELECT * FROM mv"))
+    assert a == b and len(a) > 10
+
+
+def test_pk_source_with_conflicts_stays_retractable():
+    """A user pk over a connector source can collide -> Materialize may
+    emit update pairs under OVERWRITE, so downstream aggs must NOT get the
+    append-only specialization (review finding: append-only spec crashed
+    on the U- rows)."""
+    db = Database(device="on")
+    db.run("CREATE TABLE bid (auction BIGINT, bidder BIGINT, price BIGINT, "
+           "channel VARCHAR, url VARCHAR, date_time TIMESTAMP, "
+           "extra VARCHAR, PRIMARY KEY (auction)) "
+           "WITH (connector='nexmark', nexmark.table='bid', "
+           "nexmark.max.events='2000')")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT bidder, max(price) AS m "
+           "FROM bid GROUP BY bidder")
+    agg = _device_agg(db, "mv")
+    if agg is not None:
+        assert not agg.spec.append_only
+    db.run("FLUSH")
+    db.run("FLUSH")
+    assert len(db.query("SELECT * FROM mv")) > 0
+
+
+def test_append_only_table_rejects_delete_update():
+    """APPEND ONLY makes the plan property load-bearing: DML retractions
+    must be rejected at the statement level (reference forbids them)."""
+    db = Database(device="on")
+    db.run("CREATE TABLE t (k INT, v BIGINT) APPEND ONLY")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, max(v) AS m "
+           "FROM t GROUP BY k")
+    db.run("INSERT INTO t VALUES (1, 10), (1, 20)")
+    assert db.query("SELECT * FROM mv") == [(1, 20)]
+    with pytest.raises(ValueError, match="APPEND ONLY"):
+        db.run("DELETE FROM t WHERE v = 20")
+    with pytest.raises(ValueError, match="APPEND ONLY"):
+        db.run("UPDATE t SET v = 0 WHERE k = 1")
+
+
+def test_append_only_recovery(tmp_path):
+    d = str(tmp_path)
+    db = Database(data_dir=d, device="on")
+    db.run(SRC.replace("nexmark.max.events='2000'",
+                       "nexmark.max.events='1000'"))
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT auction, max(price) AS m "
+           "FROM bid GROUP BY auction")
+    db.run("FLUSH")
+    before = sorted(db.query("SELECT * FROM mv"))
+    assert len(before) > 0
+    db2 = Database(data_dir=d, device="on")
+    assert sorted(db2.query("SELECT * FROM mv")) == before
