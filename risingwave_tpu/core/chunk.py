@@ -103,7 +103,14 @@ class Column:
         return v.item() if isinstance(v, np.generic) else v
 
     def to_list(self) -> List[Any]:
-        return [self.get(i) for i in range(len(self))]
+        if self.dtype.np_dtype == np.dtype(object):
+            out = list(self.values)
+        else:
+            out = self.values.tolist()   # C-speed scalar conversion
+        if not self.validity.all():
+            for i in np.flatnonzero(~self.validity).tolist():
+                out[i] = None
+        return out
 
     def take(self, indices: np.ndarray) -> "Column":
         return Column(self.dtype, self.values[indices], self.validity[indices])
@@ -177,9 +184,13 @@ class DataChunk:
         return tuple(c.get(i) for c in self.columns)
 
     def rows(self) -> List[Tuple[Any, ...]]:
-        """Visible rows as tuples."""
+        """Visible rows as tuples (columns convert in bulk, then one zip)."""
+        out = list(zip(*(c.to_list() for c in self.columns))) \
+            if self.columns else []
         mask = self.vis_mask()
-        return [self.row_at(i) for i in range(self.capacity) if mask[i]]
+        if not mask.all():
+            out = [r for r, ok in zip(out, mask.tolist()) if ok]
+        return out
 
     def compact(self) -> "DataChunk":
         """Drop invisible rows (`DataChunk::compact` in the reference)."""

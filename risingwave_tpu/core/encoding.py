@@ -113,6 +113,68 @@ def encode_key(row: Sequence[Any], dtypes: Sequence[DataType],
     return bytes(out)
 
 
+# fixed-width memcomparable kinds: payload bytes per non-null datum
+_FIXED_KEY_WIDTH = {
+    TypeKind.BOOLEAN: 1,
+    TypeKind.INT16: 2,
+    TypeKind.INT32: 4, TypeKind.DATE: 4,
+    TypeKind.INT64: 8, TypeKind.TIME: 8, TypeKind.TIMESTAMP: 8,
+    TypeKind.TIMESTAMPTZ: 8, TypeKind.SERIAL: 8,
+    TypeKind.FLOAT32: 8, TypeKind.FLOAT64: 8,   # both encode as f64 bits
+}
+
+
+def encode_key_matrix(cols: Sequence, dtypes: Sequence[DataType],
+                      order: Optional[Sequence[bool]] = None):
+    """Vectorized `encode_key` over whole columns.
+
+    Returns an (n, W) uint8 matrix whose rows are byte-for-byte identical
+    to `encode_key` of the corresponding row — or None when a column kind
+    is not fixed-width or any datum is NULL (those batches take the exact
+    per-row path). The bulk write path (`StateTable.write_chunk`) depends
+    on the byte-for-byte contract: point lookups re-encode per-row.
+    """
+    import numpy as np
+    if not cols:
+        return None
+    n = len(cols[0])
+    widths = []
+    for c, dt in zip(cols, dtypes):
+        w = _FIXED_KEY_WIDTH.get(dt.kind)
+        if w is None or not c.validity.all():
+            return None
+        widths.append(w)
+    total = sum(w + 1 for w in widths)
+    mat = np.empty((n, total), dtype=np.uint8)
+    off = 0
+    for i, (c, dt, w) in enumerate(zip(cols, dtypes, widths)):
+        mat[:, off] = _NONNULL_TAG[0]
+        kind = dt.kind
+        if kind == TypeKind.BOOLEAN:
+            body = c.values.astype(np.uint8).reshape(n, 1)
+        elif kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+            bits = np.ascontiguousarray(
+                c.values.astype(np.float64)).view(np.uint64)
+            neg = (bits >> np.uint64(63)).astype(bool)
+            bits = np.where(neg, ~bits, bits | np.uint64(1 << 63))
+            body = bits.astype(">u8").view(np.uint8).reshape(n, 8)
+        else:
+            v = c.values.astype(np.int64, copy=False)
+            if w == 8:
+                u = (v ^ np.int64(-2**63)).view(np.uint64)
+                body = u.astype(">u8").view(np.uint8).reshape(n, 8)
+            else:
+                mask_w = np.int64((1 << (8 * w)) - 1)
+                u = (v & mask_w) ^ np.int64(1 << (8 * w - 1))
+                body = u.astype(f">u{w}").view(np.uint8).reshape(n, w)
+        mat[:, off + 1: off + 1 + w] = body
+        if order is not None and order[i]:
+            mat[:, off: off + 1 + w] = \
+                np.uint8(0xFF) - mat[:, off: off + 1 + w]
+        off += 1 + w
+    return mat
+
+
 # ---------------------------------------------------------------------------
 # Value encoding (compact, non-ordered) — checkpoint row payloads
 # ---------------------------------------------------------------------------

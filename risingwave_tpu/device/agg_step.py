@@ -271,6 +271,28 @@ def agg_epoch_step_full(spec: DeviceAggSpec, state: DeviceAggState,
 
 
 @partial(jax.jit, static_argnames=("spec",))
+def agg_epoch_step_packed(spec: DeviceAggSpec, state: DeviceAggState,
+                          p64: jax.Array, p8: jax.Array):
+    """agg_epoch_step_full fed from two packed host buffers — a remote
+    device pays ~one RTT per transfer, so the host ships ONE int64 matrix
+    (row 0: keys; row 1+i: call i's values, floats as raw f64 bits) and
+    ONE int8 matrix (row 0: signs; row 1: row mask; row 2+i: call i's
+    validity) instead of 3 + 2*n_calls separate arrays."""
+    keys = p64[0]
+    signs = p8[0].astype(jnp.int32)
+    mask = p8[1] != 0
+    ins = []
+    for i, call in enumerate(spec.calls):
+        v = p64[1 + i]
+        # minput values are order-encoded int64 even for float columns
+        if call.minput is None and jnp.issubdtype(call.acc_dtype,
+                                                  jnp.floating):
+            v = jax.lax.bitcast_convert_type(v, jnp.float64)
+        ins.append((v, p8[2 + i] != 0))
+    return epoch_core_full(spec, state, keys, signs, mask, tuple(ins))
+
+
+@partial(jax.jit, static_argnames=("spec",))
 def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
                    keys: jax.Array, signs: jax.Array, mask: jax.Array,
                    inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
@@ -280,6 +302,48 @@ def agg_epoch_step(spec: DeviceAggSpec, state: SortedState,
     barrier change chunk from them (insert/delete/update-pair per key).
     """
     return epoch_core(spec, state, keys, signs, mask, inputs)
+
+
+# change-set entries only the fused pipeline (device/pipeline.py) reads;
+# the SQL executor derives outputs from the raw payload columns instead,
+# so flush_epoch skips transferring these to host
+_PULL_DROP = ("old_out", "new_out", "old_null", "new_null")
+# minput entries aligned with changes["keys"] (sliceable to its live head)
+_MINPUT_KEYS_ALIGNED = ("old_found", "old_min", "old_max",
+                        "new_found", "new_min", "new_max")
+
+
+@partial(jax.jit, static_argnames=("m",))
+def _slice_head(tree, m: int):
+    return jax.tree_util.tree_map(
+        lambda a: a[:m] if getattr(a, "ndim", 0) >= 1 else a, tree)
+
+
+def _pull_changes(changes: Dict[str, Any], formatted: bool = True,
+                  count: Optional[int] = None) -> Dict[str, Any]:
+    """Device change set -> host numpy, minimizing tunnel transfer: drop
+    pipeline-only entries when unwanted, slice keys-aligned arrays to the
+    live-prefix pow2 bucket (batch_reduce compacts live keys to a prefix),
+    then one batched device_get. minput u1/u2/u_cnt have their own
+    (possibly longer) live prefix, so they transfer unsliced."""
+    ch = {k: v for k, v in changes.items()
+          if formatted or k not in _PULL_DROP}
+    b = ch["keys"].shape[0]
+    if count is None:
+        count = int(ch["count"])
+    m = _bucket(count, lo=256)
+    if m < b:
+        flat = {k: v for k, v in ch.items() if not k.startswith("minput")}
+        sliced = dict(_slice_head(flat, m))
+        for k, v in ch.items():
+            if k.startswith("minput"):
+                sub = dict(v)
+                head = _slice_head(
+                    {kk: sub[kk] for kk in _MINPUT_KEYS_ALIGNED}, m)
+                sub.update(head)
+                sliced[k] = sub
+        ch = sliced
+    return jax.device_get(ch)
 
 
 def _bucket(n: int, lo: int = 256) -> int:
@@ -296,8 +360,12 @@ class DeviceHashAgg:
     """Host wrapper: owns the state, buffers the epoch's rows, applies at
     barrier, grows capacity on overflow (recompile per pow2 bucket)."""
 
-    def __init__(self, spec: DeviceAggSpec, capacity: int = 1024):
+    def __init__(self, spec: DeviceAggSpec, capacity: int = 1024,
+                 pull_formatted: bool = True):
         self.spec = spec
+        # False = flush_epoch skips transferring the device-formatted
+        # output entries (the SQL executor formats from raw payloads)
+        self.pull_formatted = pull_formatted
         self.state = spec.make_state(capacity)
         self.minputs: Tuple[SortedMultiset, ...] = tuple(
             ms_make(capacity) for _ in spec.minputs)
@@ -367,7 +435,14 @@ class DeviceHashAgg:
         self._inputs.append([(np.asarray(v), np.asarray(m)) for v, m in inputs])
 
     def flush_epoch(self) -> Optional[Dict[str, Any]]:
-        """Run the epoch step; returns the change set (host numpy) or None."""
+        """Run the epoch step; returns the change set (host numpy) or None.
+
+        The pull is transfer-optimized for remote devices: formatted
+        output entries (the fused-pipeline surface, unused by the SQL
+        executor) are not transferred, keys-aligned arrays are sliced on
+        device to the live-prefix bucket, and everything comes back in one
+        batched `jax.device_get` instead of one round-trip per leaf.
+        """
         if not self._keys:
             return None
         keys = np.concatenate(self._keys)
@@ -380,26 +455,37 @@ class DeviceHashAgg:
             ins.append((vs, ms))
         self._keys, self._signs, self._inputs = [], [], []
         b = _bucket(len(keys))
-        pad = b - len(keys)
-        mask = np.zeros(b, dtype=bool); mask[: len(keys)] = True
-        keys = np.pad(keys, (0, pad))
-        signs = np.pad(signs, (0, pad))
-        ins = tuple((jnp.asarray(np.pad(_acc_cast(v), (0, pad))),
-                     jnp.asarray(np.pad(m.astype(bool), (0, pad))))
-                    for v, m in ins)
-        jk, js, jm = jnp.asarray(keys), jnp.asarray(signs), jnp.asarray(mask)
+        n = len(keys)
+        ncalls = len(self.spec.calls)
+        # two packed buffers -> two H2D transfers total (see
+        # agg_epoch_step_packed): int64 values (floats bit-cast) + int8 flags
+        p64 = np.zeros((1 + ncalls, b), dtype=np.int64)
+        p8 = np.zeros((2 + ncalls, b), dtype=np.int8)
+        p64[0, :n] = keys
+        p8[0, :n] = signs
+        p8[1, :n] = 1
+        for i, (v, m) in enumerate(ins):
+            av = _acc_cast(v)
+            p64[1 + i, :n] = av.view(np.int64) \
+                if av.dtype == np.float64 else av
+            p8[2 + i, :n] = m.astype(np.int8)
+        jp64, jp8 = jnp.asarray(p64), jnp.asarray(p8)
         while True:
             full = DeviceAggState(self.state, self.minputs)
-            new_full, (needed, ms_needed), changes = agg_epoch_step_full(
-                self.spec, full, jk, js, jm, ins)
+            new_full, (needed, ms_needed), changes = agg_epoch_step_packed(
+                self.spec, full, jp64, jp8)
+            # one round trip for every control scalar (remote devices pay
+            # ~0.5s latency per pull, so per-scalar int() calls add up)
+            needed_h, ms_needed_h, count_h = jax.device_get(
+                (needed, ms_needed, changes["count"]))
             grown = False
-            if int(needed) > self.state.capacity:
+            if int(needed_h) > self.state.capacity:
                 self.state = grow_state(
-                    self.state, _bucket(int(needed),
+                    self.state, _bucket(int(needed_h),
                                         lo=self.state.capacity * 2),
                     self.spec.kinds)
                 grown = True
-            for i, nd in enumerate(ms_needed):
+            for i, nd in enumerate(ms_needed_h):
                 if int(nd) > self.minputs[i].capacity:
                     ms = ms_grow(self.minputs[i],
                                  _bucket(int(nd),
@@ -410,4 +496,5 @@ class DeviceHashAgg:
             if grown:
                 continue
             self.state, self.minputs = new_full.main, new_full.minputs
-            return jax.tree_util.tree_map(np.asarray, changes)
+            return _pull_changes(changes, self.pull_formatted,
+                                 count=int(count_h))

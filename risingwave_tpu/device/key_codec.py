@@ -86,10 +86,10 @@ class PackCodec:
                 for i, d in enumerate(self.dtypes)]
         return self.encode_columns(cols)
 
-    def decode(self, keys: np.ndarray) -> List[Tuple]:
-        """Vectorized unpack back to host key tuples."""
+    def decode_columns(self, keys: np.ndarray) -> List[Column]:
+        """Vectorized unpack into typed columns (no per-row Python)."""
         k = np.asarray(keys, dtype=np.int64).view(np.uint64)
-        parts: List[List[Any]] = []
+        out: List[Column] = []
         for dt, b in zip(reversed(self.dtypes), reversed(self.bits)):
             mask = np.uint64((1 << b) - 1)
             v = (k & mask).astype(np.uint64)
@@ -103,9 +103,15 @@ class PackCodec:
                 vals = (v.astype(np.int64)
                         - ((v & sign).astype(np.int64) << np.int64(1)))
                 vals = vals.astype(dt.np_dtype)
-            parts.append([None if nu else vv.item()
-                          for vv, nu in zip(vals, isnull)])
-        parts.reverse()
+            out.append(Column(dt, vals, ~isnull))
+        out.reverse()
+        return out
+
+    def decode(self, keys: np.ndarray) -> List[Tuple]:
+        """Unpack back to host key tuples."""
+        cols = self.decode_columns(keys)
+        parts = [[None if not ok else v for v, ok in
+                  zip(c.values.tolist(), c.validity.tolist())] for c in cols]
         return list(zip(*parts))
 
     def observe_columns(self, keys: np.ndarray, cols: Sequence[Column]) -> None:
@@ -165,6 +171,11 @@ class DictCodec:
 
     def decode(self, keys: np.ndarray) -> List[Tuple]:
         return [self._decode[k] for k in np.asarray(keys, np.int64).tolist()]
+
+    def decode_columns(self, keys: np.ndarray) -> List[Column]:
+        rows = self.decode(keys)
+        return [Column.from_list(d, [r[i] for r in rows])
+                for i, d in enumerate(self.dtypes)]
 
 
 def make_codec(dtypes: Sequence[DataType]):

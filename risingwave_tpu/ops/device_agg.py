@@ -167,10 +167,12 @@ class DeviceHashAggExecutor(UnaryExecutor):
         if mesh is not None:
             from ..parallel.sharded_agg import ShardedHashAgg
             self.engine: Any = ShardedHashAgg(self.spec, mesh,
-                                              capacity=capacity)
+                                              capacity=capacity,
+                                              pull_formatted=False)
         else:
             from ..device.agg_step import DeviceHashAgg
-            self.engine = DeviceHashAgg(self.spec, capacity=capacity)
+            self.engine = DeviceHashAgg(self.spec, capacity=capacity,
+                                        pull_formatted=False)
 
     # ---- recovery -------------------------------------------------------
     def _recover(self) -> None:
@@ -248,55 +250,6 @@ class DeviceHashAggExecutor(UnaryExecutor):
         self.engine.push_rows(keys, chunk.signs(), inputs)
         return iter(())
 
-    # ---- output derivation (exact host semantics from raw payloads) ----
-    def _format_row(self, vals: Sequence[np.ndarray], i: int,
-                    mm: Optional[Dict[int, np.ndarray]] = None) -> Tuple:
-        out: List[Any] = []
-        for ci, (call, dc) in enumerate(zip(self.calls, self.spec.calls)):
-            rt = call.return_type
-            if call.kind == "count":
-                out.append(int(vals[dc.cols[0]][i]))
-                continue
-            if call.kind in ("sum", "avg"):
-                acc = vals[dc.cols[0]][i]
-                n = int(vals[dc.cols[1]][i])
-                if n <= 0:
-                    out.append(None)
-                elif call.kind == "sum":
-                    if rt.kind == TypeKind.DECIMAL:
-                        out.append(Decimal(int(acc)))
-                    elif rt.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
-                        out.append(float(acc))
-                    else:
-                        out.append(int(acc))
-                else:  # avg
-                    if rt.kind == TypeKind.DECIMAL:
-                        out.append(Decimal(int(acc)) / Decimal(n))
-                    else:
-                        out.append(float(acc) / n)
-            elif dc.minput is not None:
-                # retractable min/max: extreme from the multiset changes
-                n = int(vals[dc.cols[0]][i])
-                if n <= 0 or mm is None:
-                    out.append(None)
-                else:
-                    enc = int(mm[ci][i])
-                    if self._minput_float[ci]:
-                        from ..device.minput import order_decode_f64
-                        out.append(float(order_decode_f64(
-                            np.array([enc], dtype=np.int64))[0]))
-                    else:
-                        out.append(enc)
-            else:  # min / max, append-only: monotone extreme column
-                n = int(vals[dc.cols[1]][i])
-                if n <= 0:
-                    out.append(None)
-                elif np.issubdtype(np.dtype(dc.acc_dtype), np.floating):
-                    out.append(float(vals[dc.cols[0]][i]))
-                else:
-                    out.append(int(vals[dc.cols[0]][i]))
-        return tuple(out)
-
     def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
         self._recover()
         ch = self.engine.flush_epoch()
@@ -307,6 +260,66 @@ class DeviceHashAggExecutor(UnaryExecutor):
             self.state_table.commit(barrier.epoch.curr)
         for tbl in self.minput_tables:
             tbl.commit(barrier.epoch.curr)
+
+    def _format_columns(self, vals: Sequence[np.ndarray], idxs: np.ndarray,
+                        mm: Optional[Dict[int, np.ndarray]]
+                        ) -> Tuple[List[np.ndarray], List[np.ndarray]]:
+        """Vectorized `_format_row` over the selected state rows: per call,
+        (values array in the output column's numpy dtype, validity mask).
+        Only DECIMAL outputs pay a per-row conversion (object columns)."""
+        outs: List[np.ndarray] = []
+        valids: List[np.ndarray] = []
+        n = len(idxs)
+        for ci, (call, dc) in enumerate(zip(self.calls, self.spec.calls)):
+            rt = call.return_type
+            if call.kind == "count":
+                outs.append(vals[dc.cols[0]][idxs].astype(np.int64))
+                valids.append(np.ones(n, dtype=bool))
+                continue
+            if call.kind in ("sum", "avg"):
+                acc = vals[dc.cols[0]][idxs]
+                cnt = vals[dc.cols[1]][idxs].astype(np.int64)
+                valid = cnt > 0
+                if rt.kind == TypeKind.DECIMAL:
+                    v = np.empty(n, dtype=object)
+                    for j in np.flatnonzero(valid).tolist():
+                        d = Decimal(int(acc[j]))
+                        v[j] = d if call.kind == "sum" \
+                            else d / Decimal(int(cnt[j]))
+                elif call.kind == "sum":
+                    v = acc.astype(rt.np_dtype)
+                else:
+                    v = (acc.astype(np.float64)
+                         / np.where(valid, cnt, 1)).astype(rt.np_dtype)
+                outs.append(v)
+                valids.append(valid)
+            elif dc.minput is not None:
+                # retractable min/max: extreme from the multiset changes
+                cnt = vals[dc.cols[0]][idxs].astype(np.int64)
+                valid = cnt > 0
+                if mm is None:
+                    valid = np.zeros(n, dtype=bool)
+                    enc = np.zeros(n, dtype=np.int64)
+                else:
+                    enc = mm[ci][idxs]
+                if self._minput_float[ci]:
+                    from ..device.minput import order_decode_f64
+                    outs.append(order_decode_f64(enc).astype(rt.np_dtype))
+                else:
+                    outs.append(enc.astype(rt.np_dtype))
+                valids.append(valid)
+            else:  # min / max, append-only: monotone extreme column
+                cnt = vals[dc.cols[1]][idxs].astype(np.int64)
+                outs.append(vals[dc.cols[0]][idxs].astype(rt.np_dtype))
+                valids.append(cnt > 0)
+        return outs, valids
+
+    @staticmethod
+    def _interleave(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+        out = np.empty(2 * len(old), dtype=old.dtype)
+        out[0::2] = old
+        out[1::2] = new
+        return out
 
     def _emit_changes(self, ch: Dict[str, Any],
                       barrier: Barrier) -> Iterator[Message]:
@@ -332,38 +345,97 @@ class DeviceHashAggExecutor(UnaryExecutor):
                 else ("old_min", "new_min")
             mm_old[ci] = np.asarray(sub[which[0]]).reshape(-1)
             mm_new[ci] = np.asarray(sub[which[1]]).reshape(-1)
-        key_tuples = self.codec.decode(keys[idxs])
-        out = StreamChunkBuilder(self.schema.dtypes)
-        for i, kt in zip(idxs.tolist(), key_tuples):
-            of, nf = bool(old_found[i]), bool(new_found[i])
-            if nf:
-                new_row = kt + self._format_row(new_vals, i, mm_new)
-            if of and nf:
-                old_row = kt + self._format_row(old_vals, i, mm_old)
-                if old_row != new_row:
-                    out.append_update(old_row, new_row)
-                self._persist(kt, new_vals, i)
-            elif nf:
-                out.append_row(Op.INSERT, new_row)
-                self._persist(kt, new_vals, i)
-            else:  # group died this epoch
-                out.append_row(Op.DELETE,
-                               kt + self._format_row(old_vals, i, mm_old))
-                if self.state_table is not None:
-                    self.state_table.delete(
-                        kt + tuple(self._payload_tuple(old_vals, i)))
+        of = old_found[idxs]
+        nf = new_found[idxs]
+        key_cols = self.codec.decode_columns(keys[idxs])
+        new_cols, new_valid = self._format_columns(new_vals, idxs, mm_new)
+        old_cols, old_valid = self._format_columns(old_vals, idxs, mm_old)
+        upd = of & nf
+        ins = nf & ~of
+        dead = of & ~nf
+        # suppress no-op updates (old row == new row, NaN-strict like the
+        # host tuple compare: NaN != NaN keeps the update)
+        if upd.any():
+            same = upd.copy()
+            for ov, ovl, nv, nvl in zip(old_cols, old_valid,
+                                        new_cols, new_valid):
+                with np.errstate(invalid="ignore"):
+                    eq = (ov == nv) & ovl & nvl | (~ovl & ~nvl)
+                same &= np.asarray(eq, dtype=bool)
+            upd &= ~same
+        u_ix = np.flatnonzero(upd)
+        i_ix = np.flatnonzero(ins)
+        d_ix = np.flatnonzero(dead)
+        n_out = 2 * len(u_ix) + len(i_ix) + len(d_ix)
+        if n_out:
+            ops = np.concatenate([
+                np.tile(np.array([Op.UPDATE_DELETE, Op.UPDATE_INSERT],
+                                 dtype=np.int8), len(u_ix)),
+                np.full(len(i_ix), Op.INSERT, dtype=np.int8),
+                np.full(len(d_ix), Op.DELETE, dtype=np.int8)])
+            out_cols: List[Any] = []
+            from ..core.chunk import Column
+            nk = len(self.group_key_indices)
+            for c in key_cols:
+                vv = np.concatenate([self._interleave(c.values[u_ix],
+                                                      c.values[u_ix]),
+                                     c.values[i_ix], c.values[d_ix]])
+                vl = np.concatenate([self._interleave(c.validity[u_ix],
+                                                      c.validity[u_ix]),
+                                     c.validity[i_ix], c.validity[d_ix]])
+                out_cols.append(Column(self._key_dtypes[len(out_cols)],
+                                       vv, vl))
+            for j in range(len(self.calls)):
+                vv = np.concatenate([self._interleave(old_cols[j][u_ix],
+                                                      new_cols[j][u_ix]),
+                                     new_cols[j][i_ix], old_cols[j][d_ix]])
+                vl = np.concatenate([self._interleave(old_valid[j][u_ix],
+                                                      new_valid[j][u_ix]),
+                                     new_valid[j][i_ix], old_valid[j][d_ix]])
+                out_cols.append(Column(self.schema.fields[nk + j].dtype,
+                                       vv, vl))
+            yield StreamChunk(ops, out_cols)
+        self._persist_batch(key_cols, nf, dead, old_vals, new_vals, idxs)
         self._persist_minputs(ch)
-        dead = idxs[old_found[idxs] & ~new_found[idxs]]
-        if len(dead):
-            self.codec.forget(keys[dead])
-        for chunk in out.drain():
-            yield chunk
+        dead_keys = keys[idxs[dead]]
+        if len(dead_keys):
+            self.codec.forget(dead_keys)
+
+    def _persist_batch(self, key_cols: Sequence[Any], nf: np.ndarray,
+                       dead: np.ndarray, old_vals: Sequence[np.ndarray],
+                       new_vals: Sequence[np.ndarray],
+                       idxs: np.ndarray) -> None:
+        """Bulk-upsert every touched live group's payload (and tombstone
+        dead groups) into the state table — the per-barrier recovery write,
+        vectorized end-to-end (`StateTable.write_chunk`)."""
+        if self.state_table is None:
+            return
+        from ..core.chunk import Column
+        n_ix = np.flatnonzero(nf)
+        d_ix = np.flatnonzero(dead)
+        if len(n_ix) == 0 and len(d_ix) == 0:
+            return
+        ops = np.concatenate([np.full(len(n_ix), Op.INSERT, dtype=np.int8),
+                              np.full(len(d_ix), Op.DELETE, dtype=np.int8)])
+        cols: List[Column] = []
+        for c, dt in zip(key_cols, self._key_dtypes):
+            cols.append(Column(
+                dt, np.concatenate([c.values[n_ix], c.values[d_ix]]),
+                np.concatenate([c.validity[n_ix], c.validity[d_ix]])))
+        for j, d in enumerate(self.spec.dtypes):
+            flt = np.issubdtype(np.dtype(d), np.floating)
+            npd = np.float64 if flt else np.int64
+            arr = np.concatenate([new_vals[j][idxs][n_ix],
+                                  old_vals[j][idxs][d_ix]]).astype(npd)
+            cols.append(Column(T.FLOAT64 if flt else T.INT64, arr))
+        self.state_table.write_chunk(StreamChunk(ops, cols))
 
     def _persist_minputs(self, ch: Dict[str, Any]) -> None:
         """Upsert/delete the touched (group, value, count) multiset pairs
         into the per-minput state tables (decode before dead-key forget)."""
         if not self.minput_tables:
             return
+        from ..core.chunk import Column
         from ..device.sorted_state import EMPTY_KEY
         for mi in range(len(self.spec.minputs)):
             sub = ch[f"minput{mi}"]
@@ -373,25 +445,14 @@ class DeviceHashAggExecutor(UnaryExecutor):
             sel = np.flatnonzero(u1 != EMPTY_KEY)
             if len(sel) == 0:
                 continue
-            gts = self.codec.decode(u1[sel])
-            tbl = self.minput_tables[mi]
-            for j, gt in zip(sel.tolist(), gts):
-                row = gt + (int(u2[j]), int(uc[j]))
-                if uc[j] == 0:
-                    tbl.delete(row)
-                else:
-                    tbl.insert(row)
-
-    def _payload_tuple(self, vals: Sequence[np.ndarray], i: int) -> List[Any]:
-        out = []
-        for d, v in zip(self.spec.dtypes, vals):
-            out.append(float(v[i]) if np.issubdtype(np.dtype(d), np.floating)
-                       else int(v[i]))
-        return out
-
-    def _persist(self, kt: Tuple, vals: Sequence[np.ndarray], i: int) -> None:
-        if self.state_table is not None:
-            self.state_table.insert(kt + tuple(self._payload_tuple(vals, i)))
+            gcols = self.codec.decode_columns(u1[sel])
+            ops = np.where(uc[sel] == 0, Op.DELETE, Op.INSERT) \
+                .astype(np.int8)
+            cols = [Column(dt, c.values, c.validity)
+                    for c, dt in zip(gcols, self._key_dtypes)]
+            cols.append(Column(T.INT64, u2[sel].astype(np.int64)))
+            cols.append(Column(T.INT64, uc[sel].astype(np.int64)))
+            self.minput_tables[mi].write_chunk(StreamChunk(ops, cols))
 
     # ---- watermark state cleaning (state_table.rs:1002 analog) ----------
     def _clean_state(self) -> None:

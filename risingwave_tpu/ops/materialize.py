@@ -41,11 +41,7 @@ class MaterializeExecutor(UnaryExecutor):
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
         chunk = chunk.compact()
         if self.conflict == ConflictBehavior.NO_CHECK:
-            for op, row in chunk.op_rows():
-                if op.is_insert:
-                    self.table.insert(row)
-                else:
-                    self.table.delete(row)
+            self.table.write_chunk(chunk)
             yield chunk
             return
         # conflict-checked path: rewrite the chunk against current state

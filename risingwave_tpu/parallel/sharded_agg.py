@@ -149,8 +149,10 @@ class ShardedHashAgg:
     """Host wrapper: global sharded state + epoch buffering + growth."""
 
     def __init__(self, spec: DeviceAggSpec, mesh: Mesh, capacity: int = 1024,
-                 vnode_count: int = VNODE_COUNT):
+                 vnode_count: int = VNODE_COUNT,
+                 pull_formatted: bool = True):
         self.spec = spec
+        self.pull_formatted = pull_formatted
         self.mesh = mesh
         self.n = mesh.devices.size
         self.vnode_count = vnode_count
@@ -354,4 +356,9 @@ class ShardedHashAgg:
             if grown:
                 continue
             self.state, self.minputs = new_state, new_ms
-            return jax.tree_util.tree_map(np.asarray, changes)
+            # one batched transfer; pipeline-only formatted outputs skip
+            # the pull when the consumer formats from raw payloads
+            from ..device.agg_step import _PULL_DROP
+            return jax.device_get(
+                {k: v for k, v in changes.items()
+                 if self.pull_formatted or k not in _PULL_DROP})

@@ -254,9 +254,11 @@ class Database:
             from ..connectors.nexmark import NexmarkGenerator
             table = stmt.with_options.get("nexmark.table", "bid").lower()
             maxe = stmt.with_options.get("nexmark.max.events")
+            per = int(stmt.with_options.get("nexmark.chunk.size", "8192"))
             if self._nexmark_gen is None:
                 self._nexmark_gen = NexmarkGenerator()
             return NexmarkReader(table, self._nexmark_gen,
+                                 events_per_poll=per,
                                  max_events=int(maxe) if maxe else None)
         if connector == "datagen":
             per = int(float(stmt.with_options.get("rows.per.poll", "1024")))
@@ -297,7 +299,11 @@ class Database:
         pk = list(ns.stream_key)
         tid = self.catalog.alloc_table_id()
         mv_table = StateTable(self.store, tid, schema.dtypes, pk)
-        mat = MaterializeExecutor(execu, mv_table, ConflictBehavior.OVERWRITE)
+        # operator change streams are exact (retractions carry full rows,
+        # updates arrive as U-/U+ pairs on the stream key), so the MV needs
+        # no conflict scan — NoCheck, like the reference's StreamMaterialize
+        # for non-DML inputs (materialize.rs handle_conflict gating)
+        mat = MaterializeExecutor(execu, mv_table, ConflictBehavior.NO_CHECK)
         shared = SharedStream(mat)
         obj = CatalogObject(stmt.name, "mv", schema, pk, tid)
         obj.n_visible = ns.n_visible

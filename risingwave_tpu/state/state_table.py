@@ -79,6 +79,41 @@ class StateTable:
     def delete(self, row: Sequence[Any]) -> None:
         self.mem[self.key_of(row)] = None
 
+    def write_chunk(self, chunk) -> None:
+        """Bulk mem-table apply of a StreamChunk (insert-like ops upsert,
+        delete-like ops tombstone), in chunk order. Key encoding is
+        vectorized when the pk columns are fixed-width and null-free
+        (`encode_key_matrix`); otherwise falls back to the per-row path.
+        The Materialize hot path at scale — per-row `key_of` would dominate
+        an epoch with 10^5 changed rows."""
+        import numpy as np
+        from ..core.chunk import _sign_of_ops
+        from ..core.encoding import encode_key_matrix
+        from ..core.vnode import compute_vnodes
+        chunk = chunk.compact()
+        n = chunk.capacity
+        if n == 0:
+            return
+        cols = chunk.columns
+        rows = chunk.data_chunk().rows()
+        ins = (_sign_of_ops(chunk.ops) > 0).tolist()
+        mat = encode_key_matrix([cols[i] for i in self.pk_indices],
+                                self.pk_dtypes, self.order_desc)
+        if mat is None:
+            for row, i in zip(rows, range(n)):
+                self.mem[self.key_of(row)] = row if ins[i] else None
+            return
+        vn = compute_vnodes([cols[i] for i in self.dist_key_indices], n,
+                            self.vnode_count)
+        full = np.empty((n, 2 + mat.shape[1]), np.uint8)
+        full[:, :2] = vn.astype(">u2").view(np.uint8).reshape(n, 2)
+        full[:, 2:] = mat
+        buf = full.tobytes()
+        w = full.shape[1]
+        mem = self.mem
+        for i, row in enumerate(rows):
+            mem[buf[i * w:(i + 1) * w]] = row if ins[i] else None
+
     def update(self, old_row: Sequence[Any], new_row: Sequence[Any]) -> None:
         ko, kn = self.key_of(old_row), self.key_of(new_row)
         if ko != kn:

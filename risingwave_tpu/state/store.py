@@ -22,7 +22,11 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 
 class KeyedTable:
-    """One table: dict + incrementally-maintained sorted key list."""
+    """One table: dict + lazily-rebuilt sorted key view.
+
+    Writes are O(1) dict ops; the sorted view rebuilds once per scan after
+    changes (ordered scans are rare next to writes — an epoch can upsert
+    10^5 keys, and per-write `insort` would make the batch quadratic)."""
 
     __slots__ = ("data", "_sorted", "_dirty")
 
@@ -33,20 +37,18 @@ class KeyedTable:
 
     def put(self, key: bytes, value: Tuple) -> None:
         if key not in self.data:
-            if not self._dirty:
-                bisect.insort(self._sorted, key)
+            self._dirty = True
         self.data[key] = value
 
     def delete(self, key: bytes) -> None:
-        if self.data.pop(key, None) is not None and not self._dirty:
-            # lazy: mark dirty instead of O(n) removal; rebuilt on next scan
+        if self.data.pop(key, None) is not None:
             self._dirty = True
 
     def get(self, key: bytes) -> Optional[Tuple]:
         return self.data.get(key)
 
     def _keys(self) -> List[bytes]:
-        if self._dirty or len(self._sorted) != len(self.data):
+        if self._dirty:
             self._sorted = sorted(self.data.keys())
             self._dirty = False
         return self._sorted

@@ -70,7 +70,7 @@ def test_agg_output_breaks_append_only():
     assert agg is not None and not agg.spec.append_only
 
 
-def test_append_only_parity_with_host_tumble_minmax(nexmark_pair=None):
+def test_append_only_parity_with_host_minmax():
     host, dev = Database(device="off"), Database(device="on")
     for db in (host, dev):
         db.run(SRC)
