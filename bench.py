@@ -1,55 +1,213 @@
-"""Benchmark: Nexmark-q4-style streaming group-by aggregation throughput.
+"""Nexmark benchmarks: device (TPU) vs honest CPU baselines.
 
-Workload: bid events (hot-auction power-law, uniform prices), GROUP BY
-auction -> count(*) / sum(price) / max(price), materialized into an
-MV — the reference's `hash_agg.rs` + `materialize.rs` hot path, with the
-datagen source on-device (the reference also benches against an in-process
-datagen connector; see device/datagen.py).
+Workloads (BASELINE.json targets; reference SQL from
+`/root/reference/src/tests/simulation/src/nexmark/q{5,7,8}.sql`):
 
-The device path is the fused epoch program (device/pipeline.py): source,
-exchange-free single-chip agg, and MV upsert all in HBM; the host touches
-the device once per epoch to enqueue the step. Correctness: the final MV is
-pulled and checked bit-for-bit against the exact host path on the same
-event stream before the score is reported.
+1. **q4 fused ceiling** — bid datagen + group-by agg + MV upsert as one
+   jitted program per epoch, everything resident in HBM
+   (`device/pipeline.py`). This is the architecture's headline number.
+2. **q4 through SQL** — `CREATE SOURCE ... nexmark` + `CREATE MATERIALIZED
+   VIEW` with the device dispatch seam on: host datagen, chunks through the
+   executor stack, epochs on the TPU, recovery persistence on. Ingest-
+   inclusive (host->device transfer is in the measured path).
+3. **q5 / q7 / q8 through SQL** — the full reference queries (hop/tumble
+   windows, self-joins) on the device path, small-to-moderate scale.
 
-Baseline = the exact host (numpy/dict) path of this framework, i.e. the
-"single-node CPU" reference of BASELINE.json.
+Baselines, stated per workload:
+- `numpy_batch_eps`: a vectorized single-node CPU implementation of the
+  same query (sort/reduceat groupby — the strongest simple CPU baseline;
+  batch one-shot, no incremental maintenance, no durability).
+- `host_sql_eps`: this framework's exact host executor path (device off),
+  measured at a smaller scale (it is per-row Python).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Correctness: every SQL workload's final MV is compared against an
+independently computed numpy oracle over the SAME event stream (bit-exact
+multiset equality). The fused ceiling is verified against the numpy
+groupby of its on-device-generated stream.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 """
 import json
+import os
 import time
 
 import numpy as np
 
+# q4 fused-ceiling scale
 EPOCHS = 50
-ROWS = 262_144          # events per epoch (pow2 keeps one compiled shape)
-N_AUCTIONS = 10_000     # live auctions
-HOST_EPOCHS = 4         # host baseline is timed on a subset (it's slow)
+ROWS = 262_144
+N_AUCTIONS = 10_000
+# SQL-path scales (events are 1:3:46 person:auction:bid out of 50)
+Q4_SQL_EVENTS = 2_621_440            # 5 epochs of 64 x 8192-row chunks
+QX_SQL_EVENTS = 1_048_576            # q5/q7/q8 device scale
+HOST_SQL_EVENTS = 131_072            # host path is per-row Python
+HOST_QX_EVENTS = 16_384              # hop expansion is 5x rows on host
+
+USEC = 1_000_000
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='8192')")
+AUCTION_SRC = ("CREATE SOURCE auction (id BIGINT, item_name VARCHAR,"
+               " description VARCHAR, initial_bid BIGINT, reserve BIGINT,"
+               " date_time TIMESTAMP, expires TIMESTAMP, seller BIGINT,"
+               " category BIGINT, extra VARCHAR) WITH (connector='nexmark',"
+               " nexmark.table='auction', nexmark.max.events='{n}',"
+               " nexmark.chunk.size='8192')")
+PERSON_SRC = ("CREATE SOURCE person (id BIGINT, name VARCHAR,"
+              " email_address VARCHAR, credit_card VARCHAR, city VARCHAR,"
+              " state VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
+              " WITH (connector='nexmark', nexmark.table='person',"
+              " nexmark.max.events='{n}', nexmark.chunk.size='8192')")
+
+Q4_MV = ("CREATE MATERIALIZED VIEW q4 AS SELECT auction, count(*) AS c,"
+         " sum(price) AS s, max(price) AS m FROM bid GROUP BY auction")
+
+Q5_MV = """CREATE MATERIALIZED VIEW nexmark_q5 AS
+SELECT AuctionBids.auction, AuctionBids.num FROM (
+    SELECT bid.auction, count(*) AS num, window_start AS starttime
+    FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+    GROUP BY window_start, bid.auction
+) AS AuctionBids
+JOIN (
+    SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+    FROM (
+        SELECT count(*) AS num, window_start AS starttime_c
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY bid.auction, window_start
+    ) AS CountBids
+    GROUP BY CountBids.starttime_c
+) AS MaxBids
+ON AuctionBids.starttime = MaxBids.starttime_c
+   AND AuctionBids.num >= MaxBids.maxn"""
+
+Q7_MV = """CREATE MATERIALIZED VIEW nexmark_q7 AS
+SELECT B.auction, B.price, B.bidder, B.date_time
+FROM bid B
+JOIN (
+    SELECT MAX(price) AS maxprice, window_end as date_time
+    FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)
+    GROUP BY window_end
+) B1 ON B.price = B1.maxprice
+WHERE B.date_time BETWEEN B1.date_time - INTERVAL '10' SECOND
+      AND B1.date_time"""
+
+Q8_MV = """CREATE MATERIALIZED VIEW nexmark_q8 AS
+SELECT P.id, P.name, P.starttime
+FROM (
+    SELECT id, name, window_start AS starttime, window_end AS endtime
+    FROM TUMBLE(person, date_time, INTERVAL '10' SECOND)
+    GROUP BY id, name, window_start, window_end
+) P
+JOIN (
+    SELECT seller, window_start AS starttime, window_end AS endtime
+    FROM TUMBLE(auction, date_time, INTERVAL '10' SECOND)
+    GROUP BY seller, window_start, window_end
+) A ON P.id = A.seller AND P.starttime = A.starttime
+   AND P.endtime = A.endtime"""
 
 
-def build():
+# ---------------------------------------------------------------------------
+# numpy batch baselines / oracles (vectorized single-node CPU)
+# ---------------------------------------------------------------------------
+
+def groupby_reduce(keys: np.ndarray, cols):
+    """Sort-reduceat groupby: [(reduce, col), ...] -> (ukeys, results)."""
+    order = np.argsort(keys, kind="stable")
+    k = keys[order]
+    bounds = np.flatnonzero(np.r_[True, k[1:] != k[:-1]])
+    out = []
+    for how, c in cols:
+        if how == "count":
+            out.append(np.diff(np.r_[bounds, len(k)]))
+            continue
+        c = c[order]
+        if how == "sum":
+            out.append(np.add.reduceat(c, bounds))
+        elif how == "max":
+            out.append(np.maximum.reduceat(c, bounds))
+    return k[bounds], out
+
+
+def numpy_q4(auction, price):
+    keys, (c, s, m) = groupby_reduce(
+        auction, [("count", None), ("sum", price), ("max", price)])
+    return {int(k): (int(cc), int(ss), int(mm))
+            for k, cc, ss, mm in zip(keys, c, s, m)}
+
+
+def _hop_expand(ts, hop, size):
+    """Per-row window_starts for HOP (latest aligned start <= ts, n back)."""
+    n = size // hop
+    first = (ts // hop) * hop
+    offs = (np.arange(n) * hop)[None, :]
+    return (first[:, None] - offs).reshape(-1)   # row-major: row i repeats n
+
+
+def numpy_q5(auction, ts):
+    hop, size = 2 * USEC, 10 * USEC
+    n = size // hop
+    ws = _hop_expand(ts, hop, size)
+    au = np.repeat(auction, n)
+    # normalize window starts to small hop ordinals so the composite
+    # (window, auction) key fits in int64
+    wn = (ws - ws.min()) // hop
+    composite = wn * np.int64(1 << 32) + au      # auction ids << 2^32
+    keys, (num,) = groupby_reduce(composite, [("count", None)])
+    kws, kau = keys >> 32, keys & ((1 << 32) - 1)
+    out = {}
+    for w in np.unique(kws):
+        sel = kws == w
+        mx = num[sel].max()
+        for a, c in zip(kau[sel][num[sel] >= mx], num[sel][num[sel] >= mx]):
+            out[(int(w), int(a))] = int(c)
+    # multiset of output rows (auction, num)
+    rows = sorted((a, c) for (_w, a), c in out.items())
+    return rows
+
+
+def numpy_q7(auction, bidder, price, ts):
+    size = 10 * USEC
+    wend = (ts // size) * size + size
+    keys, (mp,) = groupby_reduce(wend, [("max", price)])
+    rows = []
+    for e, m in zip(keys, mp):
+        sel = (price == m) & (ts >= e - size) & (ts <= e)
+        for i in np.flatnonzero(sel):
+            rows.append((int(auction[i]), int(price[i]), int(bidder[i]),
+                         int(ts[i])))
+    return sorted(rows)
+
+
+def numpy_q8(p_id, p_name, p_ts, a_seller, a_ts):
+    size = 10 * USEC
+    pw = (p_ts // size) * size
+    aw = (a_ts // size) * size
+    persons = {(int(i), str(nm), int(w)) for i, nm, w in zip(p_id, p_name, pw)}
+    sellers = {(int(s), int(w)) for s, w in zip(a_seller, aw)}
+    rows = [(i, nm, w) for (i, nm, w) in persons if (i, w) in sellers]
+    return sorted(rows)
+
+
+# ---------------------------------------------------------------------------
+# workload 1: fused device ceiling
+# ---------------------------------------------------------------------------
+
+def run_fused():
+    import jax
+    import jax.numpy as jnp
     from risingwave_tpu.device.agg_step import DeviceAggSpec
-    from risingwave_tpu.device.pipeline import make_bid_pipeline
+    from risingwave_tpu.device.pipeline import bid_agg_epoch, make_bid_pipeline
 
     spec = DeviceAggSpec.build(["count_star", "sum", "max"],
                                [np.int64, np.int64, np.int64])
     agg, mv = make_bid_pipeline(spec, 1 << 14)
-    return spec, agg, mv
-
-
-def run_device():
-    import jax
-    import jax.numpy as jnp
-    from risingwave_tpu.device.pipeline import bid_agg_epoch
-
-    spec, agg, mv = build()
     rng = jax.random.PRNGKey(42)
     zero = jnp.zeros((), jnp.int32)
-    # warmup/compile
     a, m, r, mn = bid_agg_epoch(spec, ROWS, N_AUCTIONS, agg, mv, rng, zero)
-    jax.block_until_ready(mn)
-    # timed run from fresh state
+    jax.block_until_ready(mn)      # compile
     rng = jax.random.PRNGKey(42)
     mn = zero
     t0 = time.perf_counter()
@@ -62,80 +220,197 @@ def run_device():
     return EPOCHS * ROWS / dt, (spec, agg, mv)
 
 
-def host_events():
-    """Replay the device generator's event stream on host (same seed)."""
+def fused_event_stream():
+    """Replay the fused pipeline's on-device generator on host (device
+    arrays accumulate, ONE batched pull — remote links pay per transfer)."""
     import jax
     from risingwave_tpu.device.datagen import gen_bids
-
     rng = jax.random.PRNGKey(42)
-    out = []
+    auctions, prices = [], []
     for _ in range(EPOCHS):
         auction, price, rng = gen_bids(rng, ROWS, N_AUCTIONS)
-        out.append((np.asarray(auction), np.asarray(price)))
-    return out
+        auctions.append(auction)
+        prices.append(price)
+    auctions, prices = jax.device_get((auctions, prices))
+    return np.concatenate(auctions), np.concatenate(prices)
 
 
-def run_host(epochs):
-    """Exact host path: AggGroup dict loop (HashAggExecutor's hot loop).
-    Throughput is timed over the first HOST_EPOCHS; the full replay then
-    continues so the end state doubles as the parity oracle."""
+def host_dict_eps(auction, price, n=2 * ROWS):
+    """The per-row Python loop (this framework's exact host agg hot loop) —
+    kept for continuity with BENCH_r01; NOT the honest CPU baseline."""
     from risingwave_tpu.expr.agg import AggCall, create_agg_state
     from risingwave_tpu.expr.expression import InputRef
     from risingwave_tpu.core import dtypes as T
-
     price_ref = InputRef(1, T.INT64)
     calls = [AggCall("count"), AggCall("sum", price_ref),
              AggCall("max", price_ref)]
     groups = {}
-    eps = None
     t0 = time.perf_counter()
-    for n_done, (k, p) in enumerate(epochs):
-        if n_done == HOST_EPOCHS:
-            eps = HOST_EPOCHS * ROWS / (time.perf_counter() - t0)
-        for i in range(len(k)):
-            g = groups.get(k[i])
-            if g is None:
-                g = groups[k[i]] = [create_agg_state(c) for c in calls]
-            g[0].apply(1, 1)
-            g[1].apply(1, int(p[i]))
-            g[2].apply(1, int(p[i]))
-    if eps is None:
-        eps = len(epochs) * ROWS / (time.perf_counter() - t0)
-    return eps, groups
+    for i in range(n):
+        g = groups.get(auction[i])
+        if g is None:
+            g = groups[auction[i]] = [create_agg_state(c) for c in calls]
+        g[0].apply(1, 1)
+        g[1].apply(1, int(price[i]))
+        g[2].apply(1, int(price[i]))
+    return n / (time.perf_counter() - t0)
 
 
-def verify(spec, mv, host_groups):
-    """Final MV must equal the exact host path's outputs
-    (barrier-boundary parity, the reference's core oracle)."""
+def verify_fused(spec, mv, oracle):
     from risingwave_tpu.device.materialize import mv_rows
-
     keys, cols, nulls = mv_rows(mv, [c.acc_dtype for c in spec.calls])
-    assert len(keys) == len(host_groups), (len(keys), len(host_groups))
+    assert len(keys) == len(oracle), (len(keys), len(oracle))
     for i, key in enumerate(keys.tolist()):
-        expect = [st.output() for st in host_groups[key]]
         got = (int(cols[0][i]), int(cols[1][i]), int(cols[2][i]))
-        assert got == tuple(int(e) for e in expect), (key, got, expect)
+        assert got == oracle[key], (key, got, oracle[key])
+
+
+# ---------------------------------------------------------------------------
+# SQL-path workloads
+# ---------------------------------------------------------------------------
+
+def nexmark_host_columns(n_events):
+    """Replay the SQL connector's generator host-side (same seed/config)."""
+    from risingwave_tpu.connectors.nexmark import NexmarkGenerator
+    chunks = NexmarkGenerator().gen_range(0, n_events)
+    out = {}
+    for name, ch in chunks.items():
+        if ch is not None:
+            out[name] = [c.values for c in ch.columns]
+    return out
+
+
+def drive(db, n_events, chunk=8192):
+    """Tick until the bounded sources drain; return wall seconds."""
+    ticks = n_events // (64 * chunk) + 3
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        db.tick()
+    return time.perf_counter() - t0
+
+
+def _device_cfg(on, capacity):
+    if not on:
+        return "off"
+    from risingwave_tpu.config import DeviceConfig
+    return DeviceConfig(capacity=capacity)
+
+
+def run_q4_sql(on, n_events):
+    from risingwave_tpu.sql import Database
+    db = Database(device=_device_cfg(on, 1 << 18))
+    db.run(BID_SRC.format(n=n_events))
+    db.run(Q4_MV)
+    dt = drive(db, n_events)
+    rows = db.query("SELECT * FROM q4")
+    return n_events / dt, rows
+
+
+def run_qx_sql(on, n_events):
+    """q5+q7+q8 in one database (sources shared, compile cache shared)."""
+    from risingwave_tpu.sql import Database
+    db = Database(device=_device_cfg(on, 1 << 21))
+    db.run(BID_SRC.format(n=n_events))
+    db.run(AUCTION_SRC.format(n=n_events))
+    db.run(PERSON_SRC.format(n=n_events))
+    db.run(Q5_MV)
+    db.run(Q7_MV)
+    db.run(Q8_MV)
+    dt = drive(db, n_events)
+    out = {
+        "q5": db.query("SELECT * FROM nexmark_q5"),
+        "q7": db.query("SELECT * FROM nexmark_q7"),
+        "q8": db.query("SELECT * FROM nexmark_q8"),
+    }
+    return n_events / dt, out
 
 
 def main():
     import jax
+    detail = {"platform": jax.devices()[0].platform}
 
-    device_eps, (spec, agg, mv) = run_device()
-    events = host_events()
-    host_eps, host_groups = run_host(events)
-    verify(spec, mv, host_groups)
+    # -- workload 1: fused ceiling + its baselines ------------------------
+    fused_eps, (spec, agg, mv) = run_fused()
+    auction, price = fused_event_stream()
+    t0 = time.perf_counter()
+    oracle = numpy_q4(auction, price)
+    numpy_q4_eps = len(auction) / (time.perf_counter() - t0)
+    verify_fused(spec, mv, oracle)
+    dict_eps = host_dict_eps(auction, price)
+    detail["q4_fused"] = {
+        "device_eps": round(fused_eps),
+        "numpy_batch_eps": round(numpy_q4_eps),
+        "python_dict_eps": round(dict_eps),
+        "events": EPOCHS * ROWS, "groups": len(oracle),
+        "mv_verified": True,
+        "note": "datagen on device; numpy baseline is compute-only "
+                "sort-reduce over the identical replayed stream",
+    }
+
+    # -- workload 2: q4 through SQL ---------------------------------------
+    q4_eps, q4_rows = run_q4_sql(True, Q4_SQL_EVENTS)
+    cols = nexmark_host_columns(Q4_SQL_EVENTS)["bid"]
+    q4_oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
+    assert len(q4_rows) == len(q4_oracle)
+    for a, c, s, m in q4_rows:
+        assert q4_oracle[int(a)] == (int(c), int(s), int(m)), a
+    host_q4_eps, _ = run_q4_sql(False, HOST_SQL_EVENTS)
+    detail["q4_sql"] = {
+        "device_eps": round(q4_eps), "host_sql_eps": round(host_q4_eps),
+        "events": Q4_SQL_EVENTS, "groups": len(q4_rows),
+        "mv_verified": True,
+        "note": "full SQL stack, ingest-inclusive (host nexmark datagen + "
+                "chunk transfer in the measured path); host_sql_eps "
+                f"measured at {HOST_SQL_EVENTS} events",
+    }
+
+    # -- workload 3: q5/q7/q8 through SQL ---------------------------------
+    try:
+        qx_eps, qx = run_qx_sql(True, QX_SQL_EVENTS)
+        c = nexmark_host_columns(QX_SQL_EVENTS)
+        bid, auc, per = c["bid"], c["auction"], c["person"]
+        t0 = time.perf_counter()
+        q5_oracle = numpy_q5(bid[0].astype(np.int64),
+                             bid[5].astype(np.int64))
+        q5_np_eps = len(bid[0]) / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        q7_oracle = numpy_q7(bid[0].astype(np.int64), bid[1].astype(np.int64),
+                             bid[2].astype(np.int64), bid[5].astype(np.int64))
+        q7_np_eps = len(bid[0]) / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        q8_oracle = numpy_q8(per[0].astype(np.int64), per[1],
+                             per[6].astype(np.int64),
+                             auc[7].astype(np.int64), auc[5].astype(np.int64))
+        q8_np_eps = (len(per[0]) + len(auc[0])) / (time.perf_counter() - t0)
+        assert sorted((int(a), int(n)) for a, n in qx["q5"]) == q5_oracle
+        assert sorted((int(a), int(p), int(b), int(t))
+                      for a, p, b, t in qx["q7"]) == q7_oracle
+        assert sorted((int(i), str(nm), int(w))
+                      for i, nm, w in qx["q8"]) == q8_oracle
+        host_qx_eps, _ = run_qx_sql(False, HOST_QX_EVENTS)
+        detail["q5_q7_q8_sql"] = {
+            "device_eps": round(qx_eps), "host_sql_eps": round(host_qx_eps),
+            "events": QX_SQL_EVENTS,
+            "numpy_batch_eps": {"q5": round(q5_np_eps),
+                                "q7": round(q7_np_eps),
+                                "q8": round(q8_np_eps)},
+            "rows": {k: len(v) for k, v in qx.items()},
+            "mv_verified": True,
+            "note": "three reference-SQL MVs concurrently over shared "
+                    "sources; device_eps counts each source event once; "
+                    "oracles computed independently in numpy",
+        }
+    except Exception as e:  # keep the headline even if qx trips
+        detail["q5_q7_q8_sql"] = {"error": f"{type(e).__name__}: {e}"}
+
     result = {
         "metric": "nexmark_q4_agg_throughput",
-        "value": round(device_eps),
+        "value": round(fused_eps),
         "unit": "events/s",
-        "vs_baseline": round(device_eps / host_eps, 3),
-        "detail": {
-            "host_baseline_eps": round(host_eps),
-            "epochs": EPOCHS, "rows_per_epoch": ROWS,
-            "groups": int(np.asarray(agg.count)),
-            "mv_verified": True,
-            "platform": jax.devices()[0].platform,
-        },
+        # honest denominator: the vectorized numpy batch baseline, not the
+        # per-row Python loop BENCH_r01 used (that ratio is in detail)
+        "vs_baseline": round(fused_eps / numpy_q4_eps, 3),
+        "detail": detail,
     }
     print(json.dumps(result))
 

@@ -14,9 +14,27 @@ barrier granularity.
 
 64-bit keys/accumulators need x64 — enabled here, before any array is made.
 """
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: epoch-program compiles are expensive
+# (tens of seconds per shape on a remote-compile TPU tunnel) and fully
+# deterministic, so they are cached on disk across processes. Repo-local
+# by default; override with RW_TPU_JAX_CACHE (empty string disables).
+# Enabled ONLY under the TPU tunnel platform: with remote compile, CPU
+# AOT results come from the remote machine's CPU features and loading
+# them on this host risks SIGILL/garbage (observed), so CPU-platform
+# runs (tests) must not share the cache.
+_cache_dir = os.environ.get(
+    "RW_TPU_JAX_CACHE",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".jax_cache"))
+if _cache_dir and "axon" in os.environ.get("JAX_PLATFORMS", ""):
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 from .sorted_state import (  # noqa: E402,F401
     EMPTY_KEY,

@@ -164,15 +164,41 @@ class DeviceHashAggExecutor(UnaryExecutor):
                                and not np.issubdtype(
                                    np.dtype(dc.acc_dtype), np.floating)]
         self.mesh = mesh
+        self._capacity = capacity
+        self.engine: Any = self._make_engine(mesh, capacity)
+
+    def _make_engine(self, mesh: Optional[Any], capacity: int) -> Any:
         if mesh is not None:
             from ..parallel.sharded_agg import ShardedHashAgg
-            self.engine: Any = ShardedHashAgg(self.spec, mesh,
-                                              capacity=capacity,
-                                              pull_formatted=False)
-        else:
-            from ..device.agg_step import DeviceHashAgg
-            self.engine = DeviceHashAgg(self.spec, capacity=capacity,
-                                        pull_formatted=False)
+            return ShardedHashAgg(self.spec, mesh, capacity=capacity,
+                                  pull_formatted=False)
+        from ..device.agg_step import DeviceHashAgg
+        return DeviceHashAgg(self.spec, capacity=capacity,
+                             pull_formatted=False)
+
+    def rescale_mesh(self, mesh: Optional[Any]) -> None:
+        """Barrier-boundary elastic rescale (`scale.rs:2329` analog):
+        lift the live device state off the old mesh and re-install it
+        vnode-sharded onto the new one (None = single chip). The caller
+        (Database._alter_parallelism) guarantees the in-flight barrier
+        committed, so the epoch buffers are empty."""
+        assert not getattr(self.engine, "_keys", None) \
+            and not getattr(self.engine, "_rows", None), \
+            "rescale requires a barrier boundary (buffered rows pending)"
+        n_new = mesh.devices.size if mesh is not None else 1
+        n_old = self.mesh.devices.size if self.mesh is not None else 1
+        if n_new == n_old:
+            return
+        keys, vals = self.engine.live_main()
+        minputs = [self.engine.live_minput(mi)
+                   for mi in range(len(self.spec.minputs))]
+        self.mesh = mesh
+        self.engine = self._make_engine(mesh, self._capacity)
+        if len(keys):
+            self.engine.load_state(keys, vals)
+        for mi, (k1, k2, cnt) in enumerate(minputs):
+            if len(k1):
+                self.engine.load_minput(mi, k1, k2, cnt)
 
     # ---- recovery -------------------------------------------------------
     def _recover(self) -> None:

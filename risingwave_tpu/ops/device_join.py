@@ -82,15 +82,10 @@ class DeviceHashJoinExecutor(Executor):
         self.state_tables = {"a": left_state, "b": right_state}
         self._recovered = left_state is None and right_state is None
         self.max_chunk_size = max_chunk_size
-        if mesh is not None:
-            from ..parallel.sharded_join import ShardedHashJoin
-            self.engine: Any = ShardedHashJoin([], [], mesh,
-                                               capacity=capacity,
-                                               pair_capacity=pair_capacity)
-        else:
-            from ..device.join_step import DeviceHashJoin
-            self.engine = DeviceHashJoin([], [], capacity=capacity,
-                                         pair_capacity=pair_capacity)
+        self.mesh = mesh
+        self._capacity = capacity
+        self._pair_capacity = pair_capacity
+        self.engine: Any = self._make_engine(mesh)
         self.dicts = {"a": _RowDict(), "b": _RowDict()}
         # per-epoch net state-row changes: rh -> (net sign, row). Drives
         # both state-table persistence and row-cache eviction — an entry is
@@ -104,6 +99,39 @@ class DeviceHashJoinExecutor(Executor):
         self._wm: Dict[str, Dict[int, Any]] = {"a": {}, "b": {}}
         self._emitted_wm: Dict[int, Any] = {}
         self._clean_wm: Dict[int, Any] = {}
+
+    def _make_engine(self, mesh: Optional[Any]) -> Any:
+        if mesh is not None:
+            from ..parallel.sharded_join import ShardedHashJoin
+            return ShardedHashJoin([], [], mesh, capacity=self._capacity,
+                                   pair_capacity=self._pair_capacity)
+        from ..device.join_step import DeviceHashJoin
+        return DeviceHashJoin([], [], capacity=self._capacity,
+                              pair_capacity=self._pair_capacity)
+
+    def rescale_mesh(self, mesh: Optional[Any]) -> None:
+        """Barrier-boundary elastic rescale: rebuild the engine on the new
+        mesh and lazily re-load both sides from the committed state tables
+        (the recovery path — join state is fully durable per barrier, so
+        re-recovery IS the reshard)."""
+        buf = getattr(self.engine, "_buf", None)
+        assert not buf or not any(buf.values()), \
+            "rescale requires a barrier boundary (buffered rows pending)"
+        n_new = mesh.devices.size if mesh is not None else 1
+        n_old = self.mesh.devices.size if self.mesh is not None else 1
+        if n_new == n_old:
+            return
+        assert all(st is not None for st in self.state_tables.values()), \
+            "join rescale requires state tables (re-recovery reshard)"
+        self.mesh = mesh
+        self.engine = self._make_engine(mesh)
+        self.dicts = {"a": _RowDict(), "b": _RowDict()}
+        self._epoch_net = {"a": {}, "b": {}}
+        # eager: the execute() generator only checks _recovered at stream
+        # start, which already ran — reload both sides now (tables are
+        # committed; the caller is at a barrier boundary)
+        self._recovered = False
+        self._recover()
 
     # ---- recovery -------------------------------------------------------
     def _recover(self) -> None:

@@ -248,3 +248,10 @@ class ShowObjects:
 @dataclass
 class Explain:
     stmt: Any
+
+
+@dataclass
+class AlterParallelism:
+    """ALTER MATERIALIZED VIEW <name> SET PARALLELISM <n>."""
+    name: str
+    parallelism: int

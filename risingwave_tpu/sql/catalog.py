@@ -24,6 +24,7 @@ class CatalogObject:
     watermark_col: Optional[int] = None
     watermark_delay_usecs: int = 0
     n_visible: Optional[int] = None   # hidden stream-key cols sit past this
+    parallelism: Optional[int] = None  # ALTER ... SET PARALLELISM override
     # runtime attachments (set by Database)
     runtime: Any = None
 

@@ -152,7 +152,8 @@ class Database:
         for stmt, text in parse_sql_with_text(sql):
             result = self._execute(stmt)
             if isinstance(stmt, (A.CreateTable, A.CreateMaterializedView,
-                                 A.CreateSink, A.DropObject)):
+                                 A.CreateSink, A.DropObject,
+                                 A.AlterParallelism)):
                 self._log_ddl(text)
             out.append(result)
         return out
@@ -188,6 +189,8 @@ class Database:
             return self.catalog.list(kind)
         if isinstance(stmt, A.Explain):
             return repr(stmt.stmt)
+        if isinstance(stmt, A.AlterParallelism):
+            return self._alter_parallelism(stmt)
         raise ValueError(f"unsupported statement {stmt!r}")
 
     # ------------------------------------------------------------------
@@ -314,6 +317,49 @@ class Database:
         self.catalog.create(obj)
         self._iters[stmt.name] = obj.runtime["port"].execute()
         return "CREATE_MATERIALIZED_VIEW"
+
+    def _alter_parallelism(self, stmt: A.AlterParallelism) -> str:
+        """Elastic scale-out/in of one job's device-sharded operators
+        (`src/meta/src/stream/scale.rs:2329` reschedule analog).
+
+        Runs at a barrier boundary: `flush()` completes the in-flight
+        barrier on every job first (all epoch buffers empty, state
+        committed), then each device engine re-shards its vnode-mapped
+        state onto an n-device mesh (`parallel/rescale.py`). Logged to the
+        DDL log, so recovery replays the same topology — engines that
+        recover AFTER the replayed rescale load their rows straight onto
+        the new mesh."""
+        obj = self.catalog.get(stmt.name)
+        if obj.kind != "mv":
+            raise ValueError(f"{stmt.name!r} is not a materialized view")
+        n = stmt.parallelism
+        if n < 1:
+            raise ValueError("PARALLELISM must be >= 1")
+        if not self._replaying:
+            # barrier boundary; during DDL-log replay the dataflow is
+            # half-rebuilt and ticking it would feed sources into only the
+            # already-replayed jobs (buffers are empty anyway on replay)
+            self.flush()
+        from ..parallel import make_mesh
+        mesh = make_mesh(n) if n > 1 else None
+        rescaled = 0
+        stack = [obj.runtime["shared"].upstream]
+        seen = set()
+        while stack:
+            e = stack.pop()
+            if id(e) in seen:
+                continue
+            seen.add(id(e))
+            if hasattr(e, "rescale_mesh"):
+                e.rescale_mesh(mesh)
+                rescaled += 1
+            for attr in ("input", "port", "left_exec", "right_exec",
+                         "barrier_source"):
+                c = getattr(e, attr, None)
+                if c is not None:
+                    stack.append(c)
+        obj.parallelism = n
+        return f"ALTER_PARALLELISM_{rescaled}"
 
     def _create_sink(self, stmt: A.CreateSink) -> str:
         self._pending_subs = []

@@ -48,6 +48,7 @@ _KEYWORDS = {
     "over", "partition", "watermark", "for", "append", "only", "explain",
     "tumble", "hop", "emit", "window", "close", "cascade", "rows", "range",
     "unbounded", "preceding", "following", "current", "row", "union", "all",
+    "alter",
 }
 
 
@@ -173,11 +174,30 @@ class Parser:
             if t.value == "explain":
                 self.next()
                 return A.Explain(self.parse_statement())
+            if t.value == "alter":
+                return self.parse_alter()
             if t.value == "with":
                 raise ValueError("WITH (CTE) not supported yet")
         raise ValueError(f"cannot parse statement at {t!r}")
 
     # ---- DDL ------------------------------------------------------------
+    def parse_alter(self) -> Any:
+        """ALTER MATERIALIZED VIEW <name> SET PARALLELISM [=|TO] <n>
+        (`src/frontend/src/handler/alter_parallelism.rs` analog)."""
+        self.expect_kw("alter")
+        self.expect_kw("materialized")
+        self.expect_kw("view")
+        name = self.ident()
+        self.expect_kw("set")
+        word = self.ident()
+        if word != "parallelism":
+            raise ValueError(f"ALTER ... SET {word!r} not supported")
+        if not self.accept("op", "="):
+            if self.peek().kind == "id" and self.peek().value == "to":
+                self.next()
+        tok = self.expect("num")
+        return A.AlterParallelism(name, int(tok.value))
+
     def parse_create(self) -> Any:
         self.expect_kw("create")
         if self.accept_kw("table"):

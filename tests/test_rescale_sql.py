@@ -1,0 +1,166 @@
+"""ALTER MATERIALIZED VIEW ... SET PARALLELISM end-to-end (VERDICT #10):
+SQL-triggered elastic rescale of device-sharded operator state at a
+barrier boundary, chaos-style (DML keeps flowing between rescales, kill/
+restart replays the DDL log including the ALTER). Reference:
+`src/meta/src/stream/scale.rs:2329` + `state_table.rs:694-790`."""
+import numpy as np
+import pytest
+
+from risingwave_tpu.sql import Database
+
+
+def _agg_of(db, mv):
+    e = db.catalog.get(mv).runtime["shared"].upstream
+    stack = [e]
+    while stack:
+        e = stack.pop()
+        if type(e).__name__ == "DeviceHashAggExecutor":
+            return e
+        for attr in ("input", "port", "left_exec", "right_exec"):
+            c = getattr(e, attr, None)
+            if c is not None:
+                stack.append(c)
+    return None
+
+
+def _oracle(db):
+    return sorted(db.query(
+        "SELECT k, count(*), sum(v), min(v), max(v) FROM t GROUP BY k"))
+
+
+def test_alter_parallelism_rescales_device_state():
+    db = Database(device=8)
+    db.run("CREATE TABLE t (k INT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, count(*) AS c, "
+           "sum(v) AS s, min(v) AS mn, max(v) AS mx FROM t GROUP BY k")
+    rng = np.random.default_rng(3)
+
+    def push():
+        rows = ", ".join(f"({int(rng.integers(0, 12))}, "
+                         f"{int(rng.integers(-100, 100))})"
+                         for _ in range(60))
+        db.run(f"INSERT INTO t VALUES {rows}")
+        db.run(f"DELETE FROM t WHERE v > {int(rng.integers(50, 90))}")
+
+    push()
+    agg = _agg_of(db, "mv")
+    assert agg is not None and agg.mesh is not None
+    assert agg.mesh.devices.size == 8
+
+    out = db.run("ALTER MATERIALIZED VIEW mv SET PARALLELISM 4")
+    assert out == ["ALTER_PARALLELISM_1"]
+    assert _agg_of(db, "mv").mesh.devices.size == 4
+    push()
+    assert sorted(db.query("SELECT * FROM mv")) == _oracle(db)
+
+    # scale in to a single chip and back out, DML between each step
+    db.run("ALTER MATERIALIZED VIEW mv SET PARALLELISM 1")
+    assert _agg_of(db, "mv").mesh is None
+    push()
+    assert sorted(db.query("SELECT * FROM mv")) == _oracle(db)
+
+    db.run("ALTER MATERIALIZED VIEW mv SET PARALLELISM 8")
+    assert _agg_of(db, "mv").mesh.devices.size == 8
+    push()
+    assert sorted(db.query("SELECT * FROM mv")) == _oracle(db)
+    assert db.catalog.get("mv").parallelism == 8
+
+
+def test_alter_parallelism_survives_restart(tmp_path):
+    """The ALTER is DDL-logged: recovery replays it, and state recovered
+    AFTER the replayed rescale loads directly onto the new mesh."""
+    d = str(tmp_path)
+    db = Database(data_dir=d, device=8)
+    db.run("CREATE TABLE t (k INT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, sum(v) AS s "
+           "FROM t GROUP BY k")
+    db.run("INSERT INTO t VALUES (1, 10), (2, 20), (1, 5)")
+    db.run("ALTER MATERIALIZED VIEW mv SET PARALLELISM 2")
+    db.run("INSERT INTO t VALUES (2, 7), (3, 1)")
+    before = sorted(db.query("SELECT * FROM mv"))
+
+    db2 = Database(data_dir=d, device=8)
+    assert _agg_of(db2, "mv").mesh.devices.size == 2
+    assert sorted(db2.query("SELECT * FROM mv")) == before
+    db2.run("DELETE FROM t WHERE v = 20")
+    db2.run("INSERT INTO t VALUES (3, 4)")
+    assert sorted(db2.query("SELECT * FROM mv")) == sorted(
+        db2.query("SELECT k, sum(v) FROM t GROUP BY k"))
+
+
+def test_chaos_rescale_under_load():
+    """Random rescales interleaved with random DML for many rounds; the
+    MV must stay exactly equal to the batch oracle throughout (the
+    test_chaos_recovery pattern with scale events added)."""
+    rng = np.random.default_rng(17)
+    db = Database(device=8)
+    db.run("CREATE TABLE t (k INT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, count(*) AS c, "
+           "sum(v) AS s, max(v) AS mx FROM t GROUP BY k")
+    sizes = [8, 4, 2, 1]
+    for round_no in range(10):
+        rows = ", ".join(f"({int(rng.integers(0, 20))}, "
+                         f"{int(rng.integers(-50, 50))})"
+                         for _ in range(40))
+        db.run(f"INSERT INTO t VALUES {rows}")
+        if rng.random() < 0.3:
+            db.run(f"DELETE FROM t WHERE k = {int(rng.integers(0, 20))}")
+        if rng.random() < 0.5:
+            n = int(sizes[rng.integers(0, len(sizes))])
+            db.run(f"ALTER MATERIALIZED VIEW mv SET PARALLELISM {n}")
+        got = sorted(db.query("SELECT * FROM mv"))
+        want = sorted(db.query(
+            "SELECT k, count(*), sum(v), max(v) FROM t GROUP BY k"))
+        assert got == want, f"divergence at round {round_no}"
+
+
+def test_alter_rescales_device_join():
+    """Joins rescale via the re-recovery path (state tables are the
+    durable copy; reshard = reload onto the new mesh)."""
+    db = Database(device=8)
+    db.run("CREATE TABLE a (k INT, x BIGINT)")
+    db.run("CREATE TABLE b (k INT, y BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW j AS SELECT a.k, a.x, b.y "
+           "FROM a JOIN b ON a.k = b.k")
+    db.run("INSERT INTO a VALUES (1, 10), (2, 20), (3, 30)")
+    db.run("INSERT INTO b VALUES (1, 100), (2, 200), (1, 101)")
+    out = db.run("ALTER MATERIALIZED VIEW j SET PARALLELISM 2")
+    assert out == ["ALTER_PARALLELISM_1"]
+    db.run("INSERT INTO a VALUES (1, 11)")
+    db.run("DELETE FROM b WHERE y = 100")
+    got = sorted(db.query("SELECT * FROM j"))
+    want = sorted(db.query("SELECT a.k, a.x, b.y FROM a JOIN b "
+                           "ON a.k = b.k"))
+    assert got == want and len(got) > 0
+
+
+def test_alter_replay_does_not_tick_half_built_dataflow(tmp_path):
+    """Regression (review finding): a replayed ALTER must not flush() —
+    that ticks sources into only the already-replayed jobs, permanently
+    diverging MVs created after the ALTER in the DDL log."""
+    d = str(tmp_path)
+    total = 600   # bounded source: drains fully, so counts are stable
+    db = Database(data_dir=d, device=8)
+    db.run("CREATE SOURCE s (v BIGINT) WITH (connector='datagen', "
+           f"rows.per.poll='8', datagen.max.rows='{total}')")
+    db.run("CREATE MATERIALIZED VIEW m1 AS SELECT v, count(*) AS c "
+           "FROM s GROUP BY v")
+    db.run("ALTER MATERIALIZED VIEW m1 SET PARALLELISM 2")
+    db.run("CREATE MATERIALIZED VIEW m2 AS SELECT count(*) AS c FROM s")
+    for _ in range(3):
+        db.run("FLUSH")
+    n1 = sum(r[1] for r in db.query("SELECT * FROM m1"))
+    (n2,) = db.query("SELECT * FROM m2")[0]
+    assert n1 == n2 == total
+
+    db2 = Database(data_dir=d, device=8)
+    m1 = sum(r[1] for r in db2.query("SELECT * FROM m1"))
+    (m2,) = db2.query("SELECT * FROM m2")[0]
+    assert m1 == m2 == total, (m1, m2)
+
+
+def test_alter_rejects_non_mv():
+    db = Database(device="on")
+    db.run("CREATE TABLE t (k INT)")
+    with pytest.raises(ValueError, match="not a materialized view"):
+        db.run("ALTER MATERIALIZED VIEW t SET PARALLELISM 2")
