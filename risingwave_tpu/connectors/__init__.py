@@ -1,9 +1,18 @@
 """Connectors: sources & sinks (reference: `src/connector/`)."""
+from .base import (CsvParser, JsonParser, Parser, SourceSplit,
+                   SplitEnumerator, SplitReader, SplitSourceReader,
+                   make_parser)
 from .datagen import DatagenReader, FieldGen, ListReader
+from .filesystem import DirEnumerator, LineFileReader
 from .nexmark import (AUCTION_SCHEMA, BID_SCHEMA, PERSON_SCHEMA, NexmarkConfig,
                       NexmarkGenerator, NexmarkReader)
+from .sink import FileSink, SinkExecutor
 
 __all__ = [
-    "DatagenReader", "FieldGen", "ListReader", "AUCTION_SCHEMA", "BID_SCHEMA",
-    "PERSON_SCHEMA", "NexmarkConfig", "NexmarkGenerator", "NexmarkReader",
+    "CsvParser", "JsonParser", "Parser", "SourceSplit", "SplitEnumerator",
+    "SplitReader", "SplitSourceReader", "make_parser",
+    "DatagenReader", "FieldGen", "ListReader",
+    "DirEnumerator", "LineFileReader", "FileSink", "SinkExecutor",
+    "AUCTION_SCHEMA", "BID_SCHEMA", "PERSON_SCHEMA", "NexmarkConfig",
+    "NexmarkGenerator", "NexmarkReader",
 ]

@@ -1,0 +1,147 @@
+"""Sinks: deliver a change stream to an external system, exactly once.
+
+Reference: `src/connector/src/sink/mod.rs:602` (`Sink` trait) + the
+log-store decoupling and the two-phase "write epoch, then commit" the
+coordinated sinks follow. The TPU runtime's analog keeps the same epoch
+discipline without the log store (the in-process stream IS the log):
+
+* rows buffer per epoch;
+* at a CHECKPOINT barrier the epoch's rows append to the data file,
+  fsync, then a manifest (epoch -> byte length) renames into place —
+  the atomic commit point;
+* on restart the sink truncates the data file to the manifested length
+  and ignores epochs <= the committed epoch during replay, so a crash
+  between append and manifest (or a replayed epoch after recovery) never
+  duplicates or loses rows — exactly-once delivery.
+
+Formats: `jsonl` (append-only streams emit the bare row object;
+retractable streams wrap it as {"op": "+"/"-", "row": {...}} — the
+debezium-ish changelog shape) and `csv`.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterator, List, Optional, Tuple
+
+from ..core.chunk import StreamChunk
+from ..core.schema import Schema
+from ..ops.executor import Executor
+from ..ops.message import Barrier, Message, Watermark
+
+
+def _json_default(v):
+    return str(v)
+
+
+class FileSink:
+    """Append-only local-file sink with epoch-manifest exactly-once."""
+
+    def __init__(self, path: str, schema: Schema, fmt: str = "jsonl",
+                 append_only: bool = False):
+        self.path = path
+        self.schema = schema
+        self.fmt = fmt
+        self.append_only = append_only
+        self._pending: List[Tuple[int, Any]] = []   # (sign, row)
+        self.committed_epoch = 0
+        self._committed_bytes = 0
+        self._recover()
+
+    # ---- recovery -------------------------------------------------------
+    @property
+    def _manifest_path(self) -> str:
+        return self.path + ".manifest"
+
+    def _recover(self) -> None:
+        if os.path.exists(self._manifest_path):
+            with open(self._manifest_path) as f:
+                m = json.load(f)
+            self.committed_epoch = m["epoch"]
+            self._committed_bytes = m["bytes"]
+        if os.path.exists(self.path):
+            size = os.path.getsize(self.path)
+            if size > self._committed_bytes:
+                # drop any append that never reached its manifest commit
+                with open(self.path, "r+b") as f:
+                    f.truncate(self._committed_bytes)
+            elif size < self._committed_bytes:
+                # externally truncated: continuing would overstate
+                # _committed_bytes and silently break the torn-tail guard
+                raise IOError(
+                    f"sink data file {self.path!r} is {size} bytes but "
+                    f"manifest committed {self._committed_bytes}: external "
+                    "truncation/corruption")
+        elif self._committed_bytes:
+            raise FileNotFoundError(
+                f"sink data file {self.path!r} missing but manifest "
+                f"claims {self._committed_bytes} bytes")
+
+    # ---- write path -----------------------------------------------------
+    def write_chunk(self, chunk: StreamChunk) -> None:
+        for op, row in chunk.op_rows():
+            self._pending.append((op.sign, row))
+
+    def _format_row(self, sign: int, row: Tuple) -> str:
+        names = [f.name for f in self.schema.fields]
+        if self.fmt == "csv":
+            import csv
+            import io
+            buf = io.StringIO()
+            w = csv.writer(buf, lineterminator="")
+            vals = ["" if v is None else str(v) for v in row]
+            w.writerow(vals if self.append_only
+                       else ["+" if sign > 0 else "-"] + vals)
+            return buf.getvalue()
+        obj = dict(zip(names, row))
+        if self.append_only:
+            return json.dumps(obj, default=_json_default)
+        return json.dumps({"op": "+" if sign > 0 else "-", "row": obj},
+                          default=_json_default)
+
+    def commit(self, epoch: int) -> None:
+        """Checkpoint-barrier commit: append + fsync + manifest rename.
+        Empty epochs advance committed_epoch in memory only — a replayed
+        empty epoch has nothing to duplicate, so idle ticks cost no IO."""
+        if epoch <= self.committed_epoch:
+            self._pending.clear()     # replayed epoch: already delivered
+            return
+        self.committed_epoch = epoch
+        if not self._pending:
+            return
+        data = "".join(self._format_row(s, r) + "\n"
+                       for s, r in self._pending)
+        enc = data.encode("utf-8")
+        with open(self.path, "ab") as f:
+            f.write(enc)
+            f.flush()
+            os.fsync(f.fileno())
+        self._committed_bytes += len(enc)
+        self._pending.clear()
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "bytes": self._committed_bytes}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path)
+
+
+class SinkExecutor(Executor):
+    """Executor shim: pipes the upstream change stream into a sink object,
+    committing at checkpoint barriers (`SinkExecutor`, `src/stream/src/
+    executor/sink.rs` analog)."""
+
+    def __init__(self, input: Executor, sink: FileSink, name: str = "Sink"):
+        super().__init__(input.schema, name)
+        self.input = input
+        self.sink = sink
+
+    def execute(self) -> Iterator[Message]:
+        for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                if msg.cardinality:
+                    self.sink.write_chunk(msg.compact())
+            elif isinstance(msg, Barrier):
+                if msg.is_checkpoint:
+                    self.sink.commit(msg.epoch.curr)
+            yield msg

@@ -268,6 +268,20 @@ class Database:
             maxr = stmt.with_options.get("datagen.max.rows")
             return DatagenReader(schema, rows_per_chunk=per,
                                  max_rows=int(maxr) if maxr else None)
+        if connector in ("fs", "filesystem", "posix_fs"):
+            from ..connectors.base import SplitSourceReader, make_parser
+            from ..connectors.filesystem import DirEnumerator, LineFileReader
+            opts = stmt.with_options
+            path = opts.get("fs.path")
+            if not path:
+                raise ValueError("fs connector requires fs.path")
+            fmt = opts.get("format", opts.get("fs.format", "json"))
+            return SplitSourceReader(
+                DirEnumerator(path, opts.get("fs.pattern", "*")),
+                LineFileReader(),
+                make_parser(fmt, schema, opts),
+                records_per_poll=int(opts.get("fs.records.per.poll",
+                                              "4096")))
         raise ValueError(f"unknown connector {connector!r}")
 
     def _subscribe(self, name: str) -> Tuple[Executor, Schema]:
@@ -358,6 +372,7 @@ class Database:
                 c = getattr(e, attr, None)
                 if c is not None:
                     stack.append(c)
+            stack.extend(getattr(e, "inputs", ()))   # Union/Merge children
         obj.parallelism = n
         return f"ALTER_PARALLELISM_{rescaled}"
 
@@ -370,10 +385,27 @@ class Database:
                                 make_state=self._make_state,
                                 device=self.device).plan_select(stmt.query)
             schema = ns.schema()
-        rows: List[Tuple] = []
-        self.sink_results[stmt.name] = rows
         obj = CatalogObject(stmt.name, "sink", schema, [], 0,
                             with_options=stmt.with_options)
+        connector = stmt.with_options.get("connector", "collect")
+        if connector in ("fs", "filesystem", "posix_fs"):
+            from ..connectors.sink import FileSink, SinkExecutor
+            path = stmt.with_options.get("fs.path")
+            if not path:
+                raise ValueError("fs sink requires fs.path")
+            sink = FileSink(path, schema,
+                            fmt=stmt.with_options.get("format", "jsonl"),
+                            append_only=execu.append_only)
+            obj.runtime = {"sink": sink, "collect": None,
+                           "state_table": None, "shared": None,
+                           "reader": None,
+                           "upstream_subs": self._pending_subs}
+            self._pending_subs = []
+            self.catalog.create(obj)
+            self._iters[stmt.name] = SinkExecutor(execu, sink).execute()
+            return "CREATE_SINK"
+        rows: List[Tuple] = []
+        self.sink_results[stmt.name] = rows
         obj.runtime = {"collect": rows, "state_table": None, "shared": None,
                        "reader": None, "upstream_subs": self._pending_subs}
         self._pending_subs = []
