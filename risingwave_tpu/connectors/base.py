@@ -130,8 +130,8 @@ class JsonParser(Parser):
                     continue
                 rows.append([_coerce(obj.get(n), d)
                              for n, d in zip(names, dtypes)])
-            except (ValueError, TypeError, KeyError):
-                self.errors += 1
+            except (ValueError, TypeError, KeyError, ArithmeticError):
+                self.errors += 1    # ArithmeticError covers bad DECIMALs
         return self._chunk_from_rows(rows)
 
 
@@ -157,7 +157,7 @@ class CsvParser(Parser):
                 rows.append([
                     _coerce(p if p != "" else None, d)
                     for p, d in zip(parts + [None] * len(dtypes), dtypes)])
-            except (ValueError, TypeError, StopIteration):
+            except (ValueError, TypeError, StopIteration, ArithmeticError):
                 self.errors += 1
         return self._chunk_from_rows(rows)
 

@@ -67,7 +67,7 @@ class LineFileReader(SplitReader):
                 if not line or not line.endswith(b"\n"):
                     break               # EOF or partial trailing record
                 pos += len(line)
-                s = line.strip()
+                s = line.rstrip(b"\r\n")   # only the framing, not content
                 if s:
                     out.append(s)
         return out, pos

@@ -1,22 +1,28 @@
 """Sinks: deliver a change stream to an external system, exactly once.
 
 Reference: `src/connector/src/sink/mod.rs:602` (`Sink` trait) + the
-log-store decoupling and the two-phase "write epoch, then commit" the
-coordinated sinks follow. The TPU runtime's analog keeps the same epoch
-discipline without the log store (the in-process stream IS the log):
+log-store decoupling (`src/stream/src/common/log_store_impl/`) and the
+"write epoch, then commit" discipline of the coordinated sinks. The TPU
+runtime's analog keeps the full two-store protocol:
 
-* rows buffer per epoch;
-* at a CHECKPOINT barrier the epoch's rows append to the data file,
-  fsync, then a manifest (epoch -> byte length) renames into place —
-  the atomic commit point;
-* on restart the sink truncates the data file to the manifested length
-  and ignores epochs <= the committed epoch during replay, so a crash
-  between append and manifest (or a replayed epoch after recovery) never
-  duplicates or loses rows — exactly-once delivery.
+* rows buffer per epoch in memory;
+* at a CHECKPOINT barrier the epoch's rows are written to a durable LOG
+  state table (the log-store analog) — that write becomes durable in the
+  SAME store commit as the source offsets and every operator's state, so
+  the log and the rest of the checkpoint agree by construction;
+* delivery to the external file happens one checkpoint later, once the
+  log entries are known durable: append + fsync, then a manifest
+  (epoch -> byte length) renames into place — the external commit point —
+  and the delivered log entries are deleted;
+* on restart the sink truncates the data file to the manifested length,
+  re-delivers any durable log epochs past the manifest, and drops log
+  epochs at or below it. Every crash window re-delivers exactly the rows
+  whose delivery is not manifested and whose ingestion is checkpointed —
+  exactly-once end to end.
 
 Formats: `jsonl` (append-only streams emit the bare row object;
 retractable streams wrap it as {"op": "+"/"-", "row": {...}} — the
-debezium-ish changelog shape) and `csv`.
+debezium-ish changelog shape) and `csv` (RFC-4180 quoting).
 """
 from __future__ import annotations
 
@@ -25,9 +31,13 @@ import os
 from typing import Any, Iterator, List, Optional, Tuple
 
 from ..core.chunk import StreamChunk
+from ..core.encoding import decode_row, encode_row
 from ..core.schema import Schema
 from ..ops.executor import Executor
-from ..ops.message import Barrier, Message, Watermark
+from ..ops.message import Barrier, Message
+from ..state.state_table import StateTable
+
+_FORMATS = ("jsonl", "json", "ndjson", "csv")
 
 
 def _json_default(v):
@@ -35,15 +45,19 @@ def _json_default(v):
 
 
 class FileSink:
-    """Append-only local-file sink with epoch-manifest exactly-once."""
+    """Append-only local-file sink; the manifest rename is the external
+    commit point."""
 
     def __init__(self, path: str, schema: Schema, fmt: str = "jsonl",
                  append_only: bool = False):
+        if fmt not in _FORMATS:
+            raise ValueError(
+                f"unknown sink format {fmt!r} (expected one of {_FORMATS})")
         self.path = path
         self.schema = schema
         self.fmt = fmt
         self.append_only = append_only
-        self._pending: List[Tuple[int, Any]] = []   # (sign, row)
+        self._names = [f.name for f in schema.fields]
         self.committed_epoch = 0
         self._committed_bytes = 0
         self._recover()
@@ -62,6 +76,13 @@ class FileSink:
         if os.path.exists(self.path):
             size = os.path.getsize(self.path)
             if size > self._committed_bytes:
+                if self.committed_epoch == 0 and self._committed_bytes == 0:
+                    # no manifest: this file was NOT written by this sink —
+                    # truncating would destroy someone else's data
+                    raise FileExistsError(
+                        f"sink path {self.path!r} already exists with "
+                        "content but no sink manifest; refusing to "
+                        "overwrite")
                 # drop any append that never reached its manifest commit
                 with open(self.path, "r+b") as f:
                     f.truncate(self._committed_bytes)
@@ -77,47 +98,44 @@ class FileSink:
                 f"sink data file {self.path!r} missing but manifest "
                 f"claims {self._committed_bytes} bytes")
 
-    # ---- write path -----------------------------------------------------
-    def write_chunk(self, chunk: StreamChunk) -> None:
-        for op, row in chunk.op_rows():
-            self._pending.append((op.sign, row))
-
-    def _format_row(self, sign: int, row: Tuple) -> str:
-        names = [f.name for f in self.schema.fields]
+    # ---- delivery -------------------------------------------------------
+    def _format_rows(self, pairs: List[Tuple[int, Tuple]]) -> str:
         if self.fmt == "csv":
             import csv
             import io
             buf = io.StringIO()
-            w = csv.writer(buf, lineterminator="")
-            vals = ["" if v is None else str(v) for v in row]
-            w.writerow(vals if self.append_only
-                       else ["+" if sign > 0 else "-"] + vals)
+            w = csv.writer(buf)
+            for sign, row in pairs:
+                vals = ["" if v is None else str(v) for v in row]
+                w.writerow(vals if self.append_only
+                           else ["+" if sign > 0 else "-"] + vals)
             return buf.getvalue()
-        obj = dict(zip(names, row))
-        if self.append_only:
-            return json.dumps(obj, default=_json_default)
-        return json.dumps({"op": "+" if sign > 0 else "-", "row": obj},
-                          default=_json_default)
+        out = []
+        for sign, row in pairs:
+            obj = dict(zip(self._names, row))
+            if self.append_only:
+                out.append(json.dumps(obj, default=_json_default))
+            else:
+                out.append(json.dumps(
+                    {"op": "+" if sign > 0 else "-", "row": obj},
+                    default=_json_default))
+        return "".join(s + "\n" for s in out)
 
-    def commit(self, epoch: int) -> None:
-        """Checkpoint-barrier commit: append + fsync + manifest rename.
-        Empty epochs advance committed_epoch in memory only — a replayed
-        empty epoch has nothing to duplicate, so idle ticks cost no IO."""
+    def deliver(self, epoch: int, pairs: List[Tuple[int, Tuple]]) -> None:
+        """Append `pairs` (already durable in the log) and move the
+        manifest to `epoch`: append + fsync + atomic rename."""
         if epoch <= self.committed_epoch:
-            self._pending.clear()     # replayed epoch: already delivered
             return
+        if pairs:
+            enc = self._format_rows(pairs).encode("utf-8")
+            with open(self.path, "ab") as f:
+                f.write(enc)
+                f.flush()
+                os.fsync(f.fileno())
+            self._committed_bytes += len(enc)
         self.committed_epoch = epoch
-        if not self._pending:
-            return
-        data = "".join(self._format_row(s, r) + "\n"
-                       for s, r in self._pending)
-        enc = data.encode("utf-8")
-        with open(self.path, "ab") as f:
-            f.write(enc)
-            f.flush()
-            os.fsync(f.fileno())
-        self._committed_bytes += len(enc)
-        self._pending.clear()
+        if not pairs:
+            return                       # idle epochs cost no IO
         tmp = self._manifest_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "bytes": self._committed_bytes}, f)
@@ -127,21 +145,69 @@ class FileSink:
 
 
 class SinkExecutor(Executor):
-    """Executor shim: pipes the upstream change stream into a sink object,
-    committing at checkpoint barriers (`SinkExecutor`, `src/stream/src/
-    executor/sink.rs` analog)."""
+    """Executor shim: change stream -> durable log -> external delivery
+    (`src/stream/src/executor/sink.rs` + log-store analog).
 
-    def __init__(self, input: Executor, sink: FileSink, name: str = "Sink"):
+    The log state table rows are (epoch, seq) -> (sign, value-encoded
+    row). Current-epoch rows are logged at their checkpoint barrier (they
+    become durable in the same store commit as everything else); log
+    epochs already durable — at or below the store's committed epoch —
+    deliver to the file at the NEXT checkpoint and are then deleted."""
+
+    def __init__(self, input: Executor, sink: FileSink,
+                 log_table: Optional[StateTable] = None,
+                 name: str = "Sink"):
         super().__init__(input.schema, name)
         self.input = input
         self.sink = sink
+        self.log_table = log_table
+        self._pending: List[Tuple[int, Tuple]] = []
+        self._dtypes = [f.dtype for f in input.schema.fields]
+
+    def deliver_durable(self) -> None:
+        """Ship every log epoch that the store has made durable. Called by
+        the barrier loop right after `store.commit_epoch` (the
+        post-checkpoint sink-committer step), and again defensively at the
+        next checkpoint barrier (covers recovery)."""
+        if self.log_table is None:
+            return
+        durable = getattr(self.log_table.store, "committed_epoch", 0)
+        by_epoch: dict = {}
+        for row in list(self.log_table.iter_all()):
+            epoch, seq, sign, payload = row
+            if epoch > durable:
+                continue
+            by_epoch.setdefault(epoch, []).append((seq, sign, payload, row))
+        for epoch in sorted(by_epoch):
+            # explicit (epoch, seq) replay order — table iteration is
+            # vnode-prefixed, which would interleave rows
+            entries = sorted(by_epoch[epoch])
+            if epoch > self.sink.committed_epoch:
+                pairs = [(sign, decode_row(payload, self._dtypes))
+                         for _, sign, payload, _ in entries]
+                self.sink.deliver(epoch, pairs)
+            for _, _, _, row in entries:   # delivered or already manifested
+                self.log_table.delete(row)
 
     def execute(self) -> Iterator[Message]:
         for msg in self.input.execute():
             if isinstance(msg, StreamChunk):
                 if msg.cardinality:
-                    self.sink.write_chunk(msg.compact())
-            elif isinstance(msg, Barrier):
-                if msg.is_checkpoint:
-                    self.sink.commit(msg.epoch.curr)
+                    for op, row in msg.compact().op_rows():
+                        self._pending.append((op.sign, row))
+            elif isinstance(msg, Barrier) and msg.is_checkpoint:
+                epoch = msg.epoch.curr
+                if self.log_table is None:
+                    # non-durable runtime: deliver directly (tests/ephemeral)
+                    self.sink.deliver(epoch, self._pending)
+                    self._pending.clear()
+                else:
+                    self.deliver_durable()
+                    if epoch > self.sink.committed_epoch:
+                        for i, (sign, row) in enumerate(self._pending):
+                            self.log_table.insert(
+                                (epoch, i, sign,
+                                 encode_row(row, self._dtypes)))
+                    self._pending.clear()
+                    self.log_table.commit(epoch)
             yield msg

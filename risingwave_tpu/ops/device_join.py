@@ -226,15 +226,26 @@ class DeviceHashJoinExecutor(Executor):
         # replacement pair share one downstream stream key — pair order off
         # the device is hash order, so emit ALL deletes before ALL inserts
         # (at barrier granularity that's the only per-key ordering that
-        # matters; net-zero pairs never leave the device).
+        # matters). Identical rows are NETTED across the whole epoch pair
+        # set first: dA><B_old can insert the exact pair that A_new><dB
+        # deletes (e.g. both join sides changed under a non-equi
+        # condition); emitting that net-zero pair as delete-then-insert
+        # would resurrect a row the join no longer contains.
         dels: List[Tuple] = []
         ins: List[Tuple] = []
         self._assemble(o1, dels, ins)
         self._assemble(o2, dels, ins)
-        for row in dels:
-            out.append_row(Op.DELETE, row)
-        for row in ins:
-            out.append_row(Op.INSERT, row)
+        from collections import Counter
+        net: Counter = Counter(ins)
+        net.subtract(dels)
+        for row, c in net.items():
+            if c < 0:
+                for _ in range(-c):
+                    out.append_row(Op.DELETE, row)
+        for row, c in net.items():
+            if c > 0:
+                for _ in range(c):
+                    out.append_row(Op.INSERT, row)
         yield from out.drain()
         # state persistence: net row inserts/deletes this epoch
         for side in ("a", "b"):

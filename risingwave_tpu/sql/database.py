@@ -396,13 +396,21 @@ class Database:
             sink = FileSink(path, schema,
                             fmt=stmt.with_options.get("format", "jsonl"),
                             append_only=execu.append_only)
-            obj.runtime = {"sink": sink, "collect": None,
+            # durable delivery log (the log-store analog): commits in the
+            # same store epoch as the source offsets, closing the crash
+            # window between external delivery and checkpoint
+            log_table = StateTable(
+                self.store, self.catalog.alloc_table_id(),
+                [T.INT64, T.INT64, T.INT64, T.BYTEA], [0, 1])
+            sink_exec = SinkExecutor(execu, sink, log_table=log_table)
+            obj.runtime = {"sink": sink, "sink_exec": sink_exec,
+                           "collect": None,
                            "state_table": None, "shared": None,
                            "reader": None,
                            "upstream_subs": self._pending_subs}
             self._pending_subs = []
             self.catalog.create(obj)
-            self._iters[stmt.name] = SinkExecutor(execu, sink).execute()
+            self._iters[stmt.name] = sink_exec.execute()
             return "CREATE_SINK"
         rows: List[Tuple] = []
         self.sink_results[stmt.name] = rows
@@ -549,6 +557,13 @@ class Database:
         if b.is_checkpoint:
             self.store.commit_epoch(b.epoch.curr)
             self.epoch_committed = b.epoch.curr
+            # post-checkpoint sink-committer step: the epoch's log entries
+            # are durable now, so external delivery can go out
+            for obj in self.catalog.objects.values():
+                se = (obj.runtime or {}).get("sink_exec") \
+                    if isinstance(obj.runtime, dict) else None
+                if se is not None:
+                    se.deliver_durable()
         # barrier latency + epoch progress (streaming_stats.rs analog)
         REGISTRY.histogram("barrier_latency_seconds",
                            "inject-to-collect barrier latency"
