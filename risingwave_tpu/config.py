@@ -1,15 +1,22 @@
-"""Runtime configuration.
+"""Runtime configuration — the reference's 3-tier config system.
 
-The start of the reference's 3-tier config system (`src/common/src/
-config.rs:137` node config, `system_param/mod.rs:97` cluster params,
-`session_config/` session vars). The device tier here governs the
-SQL->device dispatch seam: whether eligible plan fragments lower onto the
-TPU executors and over which mesh.
+* `NodeConfig` — per-process startup config, TOML-loadable
+  (`src/common/src/config.rs:137`; `risingwave.toml`). Immutable for the
+  process lifetime.
+* `SystemParams` — cluster-wide parameters alterable at runtime via
+  `ALTER SYSTEM SET` (`src/common/src/system_param/mod.rs:97`): mutations
+  are DDL-logged so a restarted process replays them.
+* session variables — per-connection `SET`/`SHOW`
+  (`src/common/src/session_config/`), held on the Database session.
+
+The device tier (`DeviceConfig`) governs the SQL->device dispatch seam:
+whether eligible plan fragments lower onto the TPU executors and over
+which mesh.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Optional
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, Optional
 
 
 @dataclass
@@ -25,6 +32,119 @@ class DeviceConfig:
     mesh: Optional[Any] = None
     capacity: int = 1024
     minmax: bool = True
+
+
+@dataclass
+class StreamingConfig:
+    """[streaming] section (`StreamingConfig`, config.rs)."""
+    chunk_size: int = 1024             # max rows per stream chunk
+    barrier_interval_ms: int = 1000    # timed-runtime barrier cadence
+    checkpoint_frequency: int = 1      # checkpoints per N barriers
+
+
+@dataclass
+class StorageConfig:
+    """[storage] section (`StorageConfig`, config.rs)."""
+    data_dir: Optional[str] = None     # None = in-memory state store
+    block_cache_blocks: int = 4096     # hummock LRU capacity
+    compact_threshold: int = 8         # runs per table before compaction
+
+
+@dataclass
+class NodeConfig:
+    """Per-process startup configuration (the `risingwave.toml` analog).
+
+    Load with `NodeConfig.from_toml(path)`; unknown keys are rejected so
+    typos fail at startup, like the reference's serde deny_unknown_fields.
+    """
+    streaming: StreamingConfig = field(default_factory=StreamingConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    device: Optional[DeviceConfig] = None
+
+    @classmethod
+    def from_toml(cls, path: str) -> "NodeConfig":
+        import tomllib
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        cfg = cls()
+        for section, target in (("streaming", cfg.streaming),
+                                ("storage", cfg.storage)):
+            known = {f.name for f in fields(target)}
+            for k, v in raw.pop(section, {}).items():
+                if k not in known:
+                    raise ValueError(
+                        f"unknown config key [{section}] {k!r}")
+                setattr(target, k, v)
+        dev = raw.pop("device", None)
+        if dev is not None:
+            mode = dev.pop("mode", "off")
+            for k in dev:
+                if k not in ("capacity", "minmax"):
+                    raise ValueError(f"unknown config key [device] {k!r}")
+            base = resolve_device(
+                int(mode) if isinstance(mode, str) and mode.isdigit()
+                else mode)
+            if base is not None:
+                for k, v in dev.items():
+                    setattr(base, k, v)
+            cfg.device = base
+        if raw:
+            raise ValueError(f"unknown config sections {sorted(raw)!r}")
+        return cfg
+
+
+class SystemParams:
+    """Cluster parameters alterable via ALTER SYSTEM SET
+    (`system_param/mod.rs:97`). Each entry: default + coercion; mutation
+    goes through `set` so the runtime can react (e.g. checkpoint
+    frequency applies to the running barrier injector)."""
+
+    DEFAULTS: Dict[str, Any] = {
+        "checkpoint_frequency": 1,
+        "barrier_interval_ms": 1000,
+        "pause_on_next_bootstrap": False,
+    }
+
+    def __init__(self) -> None:
+        self.values: Dict[str, Any] = dict(self.DEFAULTS)
+
+    def get(self, name: str) -> Any:
+        if name not in self.values:
+            raise ValueError(f"unknown system parameter {name!r}")
+        return self.values[name]
+
+    # per-parameter validation: stored and effective values must agree
+    _MIN = {"checkpoint_frequency": 1, "barrier_interval_ms": 1}
+
+    def set(self, name: str, value: Any) -> Any:
+        if name not in self.DEFAULTS:
+            raise ValueError(f"unknown system parameter {name!r}")
+        want = type(self.DEFAULTS[name])
+        if want is bool and isinstance(value, str):
+            value = value.strip().lower() in ("t", "true", "1", "on")
+        else:
+            value = want(value)
+        lo = self._MIN.get(name)
+        if lo is not None and value < lo:
+            raise ValueError(f"system parameter {name} must be >= {lo}")
+        self.values[name] = value
+        return value
+
+
+# session variables: name -> default. The subset the runtime honors;
+# unknown SET names are rejected like PG's "unrecognized configuration
+# parameter". Values coerce to the default's type on SET.
+SESSION_VAR_DEFAULTS: Dict[str, Any] = {
+    "timezone": "UTC",
+    "query_mode": "auto",
+    "streaming_parallelism": 0,        # 0 = use the device config default
+    "application_name": "",
+    "extra_float_digits": 1,
+}
+
+
+def default_session_vars() -> Dict[str, Any]:
+    return dict(SESSION_VAR_DEFAULTS)
 
 
 def resolve_device(device) -> Optional[DeviceConfig]:

@@ -24,7 +24,7 @@ Schemas (matching the reference's CREATE SOURCE):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -263,7 +263,8 @@ class NexmarkReader(SourceReader):
     single nexmark datagen."""
 
     def __init__(self, table: str, generator: NexmarkGenerator,
-                 events_per_poll: int = 8192, max_events: Optional[int] = None):
+                 events_per_poll: int = 8192, max_events: Optional[int] = None,
+                 columns: Optional[Sequence[str]] = None):
         assert table in ("person", "auction", "bid")
         self.table = table
         self.gen = generator
@@ -272,6 +273,19 @@ class NexmarkReader(SourceReader):
         self.next_event = 0
         self.schema = {"person": PERSON_SCHEMA, "auction": AUCTION_SCHEMA,
                        "bid": BID_SCHEMA}[table]
+        # CREATE SOURCE may declare a column subset/reorder: project the
+        # generated chunks onto the declared names
+        self._proj: Optional[List[int]] = None
+        if columns is not None:
+            names = [f.name for f in self.schema.fields]
+            missing = [c for c in columns if c not in names]
+            if missing:
+                raise ValueError(
+                    f"nexmark table {table!r} has no columns {missing}; "
+                    f"available: {names}")
+            idx = [names.index(c) for c in columns]
+            if idx != list(range(len(names))):
+                self._proj = idx
 
     def poll(self) -> Optional[StreamChunk]:
         if self.max_events is not None and self.next_event >= self.max_events:
@@ -281,7 +295,10 @@ class NexmarkReader(SourceReader):
             end = min(end, self.max_events)
         chunks = self.gen.gen_range(self.next_event, end)
         self.next_event = end
-        return chunks.get(self.table)
+        ch = chunks.get(self.table)
+        if ch is not None and self._proj is not None:
+            ch = ch.project(self._proj)
+        return ch
 
     def split_states(self) -> Dict[str, Any]:
         return {f"nexmark-{self.table}": self.next_event}

@@ -255,3 +255,17 @@ class AlterParallelism:
     """ALTER MATERIALIZED VIEW <name> SET PARALLELISM <n>."""
     name: str
     parallelism: int
+
+
+@dataclass
+class SetVar:
+    """SET <name> = <value> (session) / ALTER SYSTEM SET (cluster)."""
+    name: str
+    value: Any
+    system: bool = False
+
+
+@dataclass
+class ShowVar:
+    """SHOW <name> | SHOW ALL | SHOW PARAMETERS."""
+    name: Optional[str]   # None = ALL

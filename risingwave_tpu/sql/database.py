@@ -60,12 +60,28 @@ class _Backfill(Executor):
 class Database:
     def __init__(self, store: Optional[StateStore] = None,
                  data_dir: Optional[str] = None,
-                 checkpoint_frequency: int = 1,
-                 device=None):
+                 checkpoint_frequency: Optional[int] = None,
+                 device=None, config=None):
+        # node config tier: explicit ctor args override the config file
+        from ..config import NodeConfig, SystemParams, default_session_vars
+        if isinstance(config, str):
+            config = NodeConfig.from_toml(config)
+        self.config = config or NodeConfig()
+        if data_dir is None:
+            data_dir = self.config.storage.data_dir
+        if device is None:
+            device = self.config.device
+        if checkpoint_frequency is None:
+            checkpoint_frequency = self.config.streaming.checkpoint_frequency
         if store is None:
             store = (SpillStateStore(data_dir) if data_dir
                      else MemoryStateStore())
         self.store = store
+        # system-param + session-var tiers
+        self.system_params = SystemParams()
+        self.system_params.values["checkpoint_frequency"] = \
+            checkpoint_frequency
+        self.session_vars = default_session_vars()
         # SQL->TPU dispatch policy (config.resolve_device): None = host-only.
         # Must match the value used when this data directory was created —
         # device-path state tables persist raw payload columns, host-path
@@ -153,7 +169,8 @@ class Database:
             result = self._execute(stmt)
             if isinstance(stmt, (A.CreateTable, A.CreateMaterializedView,
                                  A.CreateSink, A.DropObject,
-                                 A.AlterParallelism)):
+                                 A.AlterParallelism)) \
+                    or (isinstance(stmt, A.SetVar) and stmt.system):
                 self._log_ddl(text)
             out.append(result)
         return out
@@ -188,9 +205,13 @@ class Database:
                     "materialized views": "mv", "sinks": "sink"}[stmt.kind]
             return self.catalog.list(kind)
         if isinstance(stmt, A.Explain):
-            return repr(stmt.stmt)
+            return self._explain(stmt.stmt)
         if isinstance(stmt, A.AlterParallelism):
             return self._alter_parallelism(stmt)
+        if isinstance(stmt, A.SetVar):
+            return self._set_var(stmt)
+        if isinstance(stmt, A.ShowVar):
+            return self._show_var(stmt)
         raise ValueError(f"unsupported statement {stmt!r}")
 
     # ------------------------------------------------------------------
@@ -260,9 +281,11 @@ class Database:
             per = int(stmt.with_options.get("nexmark.chunk.size", "8192"))
             if self._nexmark_gen is None:
                 self._nexmark_gen = NexmarkGenerator()
+            cols = [c.name for c in stmt.columns]
             return NexmarkReader(table, self._nexmark_gen,
                                  events_per_poll=per,
-                                 max_events=int(maxe) if maxe else None)
+                                 max_events=int(maxe) if maxe else None,
+                                 columns=cols)
         if connector == "datagen":
             per = int(float(stmt.with_options.get("rows.per.poll", "1024")))
             maxr = stmt.with_options.get("datagen.max.rows")
@@ -331,6 +354,70 @@ class Database:
         self.catalog.create(obj)
         self._iters[stmt.name] = obj.runtime["port"].execute()
         return "CREATE_MATERIALIZED_VIEW"
+
+    def _explain(self, inner: Any) -> str:
+        """EXPLAIN renders the physical plan this runtime would execute —
+        the executor tree the planner lowers to (the AST lowers straight
+        to executors; there is one plan shape). No state tables are
+        allocated and no subscriptions are taken."""
+        from .system_catalog import render_plan
+        if isinstance(inner, A.CreateMaterializedView):
+            q = inner.query
+        elif isinstance(inner, A.Select):
+            q = inner
+        else:
+            return repr(inner)
+        inj = BarrierInjector()
+
+        def peek(name: str):
+            from .system_catalog import SYSTEM_TABLES
+            if name in SYSTEM_TABLES and name not in self.catalog.objects:
+                schema, _builder = SYSTEM_TABLES[name]
+                src = SourceExecutor(schema, ListReader([]), inj,
+                                     name=f"SysScan({name})")
+                return src, schema, list(range(len(schema)))
+            obj = self.catalog.get(name)
+            src = SourceExecutor(obj.schema, ListReader([]), inj,
+                                 name=f"Scan({name})")
+            rt = obj.runtime or {}
+            shared = rt.get("shared")
+            if shared is not None:
+                src.append_only = shared.upstream.append_only
+            return src, obj.schema, obj.pk
+
+        execu, _ns = Planner(peek, device=self.device).plan_select(q)
+        return render_plan(execu)
+
+    def _set_var(self, stmt: A.SetVar) -> str:
+        """SET (session tier) / ALTER SYSTEM SET (cluster tier,
+        DDL-logged so restarts replay it). System params take effect
+        immediately where the runtime consumes them."""
+        if stmt.system:
+            v = self.system_params.set(stmt.name, stmt.value)
+            if stmt.name == "checkpoint_frequency":
+                self.injector.checkpoint_frequency = max(1, int(v))
+            return f"ALTER_SYSTEM_{stmt.name}"
+        from ..config import SESSION_VAR_DEFAULTS
+        if stmt.name not in SESSION_VAR_DEFAULTS:
+            raise ValueError(
+                f"unrecognized configuration parameter {stmt.name!r}")
+        want = type(SESSION_VAR_DEFAULTS[stmt.name])
+        v = stmt.value
+        if want is bool and isinstance(v, str):
+            v = v.strip().lower() in ("t", "true", "1", "on")
+        elif not isinstance(v, want):
+            v = want(v)
+        self.session_vars[stmt.name] = v
+        return f"SET_{stmt.name}"
+
+    def _show_var(self, stmt: A.ShowVar):
+        if stmt.name is None:                      # SHOW ALL
+            return sorted(self.session_vars.items())
+        if stmt.name == "parameters":              # SHOW PARAMETERS
+            return sorted(self.system_params.values.items())
+        if stmt.name in self.session_vars:
+            return self.session_vars[stmt.name]
+        return self.system_params.get(stmt.name)
 
     def _alter_parallelism(self, stmt: A.AlterParallelism) -> str:
         """Elastic scale-out/in of one job's device-sharded operators
@@ -595,6 +682,16 @@ class Database:
         inj = BarrierInjector()
 
         def subscribe(name: str):
+            from .system_catalog import SYSTEM_TABLES
+            if name in SYSTEM_TABLES and name not in self.catalog.objects:
+                schema, builder = SYSTEM_TABLES[name]
+                rows = builder(self)
+                chunks = ([StreamChunk.from_rows(
+                    schema.dtypes, [(Op.INSERT, r) for r in rows])]
+                    if rows else [])
+                src = SourceExecutor(schema, ListReader(chunks), inj,
+                                     name=f"SysScan({name})")
+                return src, schema, list(range(len(schema)))
             obj = self.catalog.get(name)
             rows = list(obj.runtime["state_table"].iter_all())
             chunks = []
@@ -615,18 +712,32 @@ class Database:
         # visible = user items (stars expanded) — minus hidden ORDER BY
         # helpers and planner-appended stream-key columns
         n_vis = (ns.n_visible or len(ns.cols)) - len(q.order_by)
-        state: Dict[Tuple, int] = {}
-        it = execu.execute()
-        inj.inject()
-        inj.inject_stop()
-        for msg in it:
-            if isinstance(msg, StreamChunk):
-                for op, r in msg.compact().op_rows():
-                    if op.is_insert:
-                        state[r] = state.get(r, 0) + 1
-                    else:
-                        state[r] = state.get(r, 0) - 1
-        out = [r for r, n in state.items() for _ in range(n)]
+        # preferred path: convert to batch executors (vectorized one-shot
+        # pipeline, src/batch analog). Plans with no batch form yet replay
+        # as a bounded stream (the pre-batch-engine behavior).
+        from ..batch import SeqScan, translate_stream_plan
+
+        def scan_of(src):
+            return SeqScan(src.schema, [c.data_chunk()
+                                        for c in src.reader.chunks],
+                           name=src.name)
+
+        batch = translate_stream_plan(execu, scan_of)
+        if batch is not None:
+            out = batch.rows()
+        else:
+            state: Dict[Tuple, int] = {}
+            it = execu.execute()
+            inj.inject()
+            inj.inject_stop()
+            for msg in it:
+                if isinstance(msg, StreamChunk):
+                    for op, r in msg.compact().op_rows():
+                        if op.is_insert:
+                            state[r] = state.get(r, 0) + 1
+                        else:
+                            state[r] = state.get(r, 0) - 1
+            out = [r for r, n in state.items() for _ in range(n)]
         for i in range(len(q.order_by) - 1, -1, -1):
             desc = q.order_by[i][1]
             out.sort(key=lambda r: _sort_key(r[n_vis + i]), reverse=desc)

@@ -166,11 +166,19 @@ class Parser:
                 return A.Flush()
             if t.value == "show":
                 self.next()
+                if self.accept_kw("all"):
+                    return A.ShowVar(None)
                 kind = self.ident()
                 if kind == "materialized":
                     self.expect_kw("views")
                     kind = "materialized views"
-                return A.ShowObjects(kind)
+                if kind in ("tables", "sources", "sinks",
+                            "materialized views"):
+                    return A.ShowObjects(kind)
+                return A.ShowVar(kind)
+            if t.value == "set":
+                self.next()
+                return self._parse_set(system=False)
             if t.value == "explain":
                 self.next()
                 return A.Explain(self.parse_statement())
@@ -181,10 +189,38 @@ class Parser:
         raise ValueError(f"cannot parse statement at {t!r}")
 
     # ---- DDL ------------------------------------------------------------
+    def _parse_set(self, system: bool) -> Any:
+        """SET <name> [=|TO] <value>; values are literals or bare idents
+        (PG-style, e.g. SET timezone TO utc)."""
+        name = self.ident()
+        if not self.accept("op", "="):
+            if self.peek().kind == "id" and self.peek().value == "to":
+                self.next()
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            v: Any = (float(t.value) if any(c in t.value for c in ".eE")
+                      else int(t.value))
+        elif t.kind == "str":
+            self.next()
+            v = t.value
+        elif t.kind == "kw" and t.value in ("true", "false"):
+            self.next()
+            v = t.value == "true"
+        else:
+            v = self.ident()
+        return A.SetVar(name, v, system=system)
+
     def parse_alter(self) -> Any:
-        """ALTER MATERIALIZED VIEW <name> SET PARALLELISM [=|TO] <n>
-        (`src/frontend/src/handler/alter_parallelism.rs` analog)."""
+        """ALTER MATERIALIZED VIEW <name> SET PARALLELISM [=|TO] <n> /
+        ALTER SYSTEM SET <param> [=|TO] <value>
+        (`src/frontend/src/handler/alter_parallelism.rs`,
+        `handler/alter_system.rs` analogs)."""
         self.expect_kw("alter")
+        if self.peek().kind == "id" and self.peek().value == "system":
+            self.next()
+            self.expect_kw("set")
+            return self._parse_set(system=True)
         self.expect_kw("materialized")
         self.expect_kw("view")
         name = self.ident()

@@ -1,0 +1,110 @@
+"""System catalogs + plan rendering.
+
+Analog of the reference's `rw_catalog` system tables
+(`src/frontend/src/catalog/system_catalog/rw_catalog/`) and EXPLAIN
+output (`src/frontend/src/optimizer/plan_node/mod.rs` Display impls),
+collapsed to the single-process runtime: system tables are virtual
+batch-only snapshots built from the live catalog; EXPLAIN renders the
+actually-planned executor tree (the physical plan — this runtime lowers
+AST straight to executors)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+from ..core import dtypes as T
+from ..core.schema import Schema
+
+
+def _rows_tables(db) -> List[Tuple]:
+    return [(o.name, o.table_id, o.append_only)
+            for o in db.catalog.objects.values() if o.kind == "table"]
+
+
+def _rows_mvs(db) -> List[Tuple]:
+    return [(o.name, o.table_id,
+             o.parallelism if o.parallelism is not None else 0)
+            for o in db.catalog.objects.values() if o.kind == "mv"]
+
+
+def _rows_sources(db) -> List[Tuple]:
+    return [(o.name, o.table_id,
+             o.with_options.get("connector", "dml"))
+            for o in db.catalog.objects.values()
+            if o.kind in ("source", "table")]
+
+
+def _rows_sinks(db) -> List[Tuple]:
+    return [(o.name, o.with_options.get("connector", "collect"))
+            for o in db.catalog.objects.values() if o.kind == "sink"]
+
+
+def _rows_params(db) -> List[Tuple]:
+    return [(k, str(v)) for k, v in sorted(db.system_params.values.items())]
+
+
+def _rows_columns(db) -> List[Tuple]:
+    out = []
+    for o in db.catalog.objects.values():
+        if o.kind in ("table", "source", "mv"):
+            for i, f in enumerate(o.schema.fields):
+                out.append((o.name, f.name, i, str(f.dtype)))
+    return out
+
+
+# name -> (schema, row builder). Names mirror rw_catalog.
+SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
+    "rw_tables": (Schema.of(("name", T.VARCHAR), ("id", T.INT64),
+                            ("append_only", T.BOOLEAN)), _rows_tables),
+    "rw_materialized_views": (
+        Schema.of(("name", T.VARCHAR), ("id", T.INT64),
+                  ("parallelism", T.INT64)), _rows_mvs),
+    "rw_sources": (Schema.of(("name", T.VARCHAR), ("id", T.INT64),
+                             ("connector", T.VARCHAR)), _rows_sources),
+    "rw_sinks": (Schema.of(("name", T.VARCHAR), ("connector", T.VARCHAR)),
+                 _rows_sinks),
+    "rw_system_parameters": (
+        Schema.of(("name", T.VARCHAR), ("value", T.VARCHAR)), _rows_params),
+    "rw_columns": (Schema.of(("relation", T.VARCHAR), ("name", T.VARCHAR),
+                             ("position", T.INT64), ("type", T.VARCHAR)),
+                   _rows_columns),
+}
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN rendering
+# ---------------------------------------------------------------------------
+
+def _label(e) -> str:
+    name = e.name or type(e).__name__
+    bits: List[str] = []
+    gk = getattr(e, "group_key_indices", None)
+    if gk is not None:
+        bits.append(f"group_key={list(gk)}")
+    calls = getattr(e, "calls", None)
+    if calls:
+        try:
+            bits.append("aggs=[" + ", ".join(c.kind for c in calls) + "]")
+        except Exception:
+            pass
+    ki = getattr(e, "key_idx", None)
+    if isinstance(ki, dict):
+        bits.append(f"on={ki.get('a')}={ki.get('b')}")
+    mesh = getattr(e, "mesh", None)
+    if mesh is not None:
+        bits.append(f"mesh={mesh.devices.size}")
+    if getattr(e, "append_only", False):
+        bits.append("append_only")
+    return name + (" { " + ", ".join(bits) + " }" if bits else "")
+
+
+def render_plan(e, depth: int = 0) -> str:
+    lines = ["  " * depth + ("-> " if depth else "") + _label(e)]
+    children = []
+    for attr in ("input", "left_exec", "right_exec", "port"):
+        c = getattr(e, attr, None)
+        if c is not None:
+            children.append(c)
+    children.extend(getattr(e, "inputs", ()))
+    for c in children:
+        lines.append(render_plan(c, depth + 1))
+    return "\n".join(lines)

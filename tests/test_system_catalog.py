@@ -1,0 +1,93 @@
+"""System catalogs (rw_catalog analog) + EXPLAIN physical-plan rendering.
+Reference: src/frontend/src/catalog/system_catalog/rw_catalog/."""
+import pytest
+
+from risingwave_tpu.sql import Database
+
+
+def _db():
+    db = Database(device="on")
+    db.run("CREATE TABLE t (k INT, v BIGINT)")
+    db.run("CREATE SOURCE bid (auction BIGINT, price BIGINT, "
+           "date_time TIMESTAMP) WITH (connector='nexmark', "
+           "nexmark.table='bid', nexmark.max.events='200')")
+    db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, sum(v) AS s "
+           "FROM t GROUP BY k")
+    return db
+
+
+def test_rw_tables_mvs_sources():
+    db = _db()
+    assert db.query("SELECT name FROM rw_tables") == [("t",)]
+    assert db.query("SELECT name FROM rw_materialized_views") == [("mv",)]
+    assert sorted(db.query("SELECT name FROM rw_sources")) == \
+        [("bid",), ("t",)]
+    assert db.query("SELECT name FROM rw_sources "
+                    "WHERE connector = 'nexmark'") == [("bid",)]
+
+
+def test_rw_columns_and_params():
+    db = _db()
+    cols = db.query("SELECT name, type FROM rw_columns "
+                    "WHERE relation = 't'")
+    assert ("k", "int") in cols and ("v", "bigint") in cols
+    db.run("ALTER SYSTEM SET checkpoint_frequency = 2")
+    params = dict(db.query("SELECT * FROM rw_system_parameters"))
+    assert params["checkpoint_frequency"] == "2"
+
+
+def test_system_tables_compose_with_sql():
+    db = _db()
+    (n,) = db.query("SELECT count(*) FROM rw_columns "
+                    "WHERE relation = 'mv'")[0]
+    assert n == len(db.catalog.get("mv").schema)
+
+
+def test_user_table_shadows_system_table():
+    db = Database()
+    db.run("CREATE TABLE rw_tables (x INT)")
+    db.run("INSERT INTO rw_tables VALUES (42)")
+    assert db.query("SELECT x FROM rw_tables") == [(42,)]
+
+
+def test_explain_renders_device_plan():
+    db = _db()
+    plan = db.run("EXPLAIN CREATE MATERIALIZED VIEW x AS "
+                  "SELECT auction, count(*) FROM bid GROUP BY auction")[0]
+    assert "DeviceHashAgg" in plan and "Scan(bid)" in plan
+    assert "append_only" in plan
+    plan2 = db.run("EXPLAIN SELECT t.k, u.v FROM t "
+                   "JOIN t AS u ON t.k = u.k")[0]
+    assert "Join" in plan2 and plan2.count("Scan(t)") == 2
+
+
+def test_explain_has_no_side_effects():
+    db = _db()
+    before = set(db.catalog.objects)
+    tid = db.catalog._next_table_id
+    db.run("EXPLAIN CREATE MATERIALIZED VIEW zzz AS "
+           "SELECT k, count(*) FROM t GROUP BY k")
+    assert set(db.catalog.objects) == before
+    assert db.catalog._next_table_id == tid
+    # the explained MV was never created
+    with pytest.raises(KeyError):
+        db.catalog.get("zzz")
+
+
+def test_explain_system_table():
+    db = _db()
+    plan = db.run("EXPLAIN SELECT * FROM rw_tables")[0]
+    assert "SysScan(rw_tables)" in plan
+
+
+def test_nexmark_source_column_subset():
+    """CREATE SOURCE with a column subset projects the generator chunks
+    (regression: full-schema chunks crashed RowIdGen)."""
+    db = _db()
+    db.run("CREATE MATERIALIZED VIEW m2 AS SELECT count(*) AS c FROM bid")
+    db.run("FLUSH")
+    (n,) = db.query("SELECT * FROM m2")[0]
+    assert n > 0
+    with pytest.raises(ValueError, match="no columns"):
+        db.run("CREATE SOURCE bad (nope INT) WITH (connector='nexmark', "
+               "nexmark.table='bid')")
