@@ -1,0 +1,4 @@
+"""Postgres wire protocol server (reference: `src/utils/pgwire/`)."""
+from .server import PgServer
+
+__all__ = ["PgServer"]

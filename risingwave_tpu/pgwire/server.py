@@ -1,0 +1,309 @@
+"""Postgres wire protocol v3 frontend.
+
+Re-design of the reference's pgwire crate (`src/utils/pgwire/src/
+pg_server.rs:46` server loop, `pg_protocol.rs` message handling): any
+Postgres client (psql, psycopg, JDBC) can speak to the engine. Scope:
+
+* startup: SSLRequest politely declined ('N'), cleartext-free trust auth
+  (AuthenticationOk immediately), ParameterStatus + BackendKeyData +
+  ReadyForQuery;
+* simple query protocol ('Q'): multi-statement SQL, RowDescription with
+  real type OIDs, text-format DataRows, per-statement CommandComplete;
+* extended protocol (Parse/Bind/Describe/Execute/Sync) for the
+  no-parameter statements drivers send by default; Close/Flush handled;
+* errors -> ErrorResponse with SQLSTATE, connection stays usable.
+
+The runtime is single-process: one Database behind a lock, each
+connection a thread (the reference runs a session per connection over
+tokio; the serialization point there is the meta/catalog too).
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, List, Optional, Tuple
+
+from ..core.dtypes import TypeKind
+
+# dtype kind -> (type OID, type size)
+_OID = {
+    TypeKind.BOOLEAN: (16, 1),
+    TypeKind.INT16: (21, 2),
+    TypeKind.INT32: (23, 4),
+    TypeKind.INT64: (20, 8),
+    TypeKind.SERIAL: (20, 8),
+    TypeKind.FLOAT32: (700, 4),
+    TypeKind.FLOAT64: (701, 8),
+    TypeKind.DECIMAL: (1700, -1),
+    TypeKind.VARCHAR: (25, -1),
+    TypeKind.BYTEA: (17, -1),
+    TypeKind.DATE: (1082, 4),
+    TypeKind.TIME: (1083, 8),
+    TypeKind.TIMESTAMP: (1114, 8),
+    TypeKind.TIMESTAMPTZ: (1184, 8),
+    TypeKind.INTERVAL: (1186, 16),
+}
+
+
+def _text(v: Any, kind: Optional[TypeKind] = None) -> Optional[bytes]:
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return b"t" if v else b"f"
+    if kind == TypeKind.TIMESTAMP and isinstance(v, int):
+        from datetime import datetime, timezone
+        dt = datetime.fromtimestamp(v / 1_000_000, tz=timezone.utc)
+        return dt.strftime("%Y-%m-%d %H:%M:%S.%f").encode()
+    return str(v).encode("utf-8")
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket, db, lock: threading.Lock):
+        self.sock = sock
+        self.db = db
+        self.lock = lock
+        self._buf = b""
+        self._portal_sql: Optional[str] = None
+
+    # ---- raw IO ---------------------------------------------------------
+    def _recv(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            got = self.sock.recv(65536)
+            if not got:
+                raise ConnectionError("client closed")
+            self._buf += got
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _send(self, tag: bytes, payload: bytes = b"") -> None:
+        self.sock.sendall(tag + struct.pack(">I", len(payload) + 4) + payload)
+
+    # ---- startup --------------------------------------------------------
+    def startup(self) -> bool:
+        while True:
+            (ln,) = struct.unpack(">I", self._recv(4))
+            body = self._recv(ln - 4)
+            (code,) = struct.unpack(">I", body[:4])
+            if code == 80877103:           # SSLRequest
+                self.sock.sendall(b"N")
+                continue
+            if code == 80877102:           # CancelRequest: ignore politely
+                return False
+            break
+        self._send(b"R", struct.pack(">I", 0))          # AuthenticationOk
+        for k, v in (("server_version", "9.5.0"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8"),
+                     ("DateStyle", "ISO, MDY"),
+                     ("standard_conforming_strings", "on")):
+            self._send(b"S", k.encode() + b"\0" + v.encode() + b"\0")
+        self._send(b"K", struct.pack(">II", 0, 0))      # BackendKeyData
+        self._ready()
+        return True
+
+    def _ready(self) -> None:
+        self._send(b"Z", b"I")
+
+    def _error(self, msg: str, code: str = "XX000") -> None:
+        fields = b"SERROR\0" + b"C" + code.encode() + b"\0" \
+            + b"M" + msg.encode("utf-8", "replace") + b"\0\0"
+        self._send(b"E", fields)
+
+    # ---- query execution ------------------------------------------------
+    def _row_description(self, desc: List[Tuple[str, Any]]) -> None:
+        out = struct.pack(">H", len(desc))
+        for name, dtype in desc:
+            oid, size = _OID.get(dtype.kind, (25, -1))
+            out += name.encode() + b"\0" + struct.pack(
+                ">IHIhih", 0, 0, oid, size, -1, 0)
+        self._send(b"T", out)
+
+    def _data_rows(self, rows: List[Tuple], kinds: List[TypeKind]) -> None:
+        for r in rows:
+            out = struct.pack(">H", len(r))
+            for v, k in zip(r, kinds):
+                t = _text(v, k)
+                out += struct.pack(">i", -1) if t is None \
+                    else struct.pack(">I", len(t)) + t
+            self._send(b"D", out)
+
+    def _tag(self, result: Any, nrows: int) -> str:
+        if isinstance(result, str):
+            if result.startswith("INSERT_"):
+                return f"INSERT 0 {result.split('_')[1]}"
+            if result.startswith(("DELETE_", "UPDATE_")):
+                kind, n = result.split("_", 1)
+                return f"{kind} {n}"
+            return result.replace("_", " ")
+        return f"SELECT {nrows}"
+
+    def _emit_text_rows(self, name: str, rows: List[Tuple],
+                        suppress_desc: bool) -> None:
+        from ..core import dtypes as T
+        if not suppress_desc:
+            self._row_description([(name, T.VARCHAR)] if not rows or
+                                  len(rows[0]) == 1 else
+                                  [(f"{name}{i}", T.VARCHAR)
+                                   for i in range(len(rows[0]))])
+        kinds = [TypeKind.VARCHAR] * (len(rows[0]) if rows else 1)
+        self._data_rows(rows, kinds)
+        self._send(b"C", f"SELECT {len(rows)}".encode() + b"\0")
+
+    def _run_one(self, sql: str, suppress_desc: bool = False) -> bool:
+        """Execute every statement in `sql`; returns False for an empty
+        query (caller sends EmptyQueryResponse)."""
+        from ..sql import ast as A
+        from ..sql.parser import parse_sql_with_text
+        pairs = parse_sql_with_text(sql)
+        if not pairs:
+            return False
+        for stmt, text in pairs:
+            with self.lock:
+                if isinstance(stmt, A.Select):
+                    rows = self.db._run_batch_select(stmt)
+                    desc = getattr(self.db, "last_description", [])
+                    if not suppress_desc:
+                        self._row_description(desc)
+                    self._data_rows(rows, [d.kind for _, d in desc])
+                    self._send(b"C", f"SELECT {len(rows)}".encode() + b"\0")
+                    continue
+                result = self.db._execute(stmt)
+                if isinstance(stmt, (A.CreateTable,
+                                     A.CreateMaterializedView,
+                                     A.CreateSink, A.DropObject,
+                                     A.AlterParallelism)) \
+                        or (isinstance(stmt, A.SetVar) and stmt.system):
+                    # per-statement text, like Database.run — logging the
+                    # whole multi-statement string would replay extras
+                    self.db._log_ddl(text)
+                # statements that answer with data, not just a tag
+                if isinstance(stmt, A.Explain):
+                    self._emit_text_rows(
+                        "QUERY PLAN", [(ln,) for ln in str(result).split("\n")],
+                        suppress_desc)
+                elif isinstance(stmt, A.ShowObjects):
+                    self._emit_text_rows("Name", [(n,) for n in result],
+                                         suppress_desc)
+                elif isinstance(stmt, A.ShowVar):
+                    if isinstance(result, list):   # SHOW ALL / PARAMETERS
+                        self._emit_text_rows(
+                            "setting",
+                            [(str(k), str(v)) for k, v in result],
+                            suppress_desc)
+                    else:
+                        self._emit_text_rows(stmt.name or "setting",
+                                             [(str(result),)], suppress_desc)
+                else:
+                    self._send(b"C", self._tag(result, 0).encode() + b"\0")
+        return True
+
+    def _describe_portal(self) -> None:
+        """Describe: RowDescription for a SELECT portal, NoData otherwise
+        — drivers bind result handling off this answer."""
+        from ..sql import ast as A
+        from ..sql.parser import parse_sql
+        sql = self._portal_sql or ""
+        try:
+            stmts = parse_sql(sql)
+        except Exception:  # noqa: BLE001 — surfaces at Execute
+            self._send(b"n")
+            return
+        if len(stmts) == 1 and isinstance(stmts[0], A.Select):
+            with self.lock:
+                desc = self.db.describe_select(stmts[0])
+            self._row_description(desc)
+        else:
+            self._send(b"n")
+
+    # ---- protocol loop --------------------------------------------------
+    def serve(self) -> None:
+        if not self.startup():
+            return
+        parse_sql_by_name = {}
+        while True:
+            tag = self._recv(1)
+            (ln,) = struct.unpack(">I", self._recv(4))
+            body = self._recv(ln - 4)
+            if tag == b"X":                              # Terminate
+                return
+            if tag == b"Q":                              # simple query
+                sql = body.rstrip(b"\0").decode("utf-8")
+                try:
+                    if not self._run_one(sql):
+                        self._send(b"I")                 # EmptyQueryResponse
+                except Exception as e:  # noqa: BLE001 — wire must stay up
+                    self._error(f"{type(e).__name__}: {e}")
+                self._ready()
+            elif tag == b"P":                            # Parse
+                name, rest = body.split(b"\0", 1)
+                sql, _rest = rest.split(b"\0", 1)
+                parse_sql_by_name[name] = sql.decode("utf-8")
+                self._send(b"1")
+            elif tag == b"B":                            # Bind
+                portal, rest = body.split(b"\0", 1)
+                stmt_name, _ = rest.split(b"\0", 1)
+                self._portal_sql = parse_sql_by_name.get(stmt_name)
+                self._send(b"2")
+            elif tag == b"D":                            # Describe
+                self._describe_portal()
+            elif tag == b"E":                            # Execute
+                try:
+                    if self._portal_sql is None:
+                        self._error("portal does not exist", "34000")
+                    elif not self._run_one(self._portal_sql,
+                                           suppress_desc=True):
+                        self._send(b"I")
+                except Exception as e:  # noqa: BLE001
+                    self._error(f"{type(e).__name__}: {e}")
+            elif tag == b"C":                            # Close
+                kind, name = body[:1], body[1:].split(b"\0", 1)[0]
+                if kind == b"S":
+                    parse_sql_by_name.pop(name, None)
+                else:
+                    self._portal_sql = None
+                self._send(b"3")
+            elif tag == b"H":                            # Flush
+                pass
+            elif tag == b"S":                            # Sync
+                self._ready()
+            else:
+                self._error(f"unsupported message {tag!r}", "0A000")
+                self._ready()
+
+
+class PgServer:
+    """TCP server: every Postgres client connection gets a session thread
+    over the shared Database."""
+
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+        self.db = db
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                conn = _Conn(self.request, outer.db, outer.lock)
+                try:
+                    conn.serve()
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "PgServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -367,6 +367,13 @@ class Database:
             q = inner
         else:
             return repr(inner)
+        execu, _ns = Planner(self._peek_subscribe(),
+                             device=self.device).plan_select(q)
+        return render_plan(execu)
+
+    def _peek_subscribe(self):
+        """Schema-only subscribe: plans without taking subscriptions or
+        allocating state (EXPLAIN / pgwire Describe)."""
         inj = BarrierInjector()
 
         def peek(name: str):
@@ -385,8 +392,18 @@ class Database:
                 src.append_only = shared.upstream.append_only
             return src, obj.schema, obj.pk
 
-        execu, _ns = Planner(peek, device=self.device).plan_select(q)
-        return render_plan(execu)
+        return peek
+
+    def describe_select(self, q: A.Select):
+        """Row description of a SELECT without executing it (the pgwire
+        Describe answer)."""
+        if q.from_ is None:
+            row = tuple(_eval_const(i.expr, None) for i in q.items)
+            return [(it.alias or "?column?", _const_dtype(v))
+                    for it, v in zip(q.items, row)]
+        _execu, ns = Planner(self._peek_subscribe()).plan_select(q)
+        n_vis = ns.n_visible or len(ns.cols)
+        return [(c.name, c.dtype) for c in ns.cols[:n_vis]]
 
     def _set_var(self, stmt: A.SetVar) -> str:
         """SET (session tier) / ALTER SYSTEM SET (cluster tier,
@@ -677,7 +694,11 @@ class Database:
     def _run_batch_select(self, q: A.Select) -> List[Tuple]:
         # SELECT without FROM: evaluate constant expressions
         if q.from_ is None:
-            return [tuple(_eval_const(i.expr, None) for i in q.items)]
+            row = tuple(_eval_const(i.expr, None) for i in q.items)
+            self.last_description = [
+                (it.alias or "?column?", _const_dtype(v))
+                for it, v in zip(q.items, row)]
+            return [row]
         self.flush(1)
         inj = BarrierInjector()
 
@@ -712,6 +733,9 @@ class Database:
         # visible = user items (stars expanded) — minus hidden ORDER BY
         # helpers and planner-appended stream-key columns
         n_vis = (ns.n_visible or len(ns.cols)) - len(q.order_by)
+        # row description for wire-protocol frontends (pgwire RowDescription)
+        self.last_description = [(c.name, c.dtype)
+                                 for c in ns.cols[:n_vis]]
         # preferred path: convert to batch executors (vectorized one-shot
         # pipeline, src/batch analog). Plans with no batch form yet replay
         # as a bounded stream (the pre-batch-engine behavior).
@@ -746,6 +770,18 @@ class Database:
         if q.limit is not None:
             out = out[: q.limit]
         return [r[:n_vis] for r in out]
+
+
+def _const_dtype(v) -> DataType:
+    """Best-effort output type of a constant expression (pgwire needs a
+    RowDescription even for SELECT-without-FROM)."""
+    if isinstance(v, bool):
+        return T.BOOLEAN
+    if isinstance(v, int):
+        return T.INT64
+    if isinstance(v, float):
+        return T.FLOAT64
+    return T.VARCHAR
 
 
 def _sort_key(v):

@@ -1,0 +1,206 @@
+"""Postgres wire protocol server (SURVEY L9 surface; reference
+src/utils/pgwire/src/pg_server.rs:46). No postgres client library is
+available in this image, so the test speaks protocol v3 directly — which
+also pins the exact bytes on the wire."""
+import socket
+import struct
+
+import pytest
+
+from risingwave_tpu.pgwire import PgServer
+from risingwave_tpu.sql import Database
+
+
+class MiniClient:
+    """Just enough of the v3 protocol to converse."""
+
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        self.buf = b""
+
+    def _recv(self, n):
+        while len(self.buf) < n:
+            got = self.sock.recv(65536)
+            if not got:
+                raise ConnectionError
+            self.buf += got
+        out, self.buf = self.buf[:n], self.buf[n:]
+        return out
+
+    def startup(self):
+        params = b"user\0tester\0database\0dev\0\0"
+        body = struct.pack(">I", 196608) + params
+        self.sock.sendall(struct.pack(">I", len(body) + 4) + body)
+        msgs = self.read_until(b"Z")
+        assert msgs[0][0] == b"R"          # AuthenticationOk
+        assert struct.unpack(">I", msgs[0][1])[0] == 0
+        assert any(t == b"K" for t, _ in msgs)
+        return msgs
+
+    def send(self, tag, payload=b""):
+        self.sock.sendall(tag + struct.pack(">I", len(payload) + 4) + payload)
+
+    def read_msg(self):
+        tag = self._recv(1)
+        (ln,) = struct.unpack(">I", self._recv(4))
+        return tag, self._recv(ln - 4)
+
+    def read_until(self, stop_tag):
+        msgs = []
+        while True:
+            t, b = self.read_msg()
+            msgs.append((t, b))
+            if t == stop_tag:
+                return msgs
+
+    def query(self, sql):
+        self.send(b"Q", sql.encode() + b"\0")
+        return self.read_until(b"Z")
+
+    def rows(self, msgs):
+        out = []
+        for t, b in msgs:
+            if t != b"D":
+                continue
+            (n,) = struct.unpack(">H", b[:2])
+            pos, row = 2, []
+            for _ in range(n):
+                (ln,) = struct.unpack(">i", b[pos:pos + 4])
+                pos += 4
+                if ln < 0:
+                    row.append(None)
+                else:
+                    row.append(b[pos:pos + ln].decode())
+                    pos += ln
+            out.append(tuple(row))
+        return out
+
+
+@pytest.fixture
+def server():
+    db = Database()
+    srv = PgServer(db).start()
+    yield srv
+    srv.stop()
+
+
+def test_startup_and_simple_query(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    msgs = c.query("CREATE TABLE t (k INT, v BIGINT, s VARCHAR)")
+    assert any(t == b"C" and b.startswith(b"CREATE TABLE")
+               for t, b in msgs)
+    msgs = c.query("INSERT INTO t VALUES (1, 10, 'a'), (2, NULL, 'b')")
+    assert any(t == b"C" and b.startswith(b"INSERT 0 2") for t, b in msgs)
+    msgs = c.query("SELECT k, v, s FROM t")
+    # RowDescription carries names + OIDs
+    t_msg = next(b for t, b in msgs if t == b"T")
+    (ncols,) = struct.unpack(">H", t_msg[:2])
+    assert ncols == 3
+    assert b"k\0" in t_msg and b"v\0" in t_msg and b"s\0" in t_msg
+    rows = sorted(c.rows(msgs))
+    assert rows == [("1", "10", "a"), ("2", None, "b")]
+    assert any(t == b"C" and b.startswith(b"SELECT 2") for t, b in msgs)
+
+
+def test_error_keeps_connection_usable(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    msgs = c.query("SELECT * FROM no_such_table")
+    assert any(t == b"E" for t, b in msgs)
+    assert msgs[-1][0] == b"Z"                 # ReadyForQuery after error
+    msgs = c.query("SELECT 1 + 1")
+    assert c.rows(msgs) == [("2",)]
+
+
+def test_streaming_ddl_over_the_wire(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE t (k INT, v BIGINT)")
+    msgs = c.query("CREATE MATERIALIZED VIEW mv AS "
+                   "SELECT k, sum(v) AS s FROM t GROUP BY k")
+    assert any(t == b"C" for t, b in msgs)
+    c.query("INSERT INTO t VALUES (1, 10), (1, 5), (2, 7)")
+    rows = sorted(c.rows(c.query("SELECT * FROM mv")))
+    assert rows == [("1", "15"), ("2", "7")]
+
+
+def test_ssl_request_declined_then_plain(server):
+    sock = socket.create_connection((server.host, server.port), timeout=10)
+    body = struct.pack(">I", 80877103)         # SSLRequest
+    sock.sendall(struct.pack(">I", len(body) + 4) + body)
+    assert sock.recv(1) == b"N"
+    sock.close()
+
+
+def test_extended_protocol(server):
+    """Parse/Bind/Describe/Execute/Sync: Describe answers the real
+    RowDescription, Execute sends only rows + completion."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE t (k INT)")
+    c.query("INSERT INTO t VALUES (5)")
+    c.send(b"P", b"s1\0SELECT k FROM t\0" + struct.pack(">H", 0))
+    c.send(b"B", b"\0s1\0" + struct.pack(">HHH", 0, 0, 0))
+    c.send(b"D", b"P\0")
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert tags[:3] == [b"1", b"2", b"T"]       # Parse, Bind, RowDescription
+    assert tags.count(b"T") == 1                # Execute must NOT resend it
+    assert c.rows(msgs) == [("5",)]
+    assert any(t == b"C" and b.startswith(b"SELECT 1") for t, b in msgs)
+    # Close the statement; executing its portal afterwards errors cleanly
+    c.send(b"C", b"Ss1\0")
+    c.send(b"B", b"\0s1\0" + struct.pack(">HHH", 0, 0, 0))
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    assert any(t == b"E" for t, _ in msgs)      # portal does not exist
+
+
+def test_show_and_explain_return_rows(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE t (k INT)")
+    rows = c.rows(c.query("SHOW TABLES"))
+    assert rows == [("t",)]
+    plan = c.rows(c.query("EXPLAIN SELECT k FROM t"))
+    assert any("Scan(t)" in r[0] for r in plan)
+    assert c.rows(c.query("SHOW timezone")) == [("UTC",)]
+
+
+def test_empty_query_response(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    msgs = c.query("   ")
+    assert msgs[0][0] == b"I"                   # EmptyQueryResponse
+    assert msgs[-1][0] == b"Z"
+
+
+def test_multi_statement_ddl_log_replays_once(server, tmp_path):
+    """Regression (review finding): a multi-statement simple query must
+    DDL-log only the per-statement text, or recovery re-runs the INSERT."""
+    from risingwave_tpu.sql import Database
+    db = Database(data_dir=str(tmp_path))
+    srv = PgServer(db).start()
+    try:
+        c = MiniClient(srv.host, srv.port)
+        c.startup()
+        c.query("CREATE TABLE a (x INT); INSERT INTO a VALUES (1)")
+        assert c.rows(c.query("SELECT count(*) FROM a")) == [("1",)]
+    finally:
+        srv.stop()
+    db2 = Database(data_dir=str(tmp_path))
+    assert db2.query("SELECT count(*) FROM a") == [(1,)]
+
+
+def test_two_concurrent_connections(server):
+    a = MiniClient(server.host, server.port)
+    b = MiniClient(server.host, server.port)
+    a.startup()
+    b.startup()
+    a.query("CREATE TABLE shared (x INT)")
+    a.query("INSERT INTO shared VALUES (7)")
+    assert b.rows(b.query("SELECT x FROM shared")) == [("7",)]
