@@ -369,7 +369,11 @@ class Database:
             return repr(inner)
         execu, _ns = Planner(self._peek_subscribe(),
                              device=self.device).plan_select(q)
-        return render_plan(execu)
+        out = render_plan(execu)
+        rules = getattr(q, "applied_rules", None)
+        if rules:
+            out += "\n-- rewrites: " + ", ".join(rules)
+        return out
 
     def _peek_subscribe(self):
         """Schema-only subscribe: plans without taking subscriptions or

@@ -387,6 +387,11 @@ class Planner:
 
     # ---- SELECT ---------------------------------------------------------
     def plan_select(self, q: A.Select) -> Tuple[Executor, Namespace]:
+        # logical rewrites (sql/optimizer.py) run once per tree; subquery
+        # recursion below sees already-optimized nodes
+        if not hasattr(q, "applied_rules"):
+            from .optimizer import optimize
+            optimize(q)
         if q.from_ is None:
             raise ValueError("SELECT without FROM is a batch-only statement")
         execu, ns = self._plan_table(q.from_)
