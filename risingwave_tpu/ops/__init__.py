@@ -13,6 +13,8 @@ from .join import HashJoinExecutor, JoinType
 from .topn import AppendOnlyDedupExecutor, TopNExecutor
 from .watermark import WatermarkFilterExecutor
 from .window import HopWindowExecutor, OverWindowExecutor, WindowFuncCall
+from .misc import (ChangelogExecutor, DynamicFilterExecutor, NowExecutor,
+                   SortExecutor)
 
 __all__ = [
     "Executor", "SharedStream", "UnaryExecutor", "BatchScan",
@@ -25,4 +27,6 @@ __all__ = [
     "HashJoinExecutor", "JoinType", "AppendOnlyDedupExecutor", "TopNExecutor",
     "HopWindowExecutor", "OverWindowExecutor", "WindowFuncCall",
     "WatermarkFilterExecutor", "Channel", "DispatchExecutor", "MergeExecutor",
+    "ChangelogExecutor", "DynamicFilterExecutor", "NowExecutor",
+    "SortExecutor",
 ]

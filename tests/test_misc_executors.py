@@ -1,0 +1,139 @@
+"""Changelog / Now / DynamicFilter / Sort executors (L5a inventory;
+reference src/stream/src/executor/{changelog,now,dynamic_filter,sort}.rs)."""
+from typing import Iterator, List
+
+import pytest
+
+from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
+from risingwave_tpu.core.epoch import EpochPair, epoch_from_physical
+from risingwave_tpu.ops import (ChangelogExecutor, DynamicFilterExecutor,
+                                NowExecutor, SortExecutor)
+from risingwave_tpu.ops.executor import Executor
+from risingwave_tpu.ops.message import Barrier, Message, Watermark
+
+
+class Feed(Executor):
+    """Scripted message source."""
+
+    def __init__(self, schema: Schema, msgs: List[Message]):
+        super().__init__(schema, "Feed")
+        self.msgs = msgs
+
+    def execute(self) -> Iterator[Message]:
+        yield from self.msgs
+
+
+def bar(n: int) -> Barrier:
+    return Barrier(EpochPair(epoch_from_physical(1000 + n),
+                             epoch_from_physical(999 + n)))
+
+
+S = Schema.of(("k", T.INT64), ("v", T.INT64))
+
+
+def chunk(*op_rows):
+    return StreamChunk.from_rows(S.dtypes, list(op_rows))
+
+
+def test_changelog_appends_op_column():
+    feed = Feed(S, [chunk((Op.INSERT, (1, 10)), (Op.DELETE, (2, 20)),
+                          (Op.UPDATE_DELETE, (3, 30)),
+                          (Op.UPDATE_INSERT, (3, 31))), bar(1)])
+    out = [m for m in ChangelogExecutor(feed).execute()
+           if isinstance(m, StreamChunk)]
+    rows = [(op, r) for ch in out for op, r in ch.op_rows()]
+    assert [op for op, _ in rows] == [Op.INSERT] * 4       # append-only
+    assert [r[-1] for _, r in rows] == [1, 2, 3, 4]        # op codes
+    assert ChangelogExecutor(feed).append_only
+
+
+def test_now_emits_update_pairs_and_watermark():
+    feed = Feed(Schema.of(), [bar(1), bar(2), bar(3)])
+    msgs = list(NowExecutor(feed).execute())
+    chunks = [m for m in msgs if isinstance(m, StreamChunk)]
+    assert [op for op, _ in chunks[0].op_rows()] == [Op.INSERT]
+    assert all([op for op, _ in c.op_rows()] ==
+               [Op.UPDATE_DELETE, Op.UPDATE_INSERT] for c in chunks[1:])
+    vals = [r[0] for c in chunks for _, r in c.op_rows()]
+    assert vals == sorted(vals)
+    wms = [m for m in msgs if isinstance(m, Watermark)]
+    assert len(wms) == 3 and wms[-1].value == vals[-1]
+
+
+def test_dynamic_filter_bound_movement():
+    """Rows cross in/out of the output when the RHS scalar moves."""
+    right_schema = Schema.of(("m", T.INT64))
+    rchunk = lambda *vals: StreamChunk.from_rows(
+        right_schema.dtypes, [(Op.INSERT, (v,)) for v in vals])
+    left = Feed(S, [chunk((Op.INSERT, (1, 10)), (Op.INSERT, (2, 50))),
+                    bar(1),
+                    bar(2),
+                    chunk((Op.INSERT, (3, 25))),
+                    bar(3)])
+    right = Feed(right_schema, [rchunk(20), bar(1),
+                                rchunk(40), bar(2),
+                                bar(3)])
+    df = DynamicFilterExecutor(left, right, key_col=1, cmp=">")
+    acc = {}
+    for m in df.execute():
+        if isinstance(m, StreamChunk):
+            for op, r in m.op_rows():
+                acc[r] = acc.get(r, 0) + op.sign
+    live = sorted(r for r, n in acc.items() if n > 0)
+    # bound ended at 40: only v=50 passes (25 never emitted, 10 retracted)
+    assert live == [(2, 50)]
+
+
+def test_dynamic_filter_retracts_on_bound_rise():
+    right_schema = Schema.of(("m", T.INT64))
+    left = Feed(S, [chunk((Op.INSERT, (1, 30))), bar(1), bar(2)])
+    right = Feed(right_schema,
+                 [StreamChunk.from_rows(right_schema.dtypes,
+                                        [(Op.INSERT, (10,))]), bar(1),
+                  StreamChunk.from_rows(right_schema.dtypes,
+                                        [(Op.UPDATE_DELETE, (10,)),
+                                         (Op.UPDATE_INSERT, (99,))]),
+                  bar(2)])
+    df = DynamicFilterExecutor(left, right, key_col=1, cmp=">")
+    seq = [(op, r) for m in df.execute() if isinstance(m, StreamChunk)
+           for op, r in m.op_rows()]
+    assert seq == [(Op.INSERT, (1, 30)), (Op.DELETE, (1, 30))]
+
+
+def test_dynamic_filter_rhs_delete_clears_bound():
+    """Review finding: an RHS DELETE with no re-insert (empty subquery)
+    must revert the bound to NULL, retracting everything."""
+    right_schema = Schema.of(("m", T.INT64))
+    left = Feed(S, [chunk((Op.INSERT, (1, 30))), bar(1), bar(2)])
+    right = Feed(right_schema,
+                 [StreamChunk.from_rows(right_schema.dtypes,
+                                        [(Op.INSERT, (10,))]), bar(1),
+                  StreamChunk.from_rows(right_schema.dtypes,
+                                        [(Op.DELETE, (10,))]), bar(2)])
+    df = DynamicFilterExecutor(left, right, key_col=1, cmp=">")
+    seq = [(op, r) for m in df.execute() if isinstance(m, StreamChunk)
+           for op, r in m.op_rows()]
+    assert seq == [(Op.INSERT, (1, 30)), (Op.DELETE, (1, 30))]
+
+
+def test_sort_forwards_other_watermarks():
+    feed = Feed(S, [Watermark(0, T.INT64, 5), bar(1)])
+    feed.append_only = True
+    srt = SortExecutor(feed, time_col=1)
+    wms = [m for m in srt.execute() if isinstance(m, Watermark)]
+    assert wms and wms[0].col_idx == 0
+
+
+def test_sort_releases_in_order_below_watermark():
+    feed = Feed(S, [chunk((Op.INSERT, (1, 30)), (Op.INSERT, (2, 10))),
+                    Watermark(1, T.INT64, 15),
+                    bar(1),
+                    chunk((Op.INSERT, (3, 12)), (Op.INSERT, (4, 40))),
+                    Watermark(1, T.INT64, 35),
+                    bar(2)])
+    feed.append_only = True
+    srt = SortExecutor(feed, time_col=1)
+    rows = [r for m in srt.execute() if isinstance(m, StreamChunk)
+            for _, r in m.op_rows()]
+    # released in event-time order, only once the watermark passes
+    assert rows == [(2, 10), (3, 12), (1, 30)]
