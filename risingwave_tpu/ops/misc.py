@@ -40,10 +40,15 @@ class ChangelogExecutor(UnaryExecutor):
         super().__init__(input, Schema(fields), "Changelog")
         self.append_only = True
 
+    # Internal Op order is INSERT=0, DELETE=1, UPDATE_DELETE=2,
+    # UPDATE_INSERT=3; the exported CDC contract (`stream_chunk.rs:84`
+    # Op::to_i16) is Insert=1, Delete=2, UpdateInsert=3, UpdateDelete=4.
+    _OP_EXPORT = np.array([1, 2, 4, 3], dtype=np.int32)
+
     def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
         chunk = chunk.compact()
         cols = list(chunk.columns)
-        cols.append(Column(T.INT32, chunk.ops.astype(np.int32) + 1))
+        cols.append(Column(T.INT32, self._OP_EXPORT[chunk.ops]))
         yield StreamChunk(np.zeros(chunk.capacity, dtype=np.int8), cols)
 
 
@@ -73,20 +78,25 @@ class NowExecutor(Executor):
             if isinstance(msg, Barrier):
                 self._recover()
                 nowv = physical_time_ms(msg.epoch.curr) * 1000
-                if self._last is None:
-                    yield StreamChunk.from_rows(
-                        self.schema.dtypes, [(Op.INSERT, (nowv,))])
-                elif nowv > self._last:
-                    yield StreamChunk.from_rows(
-                        self.schema.dtypes,
-                        [(Op.UPDATE_DELETE, (self._last,)),
-                         (Op.UPDATE_INSERT, (nowv,))])
-                if self.state_table is not None and nowv != self._last:
-                    if self._last is not None:
-                        self.state_table.delete((self._last,))
-                    self.state_table.insert((nowv,))
-                    self.state_table.commit(msg.epoch.curr)
-                self._last = max(nowv, self._last or 0)
+                # Guard the WHOLE update on strict advance: if the barrier
+                # timestamp ever regressed, writing state while emitting
+                # nothing would make durable state diverge from what
+                # downstream saw (silent backwards jump after recovery).
+                if self._last is None or nowv > self._last:
+                    if self._last is None:
+                        yield StreamChunk.from_rows(
+                            self.schema.dtypes, [(Op.INSERT, (nowv,))])
+                    else:
+                        yield StreamChunk.from_rows(
+                            self.schema.dtypes,
+                            [(Op.UPDATE_DELETE, (self._last,)),
+                             (Op.UPDATE_INSERT, (nowv,))])
+                    if self.state_table is not None:
+                        if self._last is not None:
+                            self.state_table.delete((self._last,))
+                        self.state_table.insert((nowv,))
+                        self.state_table.commit(msg.epoch.curr)
+                    self._last = nowv
                 yield Watermark(0, T.TIMESTAMP, self._last)
                 yield msg.with_trace(self.name)
             elif isinstance(msg, StreamChunk):

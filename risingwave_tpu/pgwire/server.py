@@ -199,14 +199,17 @@ class _Conn:
                     self._send(b"C", self._tag(result, 0).encode() + b"\0")
         return True
 
-    def _describe_portal(self) -> None:
-        """Describe: RowDescription for a SELECT portal, NoData otherwise
-        — drivers bind result handling off this answer."""
+    def _describe_sql(self, sql: Optional[str], statement: bool) -> None:
+        """Describe: RowDescription for a SELECT, NoData otherwise —
+        drivers bind result handling off this answer. Statement-describe
+        additionally answers ParameterDescription first (pgjdbc sends
+        Parse -> Describe('S') -> Bind -> Execute)."""
         from ..sql import ast as A
         from ..sql.parser import parse_sql
-        sql = self._portal_sql or ""
+        if statement:
+            self._send(b"t", struct.pack(">H", 0))   # no parameters
         try:
-            stmts = parse_sql(sql)
+            stmts = parse_sql(sql or "")
         except Exception:  # noqa: BLE001 — surfaces at Execute
             self._send(b"n")
             return
@@ -222,12 +225,18 @@ class _Conn:
         if not self.startup():
             return
         parse_sql_by_name = {}
+        # After an extended-protocol error, Postgres requires discarding
+        # all messages until Sync (a pipelining client would otherwise get
+        # statements executed after a failed step).
+        skip_until_sync = False
         while True:
             tag = self._recv(1)
             (ln,) = struct.unpack(">I", self._recv(4))
             body = self._recv(ln - 4)
             if tag == b"X":                              # Terminate
                 return
+            if skip_until_sync and tag != b"S":
+                continue                 # spec: discard everything incl. 'Q'
             if tag == b"Q":                              # simple query
                 sql = body.rstrip(b"\0").decode("utf-8")
                 try:
@@ -247,16 +256,30 @@ class _Conn:
                 self._portal_sql = parse_sql_by_name.get(stmt_name)
                 self._send(b"2")
             elif tag == b"D":                            # Describe
-                self._describe_portal()
+                kind, name = body[:1], body[1:].split(b"\0", 1)[0]
+                try:
+                    if kind == b"S":
+                        if name not in parse_sql_by_name:
+                            raise KeyError("prepared statement does not "
+                                           "exist")
+                        self._describe_sql(parse_sql_by_name[name],
+                                           statement=True)
+                    else:
+                        self._describe_sql(self._portal_sql, statement=False)
+                except Exception as e:  # noqa: BLE001 — e.g. unknown table
+                    self._error(f"{type(e).__name__}: {e}", "42P01")
+                    skip_until_sync = True
             elif tag == b"E":                            # Execute
                 try:
                     if self._portal_sql is None:
                         self._error("portal does not exist", "34000")
+                        skip_until_sync = True
                     elif not self._run_one(self._portal_sql,
                                            suppress_desc=True):
                         self._send(b"I")
                 except Exception as e:  # noqa: BLE001
                     self._error(f"{type(e).__name__}: {e}")
+                    skip_until_sync = True
             elif tag == b"C":                            # Close
                 kind, name = body[:1], body[1:].split(b"\0", 1)[0]
                 if kind == b"S":
@@ -267,6 +290,7 @@ class _Conn:
             elif tag == b"H":                            # Flush
                 pass
             elif tag == b"S":                            # Sync
+                skip_until_sync = False
                 self._ready()
             else:
                 self._error(f"unsupported message {tag!r}", "0A000")

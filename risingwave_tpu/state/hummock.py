@@ -45,6 +45,7 @@ from .store import StateStore
 
 MANIFEST = "MANIFEST.json"
 COMPACT_THRESHOLD = 8
+MAX_OPEN_READERS = 128  # cap on simultaneously open run fds (LRU-evicted)
 BLOCK_ROWS = 256           # entries per block (block.rs targets ~64KB)
 DEFAULT_CACHE_BLOCKS = 4096  # LRU capacity (~1M cached entries)
 
@@ -152,6 +153,10 @@ class RunReader:
         blk = self.cache.get((self.name, i))
         if blk is None:
             _, off, length = self.index[i]
+            if self._f.closed:
+                # LRU fd eviction (or store.close()) can race a still-live
+                # lazy range scan; reopen rather than crash mid-iteration.
+                self._f = open(self.path, "rb")
             self._f.seek(off)
             blk = pickle.loads(zlib.decompress(self._f.read(length)))
             self.cache.put((self.name, i), blk)
@@ -285,15 +290,35 @@ class SpillStateStore(StateStore):
         return [self._deltas[(e, table_id)] for e in eps]
 
     def _run_readers(self, table_id: int) -> List[RunReader]:
-        """This table's runs, newest first."""
+        """This table's runs, newest first. Open handles are LRU-capped:
+        each reader keeps one fd for its lifetime, and a long-lived process
+        with many live runs would otherwise creep toward the ulimit."""
         out = []
         for name in reversed(self._manifest["tables"].get(str(table_id), [])):
-            r = self._readers.get(name)
+            r = self._readers.pop(name, None)   # re-insert = mark recent
             if r is None:
-                r = self._readers[name] = RunReader(
-                    name, self._run_path(name), self.cache)
+                r = RunReader(name, self._run_path(name), self.cache)
+            self._readers[name] = r
             out.append(r)
+        while len(self._readers) > MAX_OPEN_READERS:
+            old = next(iter(self._readers))
+            if self._readers[old] in out:       # everything live this call
+                break
+            self._readers.pop(old).close()
         return out
+
+    def close(self) -> None:
+        """Release all cached run fds (safe to keep using the store —
+        readers reopen on demand)."""
+        for r in self._readers.values():
+            r.close()
+        self._readers.clear()
+
+    def __del__(self):  # best-effort fd hygiene for test-heavy processes
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def get(self, table_id: int, key: bytes) -> Optional[Tuple]:
         for d in self._delta_sources(table_id):

@@ -1,0 +1,73 @@
+"""Driver-contract regression tests for __graft_entry__.py.
+
+The driver validates multi-chip sharding by calling ``dryrun_multichip(N)``
+in its own process, in an environment whose *default* JAX platform is the
+real-TPU axon tunnel. Rounds 1 and 2 both failed that gate on environment
+details the in-process test suite (conftest pins CPU up front) could never
+see. So these tests run the entry points in **fresh subprocesses** that
+deliberately do NOT pre-pin the platform — the entry must pin CPU itself.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_fresh(code: str, extra_env: dict | None = None, timeout: int = 600):
+    env = os.environ.copy()
+    # Simulate the driver: no conftest, no pre-pinned CPU platform and no
+    # forced host device count. (We cannot re-create the axon tunnel here,
+    # but we can verify the entry pins the platform itself rather than
+    # relying on the caller's env.)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("JAX_PLATFORM_NAME", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+def test_dryrun_multichip_fresh_subprocess():
+    r = _run_fresh(
+        "import __graft_entry__ as g\n"
+        "g.dryrun_multichip(8)\n"
+        "import jax\n"
+        "assert jax.default_backend() == 'cpu', jax.default_backend()\n"
+        "print('DRYRUN_OK')\n")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "DRYRUN_OK" in r.stdout
+
+
+def test_dryrun_after_entry_same_process():
+    """The driver may compile-check entry() then dry-run in one process;
+    dryrun_multichip must rebuild backends onto CPU in that case."""
+    r = _run_fresh(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"  # entry() itself needs a backend here
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "jax.jit(fn).lower(*args)\n"  # touches/initializes the backend
+        "g.dryrun_multichip(8)\n"
+        "assert len(jax.devices('cpu')) >= 8\n"
+        "print('DRYRUN_OK')\n")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "DRYRUN_OK" in r.stdout
+
+
+def test_entry_compiles_fresh_subprocess():
+    r = _run_fresh(
+        "import os\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import jax\n"
+        "import __graft_entry__ as g\n"
+        "fn, args = g.entry()\n"
+        "out = jax.jit(fn)(*args)\n"
+        "jax.block_until_ready(out)\n"
+        "print('ENTRY_OK')\n")
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "ENTRY_OK" in r.stdout

@@ -43,7 +43,9 @@ def test_changelog_appends_op_column():
            if isinstance(m, StreamChunk)]
     rows = [(op, r) for ch in out for op, r in ch.op_rows()]
     assert [op for op, _ in rows] == [Op.INSERT] * 4       # append-only
-    assert [r[-1] for _, r in rows] == [1, 2, 3, 4]        # op codes
+    # Exported codes per the reference contract (stream_chunk.rs:84):
+    # Insert=1, Delete=2, UpdateDelete=4, UpdateInsert=3.
+    assert [r[-1] for _, r in rows] == [1, 2, 4, 3]        # op codes
     assert ChangelogExecutor(feed).append_only
 
 

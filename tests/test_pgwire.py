@@ -204,3 +204,64 @@ def test_two_concurrent_connections(server):
     a.query("CREATE TABLE shared (x INT)")
     a.query("INSERT INTO shared VALUES (7)")
     assert b.rows(b.query("SELECT x FROM shared")) == [("7",)]
+
+
+def test_describe_statement_vs_portal(server):
+    """Describe('S') must describe the *parsed statement* (pgjdbc's
+    Parse -> Describe(S) -> Bind -> Execute order): ParameterDescription
+    then RowDescription, before any Bind exists."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE t (k INT, s VARCHAR)")
+    c.query("INSERT INTO t VALUES (7, 'x')")
+    c.send(b"P", b"s1\0SELECT k, s FROM t\0" + struct.pack(">H", 0))
+    c.send(b"D", b"Ss1\0")                      # statement, not portal
+    c.send(b"B", b"\0s1\0" + struct.pack(">HHH", 0, 0, 0))
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    # Parse, ParameterDescription, RowDescription, Bind, rows...
+    assert tags[:4] == [b"1", b"t", b"T", b"2"], tags
+    t_msg = next(b for t, b in msgs if t == b"T")
+    assert struct.unpack(">H", t_msg[:2])[0] == 2
+    assert c.rows(msgs) == [("7", "x")]
+
+
+def test_extended_error_discards_until_sync(server):
+    """After an extended-protocol error the server must skip all messages
+    until Sync — a pipelined statement after the failed one must NOT run."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE tt (k INT)")
+    # Pipeline: failing execute, then an INSERT that must be discarded.
+    c.send(b"P", b"bad\0SELECT * FROM missing_table\0" + struct.pack(">H", 0))
+    c.send(b"B", b"\0bad\0" + struct.pack(">HHH", 0, 0, 0))
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"P", b"ins\0INSERT INTO tt VALUES (1)\0" + struct.pack(">H", 0))
+    c.send(b"B", b"\0ins\0" + struct.pack(">HHH", 0, 0, 0))
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert b"E" in tags
+    # Nothing after the error except ReadyForQuery (no ParseComplete/
+    # BindComplete/CommandComplete from the pipelined INSERT).
+    assert tags[tags.index(b"E") + 1:] == [b"Z"], tags
+    rows = c.rows(c.query("SELECT count(*) FROM tt"))
+    assert rows == [("0",)]                     # the INSERT never ran
+
+
+def test_describe_unknown_table_sends_error_not_disconnect(server):
+    """Describe of a parseable SELECT over a missing table must answer
+    ErrorResponse (then discard until Sync), not kill the connection."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.send(b"P", b"s1\0SELECT * FROM missing_table\0" + struct.pack(">H", 0))
+    c.send(b"D", b"Ss1\0")
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert b"E" in tags, tags
+    # connection still usable
+    assert c.rows(c.query("SELECT 1 + 1")) == [("2",)]
