@@ -1,0 +1,475 @@
+"""Fuse planner: recognize an eligible executor tree and lower it to a
+FusedProgram (`device/fused.py`).
+
+This is the dispatch seam one level up from `ops/device_agg.py`: instead
+of swapping ONE executor onto the device, an entire MV fragment —
+source(nexmark/datagen) -> project/filter/hop -> agg/join -> materialize —
+becomes one traced epoch program. Recognition is conservative: anything
+outside the proven shape (nullable flows, non-device expressions, unpackable
+keys, watermarks, EOWC, outer joins, DISTINCT/filtered aggregates) returns
+None and the normal per-operator path runs unchanged.
+
+Static analysis carried per stream column: SQL dtype, surrogate decoder
+(strings ride as injective int64 surrogates; only projection / group-by /
+equi-join use is allowed), and (lo, hi, stride) integer range — the proof
+obligations for lossless key packing, re-verified on device at runtime.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import dtypes as T
+from ..core.dtypes import DataType, TypeKind
+from ..expr.expression import Expr, FunctionCall, InputRef, Literal
+from .fused import (AggNode, Delta, FilterNode, FusedJob, FusedProgram,
+                    HopNode, JoinNode, MapNode, MVKeyedNode, MVPairNode,
+                    MVPull, Node, PackPlan, SourceNode)
+
+NUM = ("num",)
+TS = ("ts",)
+_HORIZON = 1 << 33          # event horizon assumed for unbounded sources
+
+
+class FuseReject(Exception):
+    """Plan shape outside the fused subset — fall back silently."""
+
+
+@dataclass
+class Meta:
+    """Walker-side static description of one node's output delta."""
+    idx: int                              # node index in the program
+    dtypes: List[DataType]
+    decoders: List[Tuple]
+    ranges: List[Optional[Tuple[int, int, int]]]
+    rows_bound: int
+    append_only: bool
+    agg: Optional[AggNode] = None         # set when this delta IS an agg's
+    is_pair: bool = False                 # carries (pk, pk2) pair identity
+
+
+class _TsShift(Expr):
+    """ts +/- INTERVAL const, device-lowered (the host registers
+    ts_*_interval without a device impl — fused plans need it)."""
+
+    def __init__(self, arg: Expr, delta_usecs: int):
+        self.arg = arg
+        self.delta = int(delta_usecs)
+        self.return_type = T.TIMESTAMP
+
+    def children(self):
+        return [self.arg]
+
+    def eval(self, chunk):
+        from ..core.chunk import Column
+        c = self.arg.eval(chunk)
+        return Column(T.TIMESTAMP, c.values + self.delta, c.validity)
+
+    def supports_device(self):
+        return self.arg.supports_device()
+
+    def eval_device(self, cols):
+        v, ok = self.arg.eval_device(cols)
+        return v + self.delta, ok
+
+
+def _devify(e: Expr) -> Expr:
+    """Rewrite for device evaluability (constant interval arithmetic);
+    raises FuseReject when the expression has no device path."""
+    if isinstance(e, FunctionCall) and e.name.startswith("ts_") \
+            and e.name.endswith("_interval"):
+        iv = e.args[1]
+        if isinstance(iv, Literal) and getattr(iv.value, "months", 1) == 0:
+            us = iv.value.days * 86_400_000_000 + iv.value.usecs
+            return _TsShift(_devify(e.args[0]),
+                            us if "add" in e.name else -us)
+        raise FuseReject(f"non-constant interval in {e.name}")
+    if isinstance(e, FunctionCall):
+        e = FunctionCall(e.name, [_devify(a) for a in e.args],
+                         e.return_type, e.sig)
+    if not e.supports_device():
+        raise FuseReject(f"no device path for {e!r}")
+    return e
+
+
+def _surrogate_safe(e: Expr, decoders: Sequence[Tuple]) -> None:
+    """Surrogate columns may only be projected verbatim (or used as keys,
+    which the caller handles) — any computation on them would act on pool
+    indices, not strings."""
+    if isinstance(e, InputRef):
+        return                      # verbatim projection is fine
+    stack = list(e.children() if hasattr(e, "children") else [])
+    while stack:
+        c = stack.pop()
+        if isinstance(c, InputRef) and decoders[c.index] not in (NUM, TS):
+            raise FuseReject("computation over a string surrogate column")
+        stack.extend(c.children() if hasattr(c, "children") else [])
+
+
+def _range_of(e: Expr, ranges) -> Optional[Tuple[int, int, int]]:
+    """Interval analysis for packing proofs. None = unbounded/unknown."""
+    if isinstance(e, InputRef):
+        return ranges[e.index]
+    if isinstance(e, Literal):
+        if isinstance(e.value, (int, np.integer)) \
+                and not isinstance(e.value, bool):
+            v = int(e.value)
+            return (v, v, max(1, abs(v)))
+        return None
+    if isinstance(e, _TsShift):
+        r = _range_of(e.arg, ranges)
+        if r is None:
+            return None
+        return (r[0] + e.delta, r[1] + e.delta,
+                math.gcd(r[2], abs(e.delta)) or 1)
+    if isinstance(e, FunctionCall) and e.name in ("add", "subtract") \
+            and len(e.args) == 2:
+        a = _range_of(e.args[0], ranges)
+        b = _range_of(e.args[1], ranges)
+        if a is None or b is None:
+            return None
+        if e.name == "add":
+            lo, hi = a[0] + b[0], a[1] + b[1]
+        else:
+            lo, hi = a[0] - b[1], a[1] - b[0]
+        return (lo, hi, math.gcd(a[2], b[2]) or 1)
+    if isinstance(e, FunctionCall) and e.return_type.kind == TypeKind.BOOLEAN:
+        return (0, 1, 1)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+
+
+class _Fuser:
+    def __init__(self, device_cfg, epoch_events_cap: Optional[int] = None):
+        self.nodes: List[Node] = []
+        self.capacity = getattr(device_cfg, "capacity", 1 << 14) or (1 << 14)
+        self.epoch_events: Optional[int] = epoch_events_cap
+        self._source_cache: Dict[int, Meta] = {}
+        self.max_events: Optional[int] = None
+
+    def add(self, node: Node) -> int:
+        self.nodes.append(node)
+        return len(self.nodes) - 1
+
+    # ---- leaf: source chain --------------------------------------------
+    def _source(self, execu) -> Meta:
+        from ..connectors.nexmark import NexmarkReader
+        from ..ops import (MaterializeExecutor, RowIdGenExecutor,
+                           SourceExecutor)
+        from ..ops.executor import SharedStreamPort
+        from ..sql.database import _Backfill
+        desc = getattr(execu, "virtual_source", None)
+        e = execu
+        while desc is None:
+            if isinstance(e, _Backfill):
+                if e.snapshot is not None and e.snapshot.capacity:
+                    raise FuseReject("source already has history (backfill "
+                                     "snapshot) — fused jobs start at 0")
+                e = e.port
+            elif isinstance(e, SharedStreamPort):
+                e = e.shared.upstream
+            elif isinstance(e, (MaterializeExecutor, RowIdGenExecutor)):
+                e = e.input
+            elif isinstance(e, SourceExecutor):
+                if not isinstance(e.reader, NexmarkReader):
+                    raise FuseReject(f"unfusable reader "
+                                     f"{type(e.reader).__name__}")
+                if e.reader.next_event:
+                    raise FuseReject("source already advanced")
+                desc = _NexmarkDesc.from_reader(e.reader, e.schema)
+            else:
+                raise FuseReject(f"unfusable source chain node "
+                                 f"{type(e).__name__}")
+        key = desc.cache_key
+        if key in self._source_cache:
+            return self._source_cache[key]
+        from .nexmark_gen import GenCfg
+        cfg = desc.gencfg
+        if self.max_events is None:
+            self.max_events = desc.max_events
+        elif desc.max_events != self.max_events:
+            raise FuseReject("sources disagree on max_events")
+        ee = desc.events_per_poll * 64      # SourceExecutor poll budget
+        if self.epoch_events is None:
+            self.epoch_events = ee
+        elif self.epoch_events != ee:
+            raise FuseReject("sources disagree on epoch cadence")
+        node = SourceNode(desc.table, cfg, desc.col_names, desc.rowid_pos,
+                          desc.max_events, desc.dtypes)
+        idx = self.add(node)
+        meta = Meta(idx, list(node.dtypes), list(node.decoders),
+                    list(node.ranges),
+                    rows_bound=desc.max_events or _HORIZON,
+                    append_only=True)
+        self._source_cache[key] = meta
+        return meta
+
+    # ---- recursive build ------------------------------------------------
+    def build(self, execu, need_pk: bool) -> Meta:
+        from ..ops import (FilterExecutor, HashAggExecutor, HashJoinExecutor,
+                           HopWindowExecutor, JoinType, ProjectExecutor)
+        from ..ops.device_agg import DeviceHashAggExecutor
+        from ..ops.device_join import DeviceHashJoinExecutor
+
+        if isinstance(execu, ProjectExecutor):
+            m = self.build(execu.input, need_pk)
+            return self._map(m, execu.exprs)
+        if isinstance(execu, FilterExecutor):
+            m = self.build(execu.input, need_pk)
+            pred = _devify(execu.predicate)
+            _surrogate_safe(pred, m.decoders)
+            idx = self.add(FilterNode(m.idx, pred))
+            return replace(m, idx=idx, agg=None)
+        if isinstance(execu, HopWindowExecutor):
+            m = self.build(execu.input, need_pk)
+            if m.agg is not None or m.is_pair:
+                raise FuseReject("hop over non-source stream")
+            node = HopNode(m.idx, execu.time_col, execu.hop_usecs,
+                           execu.size_usecs)
+            idx = self.add(node)
+            tr = m.ranges[execu.time_col]
+            if tr is None:
+                raise FuseReject("hop over unbounded time column")
+            ws = ((tr[0] // execu.hop_usecs - node.n) * execu.hop_usecs,
+                  tr[1], execu.hop_usecs)
+            we = (ws[0] + execu.size_usecs, tr[1] + execu.size_usecs,
+                  execu.hop_usecs)
+            return Meta(idx, m.dtypes + [T.TIMESTAMP, T.TIMESTAMP],
+                        m.decoders + [TS, TS], m.ranges + [ws, we],
+                        rows_bound=m.rows_bound * node.n,
+                        append_only=m.append_only)
+        if isinstance(execu, (DeviceHashAggExecutor, HashAggExecutor)):
+            return self._agg(execu, need_pk)
+        if isinstance(execu, (DeviceHashJoinExecutor, HashJoinExecutor)):
+            if isinstance(execu, HashJoinExecutor) \
+                    and execu.join_type != JoinType.INNER:
+                raise FuseReject("non-inner join")
+            return self._join(execu)
+        # source chains (backfill/port/virtual) end the recursion
+        return self._source(execu)
+
+    def _map(self, m: Meta, exprs: Sequence[Expr]) -> Meta:
+        dexprs, dts, decs, rngs = [], [], [], []
+        for e in exprs:
+            de = _devify(e)
+            _surrogate_safe(de, m.decoders)
+            dexprs.append(de)
+            dts.append(e.return_type)
+            if isinstance(de, InputRef):
+                decs.append(m.decoders[de.index])
+            elif e.return_type.kind in (TypeKind.TIMESTAMP, TypeKind.DATE):
+                decs.append(TS)
+            else:
+                decs.append(NUM)
+            rngs.append(_range_of(de, m.ranges))
+        idx = self.add(MapNode(m.idx, dexprs, dts, decs, rngs))
+        return Meta(idx, dts, decs, rngs, m.rows_bound, m.append_only,
+                    is_pair=m.is_pair)
+
+    def _agg(self, execu, need_pk: bool) -> Meta:
+        from .agg_step import DeviceAggSpec
+        m = self.build(execu.input, need_pk=False)
+        gidx = list(execu.group_key_indices)
+        calls = list(execu.calls)
+        kinds, arg_dtypes, arg_ids = [], [], []
+        out_dt, out_dec, out_rng = [], [], []
+        for i in gidx:
+            out_dt.append(m.dtypes[i])
+            out_dec.append(m.decoders[i])
+            out_rng.append(m.ranges[i])
+        for ci, c in enumerate(calls):
+            if c.distinct or c.filter is not None:
+                raise FuseReject("DISTINCT / FILTER aggregate")
+            k = "count_star" if c.kind == "count" and c.arg is None \
+                else c.kind
+            if k not in ("count_star", "count", "sum", "min", "max"):
+                raise FuseReject(f"aggregate {c.kind} not fused")
+            if c.arg is not None:
+                if not isinstance(c.arg, InputRef):
+                    raise FuseReject("non-column aggregate argument")
+                if m.decoders[c.arg.index] not in (NUM, TS):
+                    raise FuseReject("aggregate over string surrogate")
+                dd = c.arg.return_type.device_dtype
+                if dd is None:
+                    raise FuseReject("aggregate arg has no device dtype")
+                if k in ("min", "max") and not m.append_only \
+                        and np.issubdtype(np.dtype(dd), np.floating):
+                    # retractable min/max multisets hold order-encoded
+                    # int64; the fused path doesn't order-encode floats
+                    raise FuseReject("retractable float min/max not fused")
+                arg_dtypes.append(np.dtype(dd))
+                arg_ids.append(("ref", c.arg.index))
+            else:
+                arg_dtypes.append(np.int64)
+                arg_ids.append(("call", ci))
+            out_dt.append(c.return_type)
+            if k in ("count_star", "count"):
+                out_dec.append(NUM)
+                out_rng.append((0, m.rows_bound, 1))
+            elif k == "sum":
+                out_dec.append(NUM)
+                out_rng.append(None)
+            else:                    # min / max: value from the arg column
+                out_dec.append(m.decoders[c.arg.index])
+                out_rng.append(m.ranges[c.arg.index])
+        pack = PackPlan.plan([m.ranges[i] for i in gidx])
+        if pack is None:
+            raise FuseReject("group key not losslessly packable")
+        spec = DeviceAggSpec.build(
+            ["count_star" if c.kind == "count" and c.arg is None else c.kind
+             for c in calls],
+            arg_dtypes, append_only=m.append_only, arg_ids=arg_ids)
+        pk_pack = None
+        if need_pk:
+            pk_pack = PackPlan.plan(out_rng)
+            if pk_pack is None:
+                raise FuseReject("agg change-row identity not packable")
+        node = AggNode(m.idx, gidx, calls, pack, spec, self.capacity,
+                       out_dec, out_dt, out_rng, pk_pack)
+        idx = self.add(node)
+        return Meta(idx, out_dt, out_dec, out_rng,
+                    rows_bound=2 * m.rows_bound, append_only=False,
+                    agg=node)
+
+    def _join(self, execu) -> Meta:
+        from ..ops.device_join import DeviceHashJoinExecutor
+        if isinstance(execu, DeviceHashJoinExecutor):
+            lkeys, rkeys = execu.key_idx["a"], execu.key_idx["b"]
+            lex, rex = execu.left_exec, execu.right_exec
+            cond = execu.condition
+        else:
+            lkeys, rkeys = execu.left_keys, execu.right_keys
+            lex, rex = execu.left_exec, execu.right_exec
+            cond = execu.condition
+        lm = self.build(lex, need_pk=True)
+        rm = self.build(rex, need_pk=True)
+        merged = []
+        for li, ri in zip(lkeys, rkeys):
+            a, b = lm.ranges[li], rm.ranges[ri]
+            if a is None or b is None:
+                raise FuseReject("join key not packable")
+            if (lm.decoders[li] in (NUM, TS)) != (rm.decoders[ri] in (NUM,
+                                                                      TS)):
+                raise FuseReject("join between surrogate and plain column")
+            merged.append((min(a[0], b[0]), max(a[1], b[1]),
+                           math.gcd(a[2], b[2]) or 1))
+        pack = PackPlan.plan(merged)
+        if pack is None:
+            raise FuseReject("join key not losslessly packable")
+        out_dt = lm.dtypes + rm.dtypes
+        out_dec = lm.decoders + rm.decoders
+        out_rng = lm.ranges + rm.ranges
+        dcond = None
+        if cond is not None:
+            dcond = _devify(cond)
+            _surrogate_safe(dcond, out_dec)
+        import jax.numpy as jnp
+        to_dev = lambda dts: [jnp.float64 if d.np_dtype is not None
+                              and np.issubdtype(d.np_dtype, np.floating)
+                              else jnp.int64 for d in dts]
+        node = JoinNode(lm.idx, rm.idx, lkeys, rkeys, pack, dcond,
+                        self.capacity, 4 * self.capacity,
+                        out_dec, out_dt, out_rng,
+                        to_dev(lm.dtypes), to_dev(rm.dtypes))
+        idx = self.add(node)
+        rb = min(lm.rows_bound * rm.rows_bound, _HORIZON)
+        return Meta(idx, out_dt, out_dec, out_rng, rows_bound=rb,
+                    append_only=lm.append_only and rm.append_only,
+                    is_pair=True)
+
+
+@dataclass(frozen=True)
+class _NexmarkDesc:
+    table: str
+    gencfg: Any
+    col_names: Tuple[str, ...]
+    dtypes: Tuple[DataType, ...]
+    rowid_pos: Optional[int]
+    max_events: Optional[int]
+    events_per_poll: int
+    cache_key: Tuple
+
+    @staticmethod
+    def from_reader(reader, schema) -> "_NexmarkDesc":
+        from .nexmark_gen import GenCfg
+        names = [f.name for f in schema.fields]
+        rowid = names.index("_row_id") if "_row_id" in names else None
+        return _NexmarkDesc(
+            reader.table, GenCfg.from_config(reader.gen.cfg), tuple(names),
+            tuple(f.dtype for f in schema.fields), rowid,
+            reader.max_events, reader.events_per_poll,
+            (reader.table, id(reader.gen)))
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def try_fuse(execu, ns, device_cfg, name: str,
+             mv_state_table=None, make_state=None) -> Optional[FusedJob]:
+    """Lower a planned MV executor tree to a FusedJob, or None.
+
+    `execu` is the tree Database._create_mv would hand to Materialize;
+    `ns` its namespace (schema + stream key + visibility).
+    """
+    from ..ops import ProjectExecutor
+    if device_cfg is None or getattr(device_cfg, "mesh", None) is not None:
+        return None        # fused path is single-chip; mesh uses sharded ops
+    try:
+        f = _Fuser(device_cfg)
+        if not isinstance(execu, ProjectExecutor):
+            raise FuseReject(f"unexpected terminal {type(execu).__name__}")
+        inner = execu.input
+        from ..ops import HashAggExecutor
+        from ..ops.device_agg import DeviceHashAggExecutor
+        if isinstance(inner, (DeviceHashAggExecutor, HashAggExecutor)):
+            # keyed MV straight off the agg change set
+            m = f.build(inner, need_pk=False)
+            agg = m.agg
+            out_map, dts, decs = [], [], []
+            ng = len(agg.group_idx)
+            for e in execu.exprs:
+                if not isinstance(e, InputRef):
+                    raise FuseReject("computed column over aggregation "
+                                     "output")
+                out_map.append(("g", e.index) if e.index < ng
+                               else ("c", e.index - ng))
+                dts.append(e.return_type)
+                decs.append(m.decoders[e.index])
+            mv_idx = f.add(MVKeyedNode(m.idx, agg, agg.capacity))
+            pull = MVPull("keyed", mv_idx, dts, decs, agg=agg,
+                          out_map=out_map)
+        else:
+            m = f.build(inner, need_pk=False)
+            if not m.is_pair:
+                raise FuseReject("terminal stream has no pair identity "
+                                 "(plain stateless MVs stay on host)")
+            m = f._map(m, execu.exprs)
+            mv_idx = f.add(MVPairNode(m.idx,
+                                      _side_dtypes(m.dtypes),
+                                      f.capacity))
+            pull = MVPull("pair", mv_idx, m.dtypes, m.decoders)
+        program = FusedProgram(f.nodes, f.epoch_events or 8192 * 64)
+        job_table = make_state([T.INT64, T.INT64], [0]) if make_state \
+            else None
+        return FusedJob(name, program, pull, f.max_events,
+                        mv_state_table=mv_state_table,
+                        job_state_table=job_table,
+                        mv_schema_len=len(ns.cols))
+    except FuseReject:
+        return None
+
+
+def _side_dtypes(dts: Sequence[DataType]):
+    import jax.numpy as jnp
+    return [jnp.float64 if d.np_dtype is not None
+            and np.issubdtype(d.np_dtype, np.floating) else jnp.int64
+            for d in dts]

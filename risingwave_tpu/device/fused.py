@@ -1,0 +1,818 @@
+"""Fused fragment runtime: a whole SQL dataflow as ONE jitted epoch program.
+
+This is the TPU-first answer to the reference's actor pipeline (SURVEY §3.2
+source -> dispatch -> agg/join -> materialize): instead of per-operator
+host round trips (r02's bottleneck on a ~0.5s-RTT device tunnel), the fuse
+planner (`device/fuse_planner.py`) lowers an eligible MV fragment into a
+stage graph whose per-epoch step — on-device datagen, expression eval, hop
+expansion, agg (`agg_step.epoch_core_full`), join (`join_step.join_core`)
+with on-device pair netting, MV apply — is one traced XLA program over
+device-resident state. The host barrier loop only *dispatches* (async);
+it synchronizes exclusively at checkpoints and SELECTs, the barrier-
+boundary parity license the reference's shared buffer exploits
+(`materialize.rs:166`, `hash_agg.rs:411`).
+
+Exactness: no hashing anywhere. Group/join/row-identity keys are LOSSLESS
+bit-packings chosen by static interval analysis (offset/stride/bits per
+column) and *verified on device* — any value outside its proven range
+raises at the next sync instead of corrupting state. Row identity for
+retractable change streams packs (stream key, payload) so an update never
+nets against its own retraction (the r02 pair-resurrection lesson).
+
+Recovery: fused fragments run over DETERMINISTIC replayable sources
+(nexmark/datagen), so recovery = regenerate: restore the committed event
+counter and re-run the epoch loop device-side (the Kafka-offset-rewind
+analog of `source_executor.rs` split state — state reconstruction at HBM
+speed instead of trickling LSM rows through the tunnel). The MV contents
+are additionally persisted to the MV state table at every checkpoint, so
+non-device readers (system catalogs, risectl) see committed data.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from decimal import Decimal
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import dtypes as T
+from ..core.dtypes import DataType, TypeKind
+
+# ---------------------------------------------------------------------------
+# Delta: the traced value flowing between stages (NOT a jit boundary type)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Delta:
+    """A batch of signed rows on device. `cols` is positional (aligned with
+    the producing operator's schema); `pk`/`pk2` carry row identity for
+    joins and pair MVs. Static metadata rides along for the fuse planner:
+    per-column surrogate decoders, SQL dtypes, and (lo, hi, stride) integer
+    ranges for lossless key packing. All columns are non-null by
+    construction (fuse eligibility rejects nullable flows)."""
+    cols: List[Any]
+    sign: Any
+    mask: Any
+    pk: Optional[Any] = None
+    pk2: Optional[Any] = None
+    decoders: List[Tuple] = field(default_factory=list)
+    dtypes: List[DataType] = field(default_factory=list)
+    ranges: List[Optional[Tuple[int, int, int]]] = field(default_factory=list)
+
+    @property
+    def size(self) -> int:
+        return int(self.mask.shape[0])
+
+
+NUM = ("num",)
+
+
+# ---------------------------------------------------------------------------
+# lossless key packing
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PackField:
+    offset: int
+    stride: int
+    bits: int
+
+
+@dataclass(frozen=True)
+class PackPlan:
+    """key = sum_i ((col_i - offset_i) // stride_i) << shift_i, proven
+    lossless by interval analysis and re-verified on device (`check`)."""
+    fields: Tuple[PackField, ...]
+
+    @staticmethod
+    def plan(ranges: Sequence[Optional[Tuple[int, int, int]]]
+             ) -> Optional["PackPlan"]:
+        fields = []
+        total = 0
+        for r in ranges:
+            if r is None:
+                return None
+            lo, hi, stride = r
+            stride = max(1, stride)
+            span = max(0, hi - lo) // stride
+            bits = max(1, int(span).bit_length())
+            fields.append(PackField(lo, stride, bits))
+            total += bits
+        if total > 62:        # keys must stay clear of EMPTY_KEY (2^63-1)
+            return None
+        return PackPlan(tuple(fields))
+
+    def pack(self, cols: Sequence[Any]):
+        import jax.numpy as jnp
+        key = jnp.zeros_like(cols[0])
+        shift = 0
+        for c, f in zip(cols, self.fields):
+            v = (c - f.offset) // f.stride if f.stride > 1 else c - f.offset
+            key = key + (v.astype(jnp.int64) << shift)
+            shift += f.bits
+        return key
+
+    def unpack(self, key) -> List[Any]:
+        import jax.numpy as jnp
+        out = []
+        shift = 0
+        for f in self.fields:
+            v = (key >> shift) & ((1 << f.bits) - 1)
+            out.append((v * f.stride + f.offset).astype(jnp.int64))
+            shift += f.bits
+        return out
+
+    def check(self, cols: Sequence[Any], mask):
+        """int64 violation flag (0 = all rows within their proven ranges)."""
+        import jax.numpy as jnp
+        bad = jnp.zeros((), jnp.int64)
+        for c, f in zip(cols, self.fields):
+            r = c - f.offset
+            v = r // f.stride if f.stride > 1 else r
+            row_bad = (r < 0) | (v >= (1 << f.bits))
+            if f.stride > 1:
+                row_bad |= (r % f.stride) != 0
+            bad = bad | jnp.where(mask & row_bad, 1, 0).max()
+        return bad
+
+
+# ---------------------------------------------------------------------------
+# stage nodes
+# ---------------------------------------------------------------------------
+
+
+class Node:
+    """Static stage config. `inputs` are node indices; state is one pytree
+    slot per node (None when stateless)."""
+    inputs: Tuple[int, ...] = ()
+    stat_names: Tuple[str, ...] = ()
+
+    def init_state(self):
+        return None
+
+    def grow(self, state, stats: Dict[str, int]):
+        """(state', grew) given this node's pulled stats."""
+        return state, False
+
+    def apply(self, state, ins: List[Optional[Delta]], ctx: Dict[str, Any]):
+        """-> (state', out Delta | None, [stat scalars])"""
+        raise NotImplementedError
+
+
+def _bucket(n: int, lo: int = 256) -> int:
+    return max(lo, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+class SourceNode(Node):
+    """On-device exact Nexmark/datagen events for this epoch's id range."""
+
+    def __init__(self, table: str, gencfg, col_names: Sequence[str],
+                 rowid_pos: Optional[int], max_events: Optional[int],
+                 schema_dtypes: Sequence[DataType]):
+        from .nexmark_gen import SURROGATE, column_bounds
+        self.table = table
+        self.gencfg = gencfg
+        self.col_names = list(col_names)
+        self.rowid_pos = rowid_pos
+        self.max_events = max_events
+        self.dtypes = list(schema_dtypes)
+        self.decoders = []
+        self.ranges: List[Optional[Tuple[int, int, int]]] = []
+        for i, nm in enumerate(self.col_names):
+            if i == rowid_pos:
+                self.decoders.append(NUM)
+                self.ranges.append((0, max_events or (1 << 40), 1))
+                continue
+            self.decoders.append(SURROGATE[table][nm])
+            lo, hi = column_bounds(gencfg, table, nm, max_events)
+            stride = gencfg.inter_event_gap_usecs \
+                if SURROGATE[table][nm] == ("ts",) and nm == "date_time" else 1
+            self.ranges.append((lo, hi, stride))
+
+    def apply(self, state, ins, ctx):
+        import jax.numpy as jnp
+        from .nexmark_gen import gen_table, table_mask
+        ids = ctx["event_lo"] + jnp.arange(ctx["epoch_events"],
+                                           dtype=jnp.int64)
+        mask = table_mask(self.table, ids)
+        if self.max_events is not None:
+            mask = mask & (ids < self.max_events)
+        all_cols = gen_table(self.gencfg, self.table, ids)
+        cols = [ids if i == self.rowid_pos else all_cols[nm]
+                for i, nm in enumerate(self.col_names)]
+        d = Delta(cols, jnp.ones(ids.shape, jnp.int32), mask, pk=ids,
+                  decoders=list(self.decoders), dtypes=list(self.dtypes),
+                  ranges=list(self.ranges))
+        return state, d, []
+
+
+class MapNode(Node):
+    """Project: device-evaluable expressions over the input delta."""
+
+    def __init__(self, input: int, exprs: Sequence[Any],
+                 dtypes: Sequence[DataType], decoders: Sequence[Tuple],
+                 ranges: Sequence[Optional[Tuple[int, int, int]]]):
+        self.inputs = (input,)
+        self.exprs = list(exprs)
+        self.dtypes = list(dtypes)
+        self.decoders = list(decoders)
+        self.ranges = list(ranges)
+
+    def apply(self, state, ins, ctx):
+        d = ins[0]
+        cols = [e.eval_device(d.cols)[0] for e in self.exprs]
+        out = Delta(cols, d.sign, d.mask, pk=d.pk, pk2=d.pk2,
+                    decoders=list(self.decoders), dtypes=list(self.dtypes),
+                    ranges=list(self.ranges))
+        return state, out, []
+
+
+class FilterNode(Node):
+    def __init__(self, input: int, pred: Any):
+        self.inputs = (input,)
+        self.pred = pred
+
+    def apply(self, state, ins, ctx):
+        d = ins[0]
+        ok, valid = self.pred.eval_device(d.cols)
+        out = Delta(d.cols, d.sign, d.mask & ok & valid, pk=d.pk, pk2=d.pk2,
+                    decoders=d.decoders, dtypes=d.dtypes, ranges=d.ranges)
+        return state, out, []
+
+
+class HopNode(Node):
+    """Row -> size/hop windowed copies, appending window_start/window_end
+    (`HopWindowExecutor` / TUMBLE when hop == size). Row identity extends
+    with the window ordinal so each copy stays unique."""
+
+    def __init__(self, input: int, time_col: int, hop_usecs: int,
+                 size_usecs: int):
+        assert size_usecs % hop_usecs == 0
+        self.inputs = (input,)
+        self.time_col = time_col
+        self.hop = hop_usecs
+        self.size = size_usecs
+        self.n = size_usecs // hop_usecs
+
+    def apply(self, state, ins, ctx):
+        import jax.numpy as jnp
+        d = ins[0]
+        n = self.n
+        rep = lambda a: jnp.repeat(a, n)
+        ts = d.cols[self.time_col]
+        first = (ts // self.hop) * self.hop
+        k = jnp.tile(jnp.arange(n, dtype=jnp.int64), ts.shape[0])
+        starts = rep(first) - k * self.hop
+        cols = [rep(c) for c in d.cols] + [starts, starts + self.size]
+        tlo, thi, _ = d.ranges[self.time_col]
+        ws_rng = ((tlo // self.hop - n) * self.hop, thi, self.hop)
+        we_rng = (ws_rng[0] + self.size, thi + self.size, self.hop)
+        pk = rep(d.pk) * n + k if d.pk is not None else None
+        out = Delta(cols, rep(d.sign), rep(d.mask), pk=pk,
+                    decoders=d.decoders + [("ts",), ("ts",)],
+                    dtypes=d.dtypes + [T.TIMESTAMP, T.TIMESTAMP],
+                    ranges=d.ranges + [ws_rng, we_rng])
+        return state, out, []
+
+
+class AggNode(Node):
+    """epoch_core_full behind a packed group key; emits the change stream
+    as a signed delta (old rows retract, new rows insert; unchanged groups
+    suppressed). Change-set internals are exposed via ctx for a terminal
+    keyed MV."""
+
+    def __init__(self, input: int, group_idx: Sequence[int], calls,
+                 pack: PackPlan, spec, capacity: int,
+                 out_decoders, out_dtypes, out_ranges,
+                 pk_pack: Optional[PackPlan]):
+        self.inputs = (input,)
+        self.group_idx = list(group_idx)
+        self.calls = list(calls)
+        self.pack = pack
+        self.spec = spec
+        self.capacity = capacity
+        self.decoders = list(out_decoders)
+        self.dtypes = list(out_dtypes)
+        self.ranges = list(out_ranges)
+        # row identity of emitted change rows = pack(group, outputs); None
+        # when no join/pair-MV consumes this stream (pk then unused)
+        self.pk_pack = pk_pack
+        self.stat_names = tuple(["needed"]
+                                + [f"ms{i}" for i in range(len(spec.minputs))]
+                                + ["packbad"])
+
+    def init_state(self):
+        return self.spec.make_full_state(self.capacity)
+
+    def grow(self, state, stats):
+        from .agg_step import DeviceAggState
+        from .minput import ms_grow
+        from .sorted_state import grow_state
+        grew = False
+        main = state.main
+        if stats["needed"] > main.capacity:
+            self.capacity = _bucket(stats["needed"], lo=main.capacity * 2)
+            main = grow_state(main, self.capacity, self.spec.kinds)
+            grew = True
+        ms = list(state.minputs)
+        for i in range(len(ms)):
+            if stats[f"ms{i}"] > ms[i].capacity:
+                ms[i] = ms_grow(ms[i], _bucket(stats[f"ms{i}"],
+                                               lo=ms[i].capacity * 2))
+                grew = True
+        return DeviceAggState(main, tuple(ms)), grew
+
+    def _call_outputs(self, ch, which: str):
+        """Per-call (array, null) at the touched keys, old or new."""
+        outs, nulls = [], []
+        for ci, dc in enumerate(self.spec.calls):
+            if dc.minput is not None:
+                sub = ch[f"minput{dc.minput}"]
+                v = sub[f"{which}_max"] if self.calls[ci].kind == "max" \
+                    else sub[f"{which}_min"]
+                outs.append(v)
+                nulls.append(~sub[f"{which}_found"])
+            else:
+                outs.append(ch[f"{which}_out"][ci])
+                nulls.append(ch[f"{which}_null"][ci])
+        return outs, nulls
+
+    def apply(self, state, ins, ctx):
+        import jax.numpy as jnp
+        from .agg_step import epoch_core_full
+        d = ins[0]
+        gcols = [d.cols[i] for i in self.group_idx]
+        packbad = self.pack.check(gcols, d.mask & (d.sign != 0))
+        keys = self.pack.pack(gcols)
+        inputs = []
+        for c in self.calls:
+            if c.arg is None:
+                z = jnp.zeros_like(keys)
+                inputs.append((z, jnp.ones(z.shape, bool)))
+            else:
+                inputs.append((d.cols[c.arg.index],
+                               jnp.ones(keys.shape, bool)))
+        new_state, _needed, ch = epoch_core_full(
+            self.spec, state, keys, d.sign, d.mask, tuple(inputs))
+        needed, ms_needed = _needed
+        # ---- change stream: old rows (-1) then new rows (+1) ------------
+        old_found, new_found = ch["old_found"], ch["new_found"]
+        old_outs, _ = self._call_outputs(ch, "old")
+        new_outs, _ = self._call_outputs(ch, "new")
+        changed = ~(old_found & new_found)
+        for ov, nv in zip(old_outs, new_outs):
+            changed = changed | (ov != nv)
+        ug = self.pack.unpack(ch["keys"])
+        cat = lambda a, b: jnp.concatenate([a, b])
+        cols = [cat(g, g) for g in ug]
+        for ov, nv in zip(old_outs, new_outs):
+            cols.append(cat(ov, nv).astype(jnp.int64)
+                        if not jnp.issubdtype(ov.dtype, jnp.floating)
+                        else cat(ov, nv))
+        n = ch["keys"].shape[0]
+        sign = cat(-jnp.ones(n, jnp.int32), jnp.ones(n, jnp.int32))
+        mask = cat(old_found & changed, new_found & changed)
+        pk = None
+        if self.pk_pack is not None:
+            pk = self.pk_pack.pack(cols)
+            packbad = packbad | self.pk_pack.check(cols, mask)
+        out = Delta(cols, sign, mask, pk=pk,
+                    decoders=list(self.decoders), dtypes=list(self.dtypes),
+                    ranges=list(self.ranges))
+        ctx.setdefault("agg_changes", {})[id(self)] = ch
+        stats = [needed.astype(jnp.int64)] \
+            + [m.astype(jnp.int64) for m in ms_needed] + [packbad]
+        return new_state, out, stats
+
+
+class JoinNode(Node):
+    """join_core + on-device cross-delta pair netting (the r02 resurrection
+    fix, moved into the traced program) + optional non-equi condition over
+    the pair columns. Output pair identity = (left pk, right pk)."""
+
+    def __init__(self, left: int, right: int, l_keys: Sequence[int],
+                 r_keys: Sequence[int], pack: PackPlan,
+                 cond: Optional[Any], capacity: int, pair_capacity: int,
+                 out_decoders, out_dtypes, out_ranges,
+                 l_val_dtypes, r_val_dtypes):
+        self.inputs = (left, right)
+        self.l_keys = list(l_keys)
+        self.r_keys = list(r_keys)
+        self.pack = pack
+        self.cond = cond
+        self.capacity = capacity
+        self.m = pair_capacity
+        self.decoders = list(out_decoders)
+        self.dtypes = list(out_dtypes)
+        self.ranges = list(out_ranges)
+        self.l_val_dtypes = list(l_val_dtypes)
+        self.r_val_dtypes = list(r_val_dtypes)
+        self.stat_names = ("need_a", "need_b", "need_pairs", "packbad")
+
+    def init_state(self):
+        from .join_step import make_side
+        return (make_side(self.capacity, self.l_val_dtypes),
+                make_side(self.capacity, self.r_val_dtypes))
+
+    def grow(self, state, stats):
+        from .join_step import grow_side
+        a, b = state
+        grew = False
+        if stats["need_a"] > a.jk.shape[0]:
+            a = grow_side(a, _bucket(stats["need_a"], lo=a.jk.shape[0] * 2))
+            grew = True
+        if stats["need_b"] > b.jk.shape[0]:
+            b = grow_side(b, _bucket(stats["need_b"], lo=b.jk.shape[0] * 2))
+            grew = True
+        self.capacity = max(a.jk.shape[0], b.jk.shape[0])
+        if stats["need_pairs"] > self.m:
+            self.m = _bucket(stats["need_pairs"], lo=self.m * 2)
+            grew = True
+        return (a, b), grew
+
+    def apply(self, state, ins, ctx):
+        import jax.numpy as jnp
+        from .join_step import batch_reduce_rows, join_core
+        A, B = ins
+        packbad = jnp.zeros((), jnp.int64)
+        sides = []
+        for d, keys in ((A, self.l_keys), (B, self.r_keys)):
+            kcols = [d.cols[i] for i in keys]
+            packbad = packbad | self.pack.check(kcols, d.mask & (d.sign != 0))
+            jk = self.pack.pack(kcols)
+            vals = tuple(c if jnp.issubdtype(c.dtype, jnp.floating)
+                         else c.astype(jnp.int64) for c in d.cols)
+            sides.append((jk, d.pk, d.sign, d.mask, vals))
+        a, b = state
+        (ajk, apk, asg, amk, avals) = sides[0]
+        (bjk, bpk, bsg, bmk, bvals) = sides[1]
+        new_a, new_b, o1, o2, needed = join_core(
+            a, b, ajk, apk, asg, amk, avals, bjk, bpk, bsg, bmk, bvals,
+            self.m)
+        # ---- net identical pairs across the epoch's pair set ------------
+        cat = lambda k: jnp.concatenate([o1[k], o2[k]])
+        catv = lambda k, i: jnp.concatenate([o1[k][i], o2[k][i]])
+        sign = cat("sign")
+        mask = cat("mask") & (sign != 0)
+        pvals = [catv("a_vals", i) for i in range(len(avals))] \
+            + [catv("b_vals", i) for i in range(len(bvals))]
+        njk, npk, nsign, nvals = batch_reduce_rows(
+            cat("a_pk"), cat("b_pk"), sign, mask, pvals)
+        omask = nsign != 0
+        ocols = list(nvals)
+        if self.cond is not None:
+            ok, valid = self.cond.eval_device(ocols)
+            omask = omask & ok & valid
+        out = Delta(ocols, nsign, omask, pk=njk, pk2=npk,
+                    decoders=list(self.decoders), dtypes=list(self.dtypes),
+                    ranges=list(self.ranges))
+        stats = [needed["a"].astype(jnp.int64),
+                 needed["b"].astype(jnp.int64),
+                 needed["pairs"].astype(jnp.int64), packbad]
+        return (new_a, new_b), out, stats
+
+
+class MVKeyedNode(Node):
+    """Terminal MV over an agg change set: upsert-by-group-key table
+    (`device/materialize.py`), zero host traffic until a pull."""
+
+    def __init__(self, input: int, agg_node: AggNode, capacity: int):
+        self.inputs = (input,)
+        self.agg = agg_node
+        self.capacity = capacity
+        self.stat_names = ("needed",)
+
+    def init_state(self):
+        from .materialize import make_mv_state
+        dts = [c.acc_dtype for c in self.agg.spec.calls]
+        return make_mv_state(self.capacity, dts)
+
+    def grow(self, state, stats):
+        from .materialize import mv_kinds
+        from .sorted_state import grow_state
+        if stats["needed"] > state.capacity:
+            self.capacity = _bucket(stats["needed"], lo=state.capacity * 2)
+            return grow_state(state, self.capacity,
+                              mv_kinds(len(self.agg.spec.calls))), True
+        return state, False
+
+    def apply(self, state, ins, ctx):
+        import jax.numpy as jnp
+        from .materialize import mv_apply_changes
+        ch = ctx["agg_changes"][id(self.agg)]
+        upsert = ch["new_found"]
+        delete = ch["old_found"] & ~ch["new_found"]
+        outs, nulls = self.agg._call_outputs(ch, "new")
+        state, needed = mv_apply_changes(
+            state, ch["keys"], upsert, delete,
+            [o.astype(v.dtype) for o, v in
+             zip(outs, [state.vals[1 + 2 * i] for i in range(len(outs))])],
+            nulls)
+        return state, None, [needed.astype(jnp.int64)]
+
+
+class MVPairNode(Node):
+    """Terminal MV over a join's pair stream: a sorted multimap keyed by
+    (left pk, right pk) holding the output columns (merge_side upsert)."""
+
+    def __init__(self, input: int, val_dtypes, capacity: int):
+        self.inputs = (input,)
+        self.val_dtypes = list(val_dtypes)
+        self.capacity = capacity
+        self.stat_names = ("needed",)
+
+    def init_state(self):
+        from .join_step import make_side
+        return make_side(self.capacity, self.val_dtypes)
+
+    def grow(self, state, stats):
+        from .join_step import grow_side
+        if stats["needed"] > state.jk.shape[0]:
+            self.capacity = _bucket(stats["needed"],
+                                    lo=state.jk.shape[0] * 2)
+            return grow_side(state, self.capacity), True
+        return state, False
+
+    def apply(self, state, ins, ctx):
+        import jax.numpy as jnp
+        from .join_step import merge_side
+        d = ins[0]
+        sign = jnp.where(d.mask, d.sign, 0)
+        vals = tuple(c if jnp.issubdtype(c.dtype, jnp.floating)
+                     else c.astype(jnp.int64) for c in d.cols)
+        state, needed = merge_side(state, d.pk, d.pk2, sign, vals)
+        return state, None, [needed.astype(jnp.int64)]
+
+
+# ---------------------------------------------------------------------------
+# program: topo-ordered nodes -> one traced epoch function
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MVPull:
+    """How the host materializes the terminal MV state into SQL rows."""
+    kind: str                      # "keyed" | "pair"
+    node_idx: int
+    dtypes: List[DataType]
+    decoders: List[Tuple]
+    # keyed only: final column <- ("g", group_pos) | ("c", call_pos)
+    agg: Optional[AggNode] = None
+    out_map: Optional[List[Tuple[str, int]]] = None
+
+
+class FusedProgram:
+    def __init__(self, nodes: List[Node], epoch_events: int):
+        self.nodes = nodes
+        self.epoch_events = epoch_events
+        self.stat_layout: List[Tuple[int, str]] = []
+        for i, n in enumerate(nodes):
+            for s in n.stat_names:
+                self.stat_layout.append((i, s))
+
+    def init_states(self):
+        return tuple(n.init_state() for n in self.nodes)
+
+    def epoch(self, states, event_lo):
+        import jax.numpy as jnp
+        ctx: Dict[str, Any] = {"event_lo": event_lo,
+                               "epoch_events": self.epoch_events}
+        outs: List[Optional[Delta]] = []
+        new_states = list(states)
+        stats: List[Any] = []
+        for i, node in enumerate(self.nodes):
+            ins = [outs[j] for j in node.inputs]
+            st, out, s = node.apply(states[i], ins, ctx)
+            new_states[i] = st
+            outs.append(out)
+            stats.extend(s)
+        vec = jnp.stack(stats) if stats \
+            else jnp.zeros((1,), jnp.int64)
+        return tuple(new_states), vec
+
+    def step_fn(self):
+        """(states, event_lo, stats_acc) -> (states', max(stats_acc, stats)).
+        Re-jitted after capacity growth (shapes change)."""
+        import jax
+
+        def step(states, event_lo, stats_acc):
+            import jax.numpy as jnp
+            new_states, vec = self.epoch(states, event_lo)
+            return new_states, jnp.maximum(stats_acc, vec)
+
+        return jax.jit(step)
+
+    def node_stats(self, i: int, vec: np.ndarray) -> Dict[str, int]:
+        return {name: int(vec[k]) for k, (ni, name)
+                in enumerate(self.stat_layout) if ni == i}
+
+
+# ---------------------------------------------------------------------------
+# FusedJob: the host-side driver behind Database.tick
+# ---------------------------------------------------------------------------
+
+
+class FusedJob:
+    """Owns the device state of one fused MV fragment.
+
+    Barrier protocol: `on_barrier` DISPATCHES one epoch (async — no device
+    sync); checkpoint barriers sync, verify the accumulated stats (pack
+    bounds, capacity overflow), persist the MV + committed event counter,
+    and advance the restore snapshot. Capacity overflow restores the last
+    snapshot, grows, and deterministically replays — barrier-boundary
+    exactness is never compromised by the async window.
+    """
+
+    def __init__(self, name: str, program: FusedProgram, pull: MVPull,
+                 max_events: Optional[int],
+                 mv_state_table=None, job_state_table=None,
+                 mv_schema_len: Optional[int] = None):
+        import jax.numpy as jnp
+        self.name = name
+        self.program = program
+        self.pull = pull
+        self.max_events = max_events
+        self.mv_state_table = mv_state_table
+        self.job_state_table = job_state_table
+        self.mv_schema_len = mv_schema_len or len(pull.dtypes)
+        self.counter = 0
+        self.committed = 0
+        self.states = program.init_states()
+        self.snapshot = (self.states, 0)
+        self._zero_stats = jnp.zeros((max(1, len(program.stat_layout)),),
+                                     jnp.int64)
+        self.stats_acc = self._zero_stats
+        self._step = program.step_fn()
+        self._persisted: Dict[Tuple, Tuple] = {}
+
+    # ---- barrier protocol ----------------------------------------------
+    @property
+    def drained(self) -> bool:
+        return self.max_events is not None \
+            and self.counter >= self.max_events
+
+    def on_barrier(self, barrier) -> None:
+        import jax.numpy as jnp
+        if not self.drained:
+            self.states, self.stats_acc = self._step(
+                self.states, jnp.int64(self.counter), self.stats_acc)
+            self.counter += self.program.epoch_events
+        if barrier.is_checkpoint:
+            self._checkpoint(barrier.epoch.curr)
+
+    # ---- sync / growth / replay ----------------------------------------
+    def _dispatch_range(self, lo: int, hi: int) -> None:
+        import jax.numpy as jnp
+        e = self.program.epoch_events
+        c = lo
+        while c < hi:
+            self.states, self.stats_acc = self._step(
+                self.states, jnp.int64(c), self.stats_acc)
+            c += e
+
+    def sync(self) -> None:
+        """Block; verify stats; grow + replay from snapshot when any state
+        overflowed its static capacity."""
+        import jax
+        while True:
+            vec = np.asarray(jax.device_get(self.stats_acc))
+            for k, (ni, nm) in enumerate(self.program.stat_layout):
+                if nm == "packbad" and vec[k] != 0:
+                    raise RuntimeError(
+                        f"fused job {self.name}: packed-key bounds violated "
+                        f"at node {ni} ({type(self.program.nodes[ni]).__name__}"
+                        ") — a column left its statically proven range. "
+                        "Re-create this MV with device='off'.")
+            snap_states, snap_counter = self.snapshot
+            grew = False
+            new_states = []
+            for i, node in enumerate(self.program.nodes):
+                st, g = node.grow(snap_states[i],
+                                  self.program.node_stats(i, vec))
+                new_states.append(st)
+                grew = grew or g
+            if not grew:
+                return
+            target = self.counter
+            self.states = tuple(new_states)
+            self.snapshot = (self.states, snap_counter)
+            self.counter = snap_counter
+            self.stats_acc = self._zero_stats
+            self._step = self.program.step_fn()
+            self._dispatch_range(snap_counter, target)
+            self.counter = target
+
+    def _checkpoint(self, epoch: int) -> None:
+        self.sync()
+        self._persist_mv(epoch)
+        if self.job_state_table is not None:
+            if self.committed != self.counter or self.committed == 0:
+                self.job_state_table.insert((0, self.counter))
+                self.job_state_table.commit(epoch)
+        self.snapshot = (self.states, self.counter)
+        self.stats_acc = self._zero_stats
+        self.committed = self.counter
+
+    # ---- MV materialization --------------------------------------------
+    def _pull_rows(self) -> List[Tuple]:
+        import jax
+        if self.pull.kind == "keyed":
+            from .materialize import mv_rows
+            st = self.states[self.pull.node_idx]
+            dts = [c.acc_dtype for c in self.pull.agg.spec.calls]
+            keys, cols, nulls = mv_rows(st, dts)
+            gcols_np = _np_unpack(self.pull.agg.pack, keys)
+            out_cols = []
+            for pos, (kind, j) in enumerate(self.pull.out_map):
+                src = gcols_np[j] if kind == "g" else cols[j]
+                null = None if kind == "g" else nulls[j]
+                out_cols.append(_format_col(
+                    self.pull.dtypes[pos], self.pull.decoders[pos],
+                    np.asarray(src), null))
+            n = len(keys)
+        else:
+            side = self.states[self.pull.node_idx]
+            n = int(side.count)
+            vals = jax.device_get([v[:n] if hasattr(v, "shape") else v
+                                   for v in side.vals])
+            out_cols = [_format_col(self.pull.dtypes[i],
+                                    self.pull.decoders[i],
+                                    np.asarray(vals[i]), None)
+                        for i in range(len(self.pull.dtypes))]
+        return [tuple(c[i] for c in out_cols) for i in range(n)]
+
+    def mv_rows_now(self) -> List[Tuple]:
+        """Query serving: sync and pull the CURRENT MV rows (full schema,
+        hidden stream-key columns included)."""
+        self.sync()
+        return self._pull_rows()
+
+    def _persist_mv(self, epoch: int) -> None:
+        """Diff the pulled MV against the last persisted image and write
+        the change into the MV state table (checkpoint visibility for
+        non-device readers + the recovery contract's committed view)."""
+        if self.mv_state_table is None:
+            return
+        rows = {r: None for r in self._pull_rows()}
+        for r in self._persisted:
+            if r not in rows:
+                self.mv_state_table.delete(r)
+        for r in rows:
+            if r not in self._persisted:
+                self.mv_state_table.insert(r)
+        self._persisted = rows
+        self.mv_state_table.commit(epoch)
+
+    # ---- recovery -------------------------------------------------------
+    def recover(self) -> None:
+        """Deterministic-source recovery: restore the committed event
+        counter and regenerate state device-side (offset rewind)."""
+        if self.job_state_table is None:
+            return
+        target = 0
+        for row in self.job_state_table.iter_all():
+            target = max(target, int(row[1]))
+        if target == 0:
+            return
+        self._dispatch_range(0, target)
+        self.counter = target
+        self.sync()
+        self.snapshot = (self.states, target)
+        self.stats_acc = self._zero_stats
+        self.committed = target
+        if self.mv_state_table is not None:
+            self._persisted = {tuple(r): None
+                               for r in self.mv_state_table.iter_all()}
+
+
+def _np_unpack(pack: PackPlan, keys: np.ndarray) -> List[np.ndarray]:
+    out = []
+    shift = 0
+    for f in pack.fields:
+        v = (keys >> shift) & ((1 << f.bits) - 1)
+        out.append(v * f.stride + f.offset)
+        shift += f.bits
+    return out
+
+
+def _format_col(dtype: DataType, decoder: Tuple, vals: np.ndarray,
+                nulls: Optional[np.ndarray]) -> List[Any]:
+    """Device int64/f64 column -> host Python values matching the host
+    executors' state-table representation exactly."""
+    from .nexmark_gen import decode_column
+    if decoder not in (("num",), ("ts",)):
+        dec = decode_column(decoder, vals.astype(np.int64))
+        out = list(dec)
+    elif dtype.kind == TypeKind.DECIMAL:
+        out = [Decimal(int(v)) for v in vals]
+    elif dtype.kind in (TypeKind.FLOAT32, TypeKind.FLOAT64):
+        out = [float(v) for v in vals]
+    elif dtype.kind == TypeKind.BOOLEAN:
+        out = [bool(v) for v in vals]
+    else:
+        out = [int(v) for v in vals]
+    if nulls is not None:
+        out = [None if nulls[i] else out[i] for i in range(len(out))]
+    return out

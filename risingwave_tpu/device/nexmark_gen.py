@@ -1,0 +1,256 @@
+"""Device-side EXACT Nexmark generation — bit-identical to the host
+connector (`risingwave_tpu/connectors/nexmark.py`).
+
+The host generator is stateless per event id (every column is a pure
+function of the id via splitmix64), which makes it directly jittable: the
+fused SQL pipeline (`device/fused.py`) generates events IN HBM and never
+ships source chunks over the host link — the TPU-native reading of the
+reference's in-process datagen source (`src/connector/src/source/nexmark/
+source/reader.rs:42`), applied to the design rule "minimise host-device
+transfers".
+
+String columns become int64 SURROGATES on device (pool indices / raw
+randoms); `decode_column` reconstructs the exact host strings at pull
+time. Numeric columns are bit-identical to the host generator — verified
+by `tests/test_device_nexmark.py`.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..connectors.nexmark import (AUCTION_PROPORTION, FIRST_AUCTION_ID,
+                                  FIRST_CATEGORY_ID, FIRST_PERSON_ID,
+                                  HOT_AUCTION_RATIO, HOT_BIDDER_RATIO,
+                                  HOT_SELLER_RATIO, PERSON_PROPORTION,
+                                  TOTAL_PROPORTION, _CH_POOL, _CITY_POOL,
+                                  _EMAIL_POOL, _NAME_POOL, _STATE_POOL,
+                                  _URL_POOL, NexmarkConfig)
+
+_U = jnp.uint64
+
+
+def splitmix64(x):
+    """jnp twin of `connectors/datagen.splitmix64` (wrapping u64 ops)."""
+    x = x + _U(0x9E3779B97F4A7C15)
+    z = (x ^ (x >> _U(30))) * _U(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> _U(27))) * _U(0x94D049BB133111EB)
+    return z ^ (z >> _U(31))
+
+
+class GenCfg(NamedTuple):
+    """Hashable static twin of NexmarkConfig (jit static argument)."""
+    seed: int
+    base_time_usecs: int
+    inter_event_gap_usecs: int
+    auction_duration_events: int
+
+    @staticmethod
+    def from_config(cfg: NexmarkConfig) -> "GenCfg":
+        return GenCfg(cfg.seed, cfg.base_time_usecs,
+                      cfg.inter_event_gap_usecs, cfg.auction_duration_events)
+
+
+def _rand(cfg: GenCfg, ids, salt: int):
+    return splitmix64(ids.astype(jnp.uint64) + _U((cfg.seed << 20) + salt))
+
+
+def _mod(r, k: int):
+    return (r % _U(k)).astype(jnp.int64)
+
+
+def event_kinds(event_ids):
+    """0=person, 1=auction, 2=bid (host `_event_kinds`)."""
+    m = event_ids % TOTAL_PROPORTION
+    return jnp.where(m == 0, 0, jnp.where(m <= AUCTION_PROPORTION, 1, 2))
+
+
+def _person_count_before(event_ids):
+    full, rem = jnp.divmod(event_ids, TOTAL_PROPORTION)
+    return full * PERSON_PROPORTION + (rem > 0)
+
+
+def _auction_count_before(event_ids):
+    full, rem = jnp.divmod(event_ids, TOTAL_PROPORTION)
+    return full * AUCTION_PROPORTION + jnp.clip(rem - PERSON_PROPORTION, 0,
+                                                AUCTION_PROPORTION)
+
+
+def _timestamps(cfg: GenCfg, event_ids):
+    return (cfg.base_time_usecs
+            + event_ids * cfg.inter_event_gap_usecs).astype(jnp.int64)
+
+
+def _hot_pick(rand_hot, rand_pick, n_entities, hot_ratio: int, hot_mod: int):
+    """Shared hot-entity ordinal logic (host gen_auctions/gen_bids)."""
+    hot = _mod(rand_hot, hot_mod) != 0 if hot_mod == 10 \
+        else _mod(rand_hot, 100) < 90
+    span = jnp.maximum(n_entities // hot_ratio, 1)
+    ord_hot = n_entities - 1 - (rand_pick % span.astype(jnp.uint64)
+                                ).astype(jnp.int64)
+    ord_cold = (rand_pick % n_entities.astype(jnp.uint64)).astype(jnp.int64)
+    return jnp.where(hot, ord_hot, ord_cold)
+
+
+def gen_table(cfg: GenCfg, table: str, event_ids) -> Dict[str, jnp.ndarray]:
+    """All columns of `table` for these event ids, as int64 arrays.
+
+    Every event id gets a row regardless of its kind — callers mask rows
+    with `event_kinds(ids) == kind`. String columns are surrogates (see
+    SURROGATE) decoded host-side by `decode_column`.
+    """
+    ts = _timestamps(cfg, event_ids)
+    if table == "person":
+        ids = (FIRST_PERSON_ID + _person_count_before(event_ids)
+               ).astype(jnp.int64)
+        fi = _mod(_rand(cfg, ids, 1), len(_NAME_POOL) // 9)   # 11 firsts
+        li = _mod(_rand(cfg, ids, 2), 9)                      # 9 lasts
+        combo = fi * 9 + li
+        return {
+            "id": ids,
+            "name": combo,
+            "email_address": combo,
+            "credit_card": _mod(_rand(cfg, ids, 3), 10**16),
+            "city": _mod(_rand(cfg, ids, 4), len(_CITY_POOL)),
+            "state": _mod(_rand(cfg, ids, 5), len(_STATE_POOL)),
+            "date_time": ts,
+            "extra": jnp.zeros_like(ids),
+        }
+    if table == "auction":
+        ids = (FIRST_AUCTION_ID + _auction_count_before(event_ids)
+               ).astype(jnp.int64)
+        n_person = jnp.maximum(_person_count_before(event_ids), 1)
+        seller_ord = _hot_pick(_rand(cfg, ids, 10), _rand(cfg, ids, 11),
+                               n_person, HOT_SELLER_RATIO, hot_mod=10)
+        initial_bid = 100 + _mod(_rand(cfg, ids, 13), 1000)
+        return {
+            "id": ids,
+            "item_name": ids,                 # "item-{id}": derived from id
+            "description": _mod(_rand(cfg, ids, 15), 1000),
+            "initial_bid": initial_bid,
+            "reserve": initial_bid + _mod(_rand(cfg, ids, 14), 1000),
+            "date_time": ts,
+            "expires": ts + (cfg.auction_duration_events
+                             * cfg.inter_event_gap_usecs),
+            "seller": (FIRST_PERSON_ID + seller_ord).astype(jnp.int64),
+            "category": FIRST_CATEGORY_ID + _mod(_rand(cfg, ids, 12), 5),
+            "extra": jnp.zeros_like(ids),
+        }
+    if table == "bid":
+        n_auction = jnp.maximum(_auction_count_before(event_ids), 1)
+        n_person = jnp.maximum(_person_count_before(event_ids), 1)
+        auction_ord = _hot_pick(_rand(cfg, event_ids, 20),
+                                _rand(cfg, event_ids, 21),
+                                n_auction, HOT_AUCTION_RATIO, hot_mod=100)
+        bidder_ord = _hot_pick(_rand(cfg, event_ids, 22),
+                               _rand(cfg, event_ids, 23),
+                               n_person, HOT_BIDDER_RATIO, hot_mod=100)
+        ch = _mod(_rand(cfg, event_ids, 25), len(_CH_POOL))
+        return {
+            "auction": (FIRST_AUCTION_ID + auction_ord).astype(jnp.int64),
+            "bidder": (FIRST_PERSON_ID + bidder_ord).astype(jnp.int64),
+            "price": 100 + _mod(_rand(cfg, event_ids, 24), 10_000),
+            "channel": ch,
+            "url": ch,
+            "date_time": ts,
+            "extra": jnp.zeros_like(event_ids),
+        }
+    raise ValueError(f"unknown nexmark table {table!r}")
+
+
+_KIND = {"person": 0, "auction": 1, "bid": 2}
+
+
+def table_mask(table: str, event_ids):
+    return event_kinds(event_ids) == _KIND[table]
+
+
+# ---------------------------------------------------------------------------
+# surrogate metadata: how the host decodes device int64 columns
+# ---------------------------------------------------------------------------
+
+# column -> ("num",) exact int64 | ("ts",) timestamp usecs |
+#           ("pool", pool) index into object pool | ("zfill16",) |
+#           ("item_name",) "item-{v}" | ("desc",) "desc-{v}" | ("empty",)
+SURROGATE: Dict[str, Dict[str, Tuple]] = {
+    "person": {
+        "id": ("num",), "name": ("pool", _NAME_POOL),
+        "email_address": ("pool", _EMAIL_POOL), "credit_card": ("zfill16",),
+        "city": ("pool", _CITY_POOL), "state": ("pool", _STATE_POOL),
+        "date_time": ("ts",), "extra": ("empty",),
+    },
+    "auction": {
+        "id": ("num",), "item_name": ("item_name",), "description": ("desc",),
+        "initial_bid": ("num",), "reserve": ("num",), "date_time": ("ts",),
+        "expires": ("ts",), "seller": ("num",), "category": ("num",),
+        "extra": ("empty",),
+    },
+    "bid": {
+        "auction": ("num",), "bidder": ("num",), "price": ("num",),
+        "channel": ("pool", _CH_POOL), "url": ("pool", _URL_POOL),
+        "date_time": ("ts",), "extra": ("empty",),
+    },
+}
+
+
+def decode_column(spec: Tuple, vals: np.ndarray) -> np.ndarray:
+    """Surrogate int64s -> the exact host-generator column values."""
+    kind = spec[0]
+    if kind in ("num", "ts"):
+        return vals
+    if kind == "pool":
+        return spec[1][vals]
+    if kind == "zfill16":
+        return np.char.zfill(vals.astype("U16"), 16).astype(object)
+    if kind == "item_name":
+        return np.char.add("item-", vals.astype("U20")).astype(object)
+    if kind == "desc":
+        return np.char.add("desc-", vals.astype("U4")).astype(object)
+    if kind == "empty":
+        return np.full(len(vals), "", dtype=object)
+    raise ValueError(f"unknown surrogate spec {spec!r}")
+
+
+def column_bounds(cfg: GenCfg, table: str, col: str,
+                  max_events: Optional[int]) -> Tuple[int, int]:
+    """Inclusive (lo, hi) value bounds for a column given the event
+    horizon — the interval analysis the fused key packer builds on.
+    Unbounded sources assume a 2^40-event horizon (loud device-side
+    bounds checks still back this up)."""
+    n = max_events if max_events is not None else 1 << 40
+    ts_lo = cfg.base_time_usecs
+    ts_hi = cfg.base_time_usecs + n * cfg.inter_event_gap_usecs
+    n_person = n // TOTAL_PROPORTION * PERSON_PROPORTION + 2
+    n_auction = n // TOTAL_PROPORTION * AUCTION_PROPORTION + 4
+    b: Dict[Tuple[str, str], Tuple[int, int]] = {
+        ("person", "id"): (FIRST_PERSON_ID, FIRST_PERSON_ID + n_person),
+        ("person", "name"): (0, len(_NAME_POOL) - 1),
+        ("person", "email_address"): (0, len(_EMAIL_POOL) - 1),
+        ("person", "credit_card"): (0, 10**16),
+        ("person", "city"): (0, len(_CITY_POOL) - 1),
+        ("person", "state"): (0, len(_STATE_POOL) - 1),
+        ("person", "date_time"): (ts_lo, ts_hi),
+        ("person", "extra"): (0, 0),
+        ("auction", "id"): (FIRST_AUCTION_ID, FIRST_AUCTION_ID + n_auction),
+        ("auction", "item_name"): (FIRST_AUCTION_ID,
+                                   FIRST_AUCTION_ID + n_auction),
+        ("auction", "description"): (0, 999),
+        ("auction", "initial_bid"): (100, 1099),
+        ("auction", "reserve"): (100, 2198),
+        ("auction", "date_time"): (ts_lo, ts_hi),
+        ("auction", "expires"): (ts_lo, ts_hi + cfg.auction_duration_events
+                                 * cfg.inter_event_gap_usecs),
+        ("auction", "seller"): (FIRST_PERSON_ID, FIRST_PERSON_ID + n_person),
+        ("auction", "category"): (FIRST_CATEGORY_ID, FIRST_CATEGORY_ID + 4),
+        ("auction", "extra"): (0, 0),
+        ("bid", "auction"): (FIRST_AUCTION_ID, FIRST_AUCTION_ID + n_auction),
+        ("bid", "bidder"): (FIRST_PERSON_ID, FIRST_PERSON_ID + n_person),
+        ("bid", "price"): (100, 10_099),
+        ("bid", "channel"): (0, len(_CH_POOL) - 1),
+        ("bid", "url"): (0, len(_URL_POOL) - 1),
+        ("bid", "date_time"): (ts_lo, ts_hi),
+        ("bid", "extra"): (0, 0),
+    }
+    return b[(table, col)]

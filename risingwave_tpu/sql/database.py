@@ -95,6 +95,8 @@ class Database:
         self.injector = BarrierInjector(checkpoint_frequency)
         self.sinks: List[Tuple[str, Iterator[Message]]] = []   # job pumps
         self._iters: Dict[str, Iterator[Message]] = {}
+        # fused device jobs (whole-fragment epoch programs, device/fused.py)
+        self._fused: Dict[str, Any] = {}
         self.sink_results: Dict[str, List[Tuple]] = {}
         self.epoch_committed = 0
         self._nexmark_gen = None
@@ -266,9 +268,27 @@ class Database:
         obj.runtime = {"reader": reader if connector == "dml" else None,
                        "state_table": mv_table, "shared": shared,
                        "port": shared.subscribe()}
+        # Virtual source (fused device path): a nexmark source under a
+        # single-chip device policy does NOT start a host datagen job —
+        # fused MVs regenerate events on device. The host chain is built
+        # (for planning and as the fallback) but activates lazily, only if
+        # a non-fusable consumer appears (_activate_source). Matches the
+        # reference, where a SOURCE runs no dataflow until consumed
+        # (`create_source.rs` — sources are passive until subscribed).
+        obj.runtime["virtual"] = (stmt.is_source and connector == "nexmark"
+                                  and self.device is not None
+                                  and self.device.mesh is None)
         self.catalog.create(obj)
-        self._iters[stmt.name] = obj.runtime["port"].execute()
+        if not obj.runtime["virtual"]:
+            self._iters[stmt.name] = obj.runtime["port"].execute()
         return f"CREATE_{'SOURCE' if stmt.is_source else 'TABLE'}"
+
+    def _activate_source(self, name: str) -> None:
+        obj = self.catalog.get(name)
+        rt = obj.runtime or {}
+        if rt.get("virtual"):
+            rt["virtual"] = False
+            self._iters[name] = rt["port"].execute()
 
     def _make_reader(self, connector: str, stmt: A.CreateTable,
                      schema: Schema):
@@ -339,6 +359,33 @@ class Database:
         pk = list(ns.stream_key)
         tid = self.catalog.alloc_table_id()
         mv_table = StateTable(self.store, tid, schema.dtypes, pk)
+        # whole-fragment fusion (device/fuse_planner.py): an eligible plan
+        # over replayable sources becomes ONE jitted epoch program with
+        # device-resident state; the per-operator host DAG is dropped
+        if self.device is not None:
+            from ..device.fuse_planner import try_fuse
+            job = try_fuse(execu, ns, self.device, stmt.name,
+                           mv_state_table=mv_table,
+                           make_state=self._make_state)
+            if job is not None:
+                for shared, port in self._pending_subs:
+                    shared.unsubscribe(port)
+                self._pending_subs = []
+                obj = CatalogObject(stmt.name, "mv", schema, pk, tid)
+                obj.n_visible = ns.n_visible
+                obj.runtime = {"state_table": mv_table, "shared": None,
+                               "port": None, "reader": None,
+                               "upstream_subs": [], "fused_job": job}
+                self.catalog.create(obj)
+                self._fused[stmt.name] = job
+                job.recover()      # no-op unless the store has a committed
+                return "CREATE_MATERIALIZED_VIEW"     # event counter
+            # fallback: the plan stayed on the host/per-operator path, so
+            # any virtual (never-started) sources it reads must activate
+            for sname in _source_names(stmt.query):
+                o = self.catalog.objects.get(sname)
+                if o is not None and (o.runtime or {}).get("virtual"):
+                    self._activate_source(sname)
         # operator change streams are exact (retractions carry full rows,
         # updates arrive as U-/U+ pairs on the stream key), so the MV needs
         # no conflict scan — NoCheck, like the reference's StreamMaterialize
@@ -454,6 +501,10 @@ class Database:
         obj = self.catalog.get(stmt.name)
         if obj.kind != "mv":
             raise ValueError(f"{stmt.name!r} is not a materialized view")
+        if (obj.runtime or {}).get("fused_job") is not None:
+            raise ValueError(
+                f"{stmt.name!r} runs as a fused single-chip device job; "
+                "create the database with a device mesh to shard it")
         n = stmt.parallelism
         if n < 1:
             raise ValueError("PARALLELISM must be >= 1")
@@ -545,6 +596,7 @@ class Database:
                 return "DROP_SKIPPED"
             raise
         self._iters.pop(stmt.name, None)
+        self._fused.pop(stmt.name, None)
         # release upstream taps, or their buffers grow forever
         for shared, port in (obj.runtime or {}).get("upstream_subs", []):
             shared.unsubscribe(port)
@@ -658,6 +710,10 @@ class Database:
         from ..utils.metrics import REGISTRY
         t0 = _time.perf_counter()
         b = self.injector.inject()
+        # fused device jobs first: their epoch dispatch is ASYNC (no device
+        # sync), so host executors below overlap with device compute
+        for job in self._fused.values():
+            job.on_barrier(b)
         for name, it in list(self._iters.items()):
             for msg in it:
                 if isinstance(msg, Barrier) and msg.epoch.curr == b.epoch.curr:
@@ -718,7 +774,11 @@ class Database:
                                      name=f"SysScan({name})")
                 return src, schema, list(range(len(schema)))
             obj = self.catalog.get(name)
-            rows = list(obj.runtime["state_table"].iter_all())
+            job = (obj.runtime or {}).get("fused_job")
+            if job is not None:
+                rows = job.mv_rows_now()   # sync + pull the CURRENT device MV
+            else:
+                rows = list(obj.runtime["state_table"].iter_all())
             chunks = []
             if rows:
                 chunks.append(StreamChunk.from_rows(
@@ -774,6 +834,29 @@ class Database:
         if q.limit is not None:
             out = out[: q.limit]
         return [r[:n_vis] for r in out]
+
+
+def _source_names(q: A.Select) -> List[str]:
+    """Every NamedTable under a Select's FROM tree (subqueries included)."""
+    out: List[str] = []
+
+    def walk_ref(r):
+        if isinstance(r, A.NamedTable):
+            out.append(r.name)
+        elif isinstance(r, A.SubqueryTable):
+            walk(r.query)
+        elif isinstance(r, A.WindowTable):
+            walk_ref(r.inner)
+        elif isinstance(r, A.Join):
+            walk_ref(r.left)
+            walk_ref(r.right)
+
+    def walk(s):
+        if s.from_ is not None:
+            walk_ref(s.from_)
+
+    walk(q)
+    return out
 
 
 def _const_dtype(v) -> DataType:

@@ -1,0 +1,70 @@
+"""Device Nexmark generator == host connector, bit for bit.
+
+The fused SQL pipeline's correctness story starts here: the oracle in
+bench.py replays the HOST generator, so the device generator must produce
+the identical stream (numeric columns exactly; strings via surrogate
+decode)."""
+import numpy as np
+import pytest
+
+from risingwave_tpu.connectors.nexmark import (BID_SCHEMA, AUCTION_SCHEMA,
+                                               PERSON_SCHEMA,
+                                               NexmarkConfig,
+                                               NexmarkGenerator)
+from risingwave_tpu.device.nexmark_gen import (GenCfg, SURROGATE,
+                                               column_bounds, decode_column,
+                                               gen_table, table_mask)
+
+N = 5_000
+SCHEMAS = {"person": PERSON_SCHEMA, "auction": AUCTION_SCHEMA,
+           "bid": BID_SCHEMA}
+
+
+@pytest.fixture(scope="module")
+def streams():
+    gen = NexmarkGenerator()
+    return gen, gen.gen_range(0, N)
+
+
+@pytest.mark.parametrize("table", ["person", "auction", "bid"])
+def test_device_matches_host(streams, table):
+    import jax.numpy as jnp
+    gen, host_chunks = streams
+    cfg = GenCfg.from_config(gen.cfg)
+    ids = jnp.arange(N, dtype=jnp.int64)
+    mask = np.asarray(table_mask(table, ids))
+    cols = gen_table(cfg, table, ids)
+    host = host_chunks[table]
+    schema = SCHEMAS[table]
+    for i, f in enumerate(schema.fields):
+        dev = np.asarray(cols[f.name])[mask]
+        want = host.columns[i].values
+        got = decode_column(SURROGATE[table][f.name], dev)
+        assert len(got) == len(want), f.name
+        if want.dtype == object:
+            assert all(a == b for a, b in zip(got, want)), f.name
+        else:
+            np.testing.assert_array_equal(got, want, err_msg=f.name)
+
+
+@pytest.mark.parametrize("table", ["person", "auction", "bid"])
+def test_column_bounds_hold(streams, table):
+    import jax.numpy as jnp
+    gen, _ = streams
+    cfg = GenCfg.from_config(gen.cfg)
+    ids = jnp.arange(N, dtype=jnp.int64)
+    mask = np.asarray(table_mask(table, ids))
+    cols = gen_table(cfg, table, ids)
+    for name, arr in cols.items():
+        lo, hi = column_bounds(cfg, table, name, max_events=N)
+        v = np.asarray(arr)[mask]
+        assert v.min() >= lo, (table, name, int(v.min()), lo)
+        assert v.max() <= hi, (table, name, int(v.max()), hi)
+
+
+def test_kind_proportions():
+    import jax.numpy as jnp
+    ids = jnp.arange(50_000, dtype=jnp.int64)
+    assert int(table_mask("person", ids).sum()) == 1_000
+    assert int(table_mask("auction", ids).sum()) == 3_000
+    assert int(table_mask("bid", ids).sum()) == 46_000
