@@ -32,6 +32,9 @@ class DeviceConfig:
     mesh: Optional[Any] = None
     capacity: int = 1024
     minmax: bool = True
+    # whole-fragment fusion (device/fuse_planner.py): eligible MV plans
+    # become one jitted epoch program. Off forces the per-operator path.
+    fuse: bool = True
 
 
 @dataclass
@@ -79,7 +82,7 @@ class NodeConfig:
         if dev is not None:
             mode = dev.pop("mode", "off")
             for k in dev:
-                if k not in ("capacity", "minmax"):
+                if k not in ("capacity", "minmax", "fuse"):
                     raise ValueError(f"unknown config key [device] {k!r}")
             base = resolve_device(
                 int(mode) if isinstance(mode, str) and mode.isdigit()

@@ -277,6 +277,7 @@ class Database:
         # (`create_source.rs` — sources are passive until subscribed).
         obj.runtime["virtual"] = (stmt.is_source and connector == "nexmark"
                                   and self.device is not None
+                                  and self.device.fuse
                                   and self.device.mesh is None)
         self.catalog.create(obj)
         if not obj.runtime["virtual"]:
@@ -362,7 +363,7 @@ class Database:
         # whole-fragment fusion (device/fuse_planner.py): an eligible plan
         # over replayable sources becomes ONE jitted epoch program with
         # device-resident state; the per-operator host DAG is dropped
-        if self.device is not None:
+        if self.device is not None and self.device.fuse:
             from ..device.fuse_planner import try_fuse
             job = try_fuse(execu, ns, self.device, stmt.name,
                            mv_state_table=mv_table,

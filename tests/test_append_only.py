@@ -5,7 +5,15 @@ the device agg then keeps min/max as a single extreme column (no multiset
 side state) — the `aggregate/agg_impl.rs` append-only min/max analog."""
 import pytest
 
+from risingwave_tpu.config import DeviceConfig
 from risingwave_tpu.sql import Database
+
+
+def _dev():
+    """Per-operator device path: these tests inspect DeviceHashAggExecutor
+    internals, which whole-fragment fusion replaces with one epoch program."""
+    return DeviceConfig(fuse=False)
+
 
 SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT, "
        "channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR) "
@@ -28,7 +36,7 @@ def _device_agg(db, mv):
 
 
 def test_source_agg_uses_append_only_spec():
-    db = Database(device="on")
+    db = Database(device=_dev())
     db.run(SRC)
     db.run("CREATE MATERIALIZED VIEW mv AS SELECT auction, max(price) AS m, "
            "min(price) AS mn FROM bid GROUP BY auction")
@@ -38,7 +46,7 @@ def test_source_agg_uses_append_only_spec():
 
 
 def test_append_only_survives_filter_project_window():
-    db = Database(device="on")
+    db = Database(device=_dev())
     db.run(SRC)
     db.run("CREATE MATERIALIZED VIEW mv AS SELECT window_start, max(price) "
            "AS m FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND) "
@@ -49,7 +57,7 @@ def test_append_only_survives_filter_project_window():
 
 def test_dml_table_agg_stays_retractable():
     """Tables accept DELETE/UPDATE, so min/max must keep the multiset."""
-    db = Database(device="on")
+    db = Database(device=_dev())
     db.run("CREATE TABLE t (k INT, v BIGINT)")
     db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, max(v) AS m "
            "FROM t GROUP BY k")
@@ -60,7 +68,7 @@ def test_dml_table_agg_stays_retractable():
 
 def test_agg_output_breaks_append_only():
     """An agg emits updates, so a second-level agg over it is retractable."""
-    db = Database(device="on")
+    db = Database(device=_dev())
     db.run(SRC)
     db.run("CREATE MATERIALIZED VIEW lvl1 AS SELECT auction, count(*) AS c "
            "FROM bid GROUP BY auction")
@@ -71,7 +79,7 @@ def test_agg_output_breaks_append_only():
 
 
 def test_append_only_parity_with_host_minmax():
-    host, dev = Database(device="off"), Database(device="on")
+    host, dev = Database(device="off"), Database(device=_dev())
     for db in (host, dev):
         db.run(SRC)
         db.run("CREATE MATERIALIZED VIEW mv AS SELECT auction, max(price) "
@@ -89,7 +97,7 @@ def test_pk_source_with_conflicts_stays_retractable():
     emit update pairs under OVERWRITE, so downstream aggs must NOT get the
     append-only specialization (review finding: append-only spec crashed
     on the U- rows)."""
-    db = Database(device="on")
+    db = Database(device=_dev())
     db.run("CREATE TABLE bid (auction BIGINT, bidder BIGINT, price BIGINT, "
            "channel VARCHAR, url VARCHAR, date_time TIMESTAMP, "
            "extra VARCHAR, PRIMARY KEY (auction)) "
@@ -108,7 +116,7 @@ def test_pk_source_with_conflicts_stays_retractable():
 def test_append_only_table_rejects_delete_update():
     """APPEND ONLY makes the plan property load-bearing: DML retractions
     must be rejected at the statement level (reference forbids them)."""
-    db = Database(device="on")
+    db = Database(device=_dev())
     db.run("CREATE TABLE t (k INT, v BIGINT) APPEND ONLY")
     db.run("CREATE MATERIALIZED VIEW mv AS SELECT k, max(v) AS m "
            "FROM t GROUP BY k")
@@ -122,7 +130,7 @@ def test_append_only_table_rejects_delete_update():
 
 def test_append_only_recovery(tmp_path):
     d = str(tmp_path)
-    db = Database(data_dir=d, device="on")
+    db = Database(data_dir=d, device=_dev())
     db.run(SRC.replace("nexmark.max.events='2000'",
                        "nexmark.max.events='1000'"))
     db.run("CREATE MATERIALIZED VIEW mv AS SELECT auction, max(price) AS m "
@@ -130,5 +138,5 @@ def test_append_only_recovery(tmp_path):
     db.run("FLUSH")
     before = sorted(db.query("SELECT * FROM mv"))
     assert len(before) > 0
-    db2 = Database(data_dir=d, device="on")
+    db2 = Database(data_dir=d, device=_dev())
     assert sorted(db2.query("SELECT * FROM mv")) == before
