@@ -90,6 +90,11 @@ def _devify(e: Expr) -> Expr:
     if isinstance(e, FunctionCall):
         e = FunctionCall(e.name, [_devify(a) for a in e.args],
                          e.return_type, e.sig)
+    if isinstance(e, InputRef):
+        # verbatim column refs are always device-safe here: variable-width
+        # columns ride as int64 surrogates, and _surrogate_safe forbids
+        # computing over them
+        return e
     if not e.supports_device():
         raise FuseReject(f"no device path for {e!r}")
     return e

@@ -4,8 +4,10 @@ from .materialize import BatchScan, ConflictBehavior, MaterializeExecutor
 from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Watermark
 from .simple import (ExpandExecutor, FilterExecutor, ProjectExecutor,
                      RowIdGenExecutor, UnionExecutor, ValuesExecutor)
-from .exchange import Channel, DispatchExecutor, MergeExecutor
-from .source import BarrierInjector, SourceExecutor, SourceReader
+from .exchange import (Channel, ChannelSource, DispatchExecutor,
+                       FragmentPump, MergeExecutor)
+from .source import (BarrierInjector, BarrierSource, SourceExecutor,
+                     SourceReader)
 from .agg import (HashAggExecutor, SimpleAggExecutor,
                   StatelessSimpleAggExecutor)
 from .device_agg import DeviceHashAggExecutor, device_agg_eligible
@@ -21,12 +23,14 @@ __all__ = [
     "ConflictBehavior", "MaterializeExecutor", "Barrier", "BarrierKind",
     "Message", "Mutation", "MutationKind", "Watermark", "ExpandExecutor",
     "FilterExecutor", "ProjectExecutor", "RowIdGenExecutor", "UnionExecutor",
-    "ValuesExecutor", "BarrierInjector", "SourceExecutor", "SourceReader",
+    "ValuesExecutor", "BarrierInjector", "BarrierSource",
+    "SourceExecutor", "SourceReader",
     "HashAggExecutor", "SimpleAggExecutor", "StatelessSimpleAggExecutor",
     "DeviceHashAggExecutor", "device_agg_eligible",
     "HashJoinExecutor", "JoinType", "AppendOnlyDedupExecutor", "TopNExecutor",
     "HopWindowExecutor", "OverWindowExecutor", "WindowFuncCall",
-    "WatermarkFilterExecutor", "Channel", "DispatchExecutor", "MergeExecutor",
+    "WatermarkFilterExecutor", "Channel", "ChannelSource",
+    "DispatchExecutor", "FragmentPump", "MergeExecutor",
     "ChangelogExecutor", "DynamicFilterExecutor", "NowExecutor",
     "SortExecutor",
 ]

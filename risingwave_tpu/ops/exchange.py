@@ -144,6 +144,51 @@ class DispatchExecutor:
         return None
 
 
+class ChannelSource(Executor):
+    """Fragment input boundary: reads one exchange channel; when empty,
+    drives the upstream dispatcher (`exchange/input.rs` LocalInput — the
+    pull side of a permit channel)."""
+
+    def __init__(self, chan: Channel, schema: Schema,
+                 pump: "DispatchExecutor"):
+        super().__init__(schema, "ChannelSource")
+        self.chan = chan
+        self.pump = pump
+        self.append_only = pump.input.append_only
+
+    def execute(self) -> Iterator[Message]:
+        while True:
+            msg = self.chan.recv()
+            if msg is None:
+                if self.pump.pump_until_barrier() is None:
+                    return
+                continue
+            yield msg
+            if isinstance(msg, Barrier) and msg.is_stop():
+                return
+
+
+class FragmentPump:
+    """Drives one executor chain into an exchange channel until its next
+    barrier — the per-fragment actor loop (`actor.rs:157`) flattened into
+    the cooperative single-thread runtime. Duck-typed like
+    DispatchExecutor for MergeExecutor's pump list."""
+
+    def __init__(self, execu: Executor, out: Channel):
+        self.execu = execu
+        self.out = out
+        self._iter: Optional[Iterator[Message]] = None
+
+    def pump_until_barrier(self) -> Optional[Barrier]:
+        if self._iter is None:
+            self._iter = self.execu.execute()
+        for msg in self._iter:
+            self.out.send(msg)
+            if isinstance(msg, Barrier):
+                return msg
+        return None
+
+
 class MergeExecutor(Executor):
     """Input side: merge N upstream channels with barrier alignment
     (`merge.rs:235,403-480`): chunks flow through freely; when one upstream

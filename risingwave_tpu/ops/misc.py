@@ -33,10 +33,17 @@ from .message import Barrier, Message, Watermark
 
 class ChangelogExecutor(UnaryExecutor):
     """Retractable stream -> append-only changelog (`changelog.rs`):
-    every input row becomes an INSERT carrying its original op code."""
+    every input row becomes an INSERT carrying its original op code.
 
-    def __init__(self, input: Executor):
-        fields = list(input.schema.fields) + [Field("op", T.INT32)]
+    With `with_row_id`, the schema additionally declares the hidden
+    `_changelog_row_id` column the reference exposes; a downstream
+    RowIdGenExecutor mints it (chunks leave here without it)."""
+
+    def __init__(self, input: Executor, op_name: str = "op",
+                 with_row_id: bool = False):
+        fields = list(input.schema.fields) + [Field(op_name, T.INT32)]
+        if with_row_id:
+            fields.append(Field("_changelog_row_id", T.SERIAL))
         super().__init__(input, Schema(fields), "Changelog")
         self.append_only = True
 

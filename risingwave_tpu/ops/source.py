@@ -82,6 +82,30 @@ class BarrierInjector:
         return any(q for q in self.queues)
 
 
+class BarrierSource(Executor):
+    """Chunk-less source: yields only the injector's barriers. Feeds
+    executors that are driven by barriers alone (Now, Values — the
+    reference's barrier-receiver registration,
+    `src/stream/src/task/barrier_manager.rs` for `now.rs`)."""
+
+    def __init__(self, injector: "BarrierInjector"):
+        super().__init__(Schema([]), "BarrierSource")
+        self.append_only = True
+        self.injector = injector
+        self.queue = injector.register()
+
+    def execute(self) -> Iterator[Message]:
+        while True:
+            if self.queue:
+                b = self.queue.popleft()
+                yield b.with_trace(self.name)
+                if b.is_stop():
+                    return
+            else:
+                # idle: tick (same deadlock-avoidance as SourceExecutor)
+                self.injector.inject()
+
+
 class SourceExecutor(Executor):
     def __init__(self, schema: Schema, reader: SourceReader,
                  injector: BarrierInjector,

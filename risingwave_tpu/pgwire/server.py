@@ -161,7 +161,7 @@ class _Conn:
             return False
         for stmt, text in pairs:
             with self.lock:
-                if isinstance(stmt, A.Select):
+                if isinstance(stmt, (A.Select, A.SetOp)):
                     rows = self.db._run_batch_select(stmt)
                     desc = getattr(self.db, "last_description", [])
                     if not suppress_desc:
@@ -177,6 +177,10 @@ class _Conn:
                         or (isinstance(stmt, A.SetVar) and stmt.system):
                     # per-statement text, like Database.run — logging the
                     # whole multi-statement string would replay extras
+                    if isinstance(stmt, A.CreateMaterializedView):
+                        k = int(self.db.session_vars.get(
+                            "streaming_parallelism") or 0)
+                        self.db._log_ddl(f"SET streaming_parallelism TO {k}")
                     self.db._log_ddl(text)
                 # statements that answer with data, not just a tag
                 if isinstance(stmt, A.Explain):
@@ -213,7 +217,7 @@ class _Conn:
         except Exception:  # noqa: BLE001 — surfaces at Execute
             self._send(b"n")
             return
-        if len(stmts) == 1 and isinstance(stmts[0], A.Select):
+        if len(stmts) == 1 and isinstance(stmts[0], (A.Select, A.SetOp)):
             with self.lock:
                 desc = self.db.describe_select(stmts[0])
             self._row_description(desc)

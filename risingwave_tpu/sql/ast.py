@@ -142,6 +142,15 @@ class Join(TableRef):
     alias: Optional[str] = None
 
 
+@dataclass
+class ChangelogTable(TableRef):
+    """WITH name AS changelog FROM obj (`ast/query.rs` CteInner::ChangeLog):
+    the upstream's retractable change stream as an append-only relation with
+    a `changelog_op` column."""
+    inner: str
+    alias: Optional[str] = None
+
+
 # ---------------------------------------------------------------------------
 # Statements
 # ---------------------------------------------------------------------------
@@ -164,6 +173,23 @@ class Select:
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+
+
+@dataclass
+class SetOp:
+    """UNION [ALL] (`ast/query.rs` SetExpr::SetOperation). `left`/`right`
+    are Select or nested SetOp. ORDER BY/LIMIT written after the last
+    branch belong to the whole set operation (hoisted by the parser)."""
+    op: str                    # 'union'
+    all: bool
+    left: Any
+    right: Any
+    order_by: List[Tuple[ExprNode, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+
+
+Query = Any                    # Select | SetOp
 
 
 @dataclass

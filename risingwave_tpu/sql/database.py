@@ -145,6 +145,7 @@ class Database:
         if not entries:
             return
         self._replaying = True
+        saved_vars = dict(self.session_vars)
         try:
             for seq, sql in entries:
                 self._ddl_seq = max(self._ddl_seq, seq + 1)
@@ -152,6 +153,9 @@ class Database:
                     self._execute(stmt)
         finally:
             self._replaying = False
+            # replayed SET pins (plan-shape determinism) must not leak into
+            # the fresh session
+            self.session_vars = saved_vars
 
     def _log_ddl(self, sql: str) -> None:
         if self._replaying:
@@ -173,6 +177,12 @@ class Database:
                                  A.CreateSink, A.DropObject,
                                  A.AlterParallelism)) \
                     or (isinstance(stmt, A.SetVar) and stmt.system):
+                if isinstance(stmt, A.CreateMaterializedView):
+                    # plan shape depends on this session var; pin it in the
+                    # log so replay replans with the same fragment count
+                    k = int(self.session_vars.get("streaming_parallelism")
+                            or 0)
+                    self._log_ddl(f"SET streaming_parallelism TO {k}")
                 self._log_ddl(text)
             out.append(result)
         return out
@@ -180,7 +190,7 @@ class Database:
     def query(self, sql: str) -> List[Tuple]:
         """Run a single SELECT and return rows."""
         stmts = parse_sql(sql)
-        assert len(stmts) == 1 and isinstance(stmts[0], A.Select)
+        assert len(stmts) == 1 and isinstance(stmts[0], (A.Select, A.SetOp))
         return self._run_batch_select(stmts[0])
 
     def _execute(self, stmt: Any) -> Any:
@@ -200,7 +210,7 @@ class Database:
             return self._update(stmt)
         if isinstance(stmt, A.Flush):
             return self.flush()
-        if isinstance(stmt, A.Select):
+        if isinstance(stmt, (A.Select, A.SetOp)):
             return self._run_batch_select(stmt)
         if isinstance(stmt, A.ShowObjects):
             kind = {"tables": "table", "sources": "source",
@@ -348,11 +358,35 @@ class Database:
         return StateTable(self.store, self.catalog.alloc_table_id(),
                           list(dtypes), list(pk))
 
+    def _watermark_of(self, name: str) -> Optional[int]:
+        obj = self.catalog.objects.get(name)
+        return getattr(obj, "watermark_col", None) if obj else None
+
+    def _barrier_source(self):
+        from ..ops import BarrierSource
+        return BarrierSource(self.injector)
+
+    def _make_planner(self, subscribe, inj: Optional[BarrierInjector] = None,
+                      **kw) -> Planner:
+        """Planner wired to this Database's NOW()/watermark context; `inj`
+        scopes barrier feeds to a one-shot batch injector."""
+        from ..ops import BarrierSource
+        bs = (lambda: BarrierSource(inj)) if inj is not None \
+            else self._barrier_source
+        return Planner(subscribe, barrier_source=bs,
+                       watermark_of=self._watermark_of, **kw)
+
     def _create_mv(self, stmt: A.CreateMaterializedView) -> str:
-        planner = Planner(self._subscribe, make_state=self._make_state,
-                          device=self.device)
+        planner = self._make_planner(self._subscribe,
+                                     make_state=self._make_state,
+                                     device=self.device)
+        # SET streaming_parallelism > 1 plans host HashAgg through the
+        # Dispatch/Merge exchange (0 = default single fragment); persisted
+        # per CREATE in the DDL log so recovery replans identically
+        planner.parallelism = max(
+            1, int(self.session_vars.get("streaming_parallelism") or 0))
         self._pending_subs = []
-        execu, ns = planner.plan_select(stmt.query)
+        execu, ns = planner.plan_query(stmt.query)
         schema = ns.schema()
         # MV pk = the derived stream key (hidden columns appended by the
         # planner when the select list drops them) — preserves duplicate-row
@@ -411,12 +445,13 @@ class Database:
         from .system_catalog import render_plan
         if isinstance(inner, A.CreateMaterializedView):
             q = inner.query
-        elif isinstance(inner, A.Select):
+        elif isinstance(inner, (A.Select, A.SetOp)):
             q = inner
         else:
             return repr(inner)
-        execu, _ns = Planner(self._peek_subscribe(),
-                             device=self.device).plan_select(q)
+        execu, _ns = self._make_planner(
+            self._peek_subscribe(), inj=BarrierInjector(),
+            device=self.device).plan_query(q)
         out = render_plan(execu)
         rules = getattr(q, "applied_rules", None)
         if rules:
@@ -446,14 +481,15 @@ class Database:
 
         return peek
 
-    def describe_select(self, q: A.Select):
+    def describe_select(self, q):
         """Row description of a SELECT without executing it (the pgwire
         Describe answer)."""
-        if q.from_ is None:
+        if isinstance(q, A.Select) and q.from_ is None:
             row = tuple(_eval_const(i.expr, None) for i in q.items)
             return [(it.alias or "?column?", _const_dtype(v))
                     for it, v in zip(q.items, row)]
-        _execu, ns = Planner(self._peek_subscribe()).plan_select(q)
+        _execu, ns = self._make_planner(
+            self._peek_subscribe(), inj=BarrierInjector()).plan_query(q)
         n_vis = ns.n_visible or len(ns.cols)
         return [(c.name, c.dtype) for c in ns.cols[:n_vis]]
 
@@ -541,9 +577,9 @@ class Database:
         if stmt.from_name is not None:
             execu, schema, _pk = self._subscribe(stmt.from_name)
         else:
-            execu, ns = Planner(self._subscribe,
-                                make_state=self._make_state,
-                                device=self.device).plan_select(stmt.query)
+            execu, ns = self._make_planner(
+                self._subscribe, make_state=self._make_state,
+                device=self.device).plan_query(stmt.query)
             schema = ns.schema()
         obj = CatalogObject(stmt.name, "sink", schema, [], 0,
                             with_options=stmt.with_options)
@@ -752,17 +788,7 @@ class Database:
     # ------------------------------------------------------------------
     # batch SELECT
     # ------------------------------------------------------------------
-    def _run_batch_select(self, q: A.Select) -> List[Tuple]:
-        # SELECT without FROM: evaluate constant expressions
-        if q.from_ is None:
-            row = tuple(_eval_const(i.expr, None) for i in q.items)
-            self.last_description = [
-                (it.alias or "?column?", _const_dtype(v))
-                for it, v in zip(q.items, row)]
-            return [row]
-        self.flush(1)
-        inj = BarrierInjector()
-
+    def _batch_subscribe(self, inj: BarrierInjector):
         def subscribe(name: str):
             from .system_catalog import SYSTEM_TABLES
             if name in SYSTEM_TABLES and name not in self.catalog.objects:
@@ -788,13 +814,64 @@ class Database:
                                  name=f"Scan({name})")
             return src, obj.schema, obj.pk
 
+        return subscribe
+
+    def _run_batch_setop(self, q: A.SetOp) -> List[Tuple]:
+        """One-shot UNION [ALL] over snapshots (stream-replay path)."""
+        self.flush(1)
+        inj = BarrierInjector()
+        # plan without the trailing order/limit; applied host-side below
+        plan_q = A.SetOp(q.op, q.all, q.left, q.right)
+        execu, ns = self._make_planner(self._batch_subscribe(inj),
+                                       inj=inj).plan_query(plan_q)
+        n_vis = ns.n_visible or len(ns.cols)
+        self.last_description = [(c.name, c.dtype)
+                                 for c in ns.cols[:n_vis]]
+        state: Dict[Tuple, int] = {}
+        it = execu.execute()
+        inj.inject()
+        inj.inject_stop()
+        for msg in it:
+            if isinstance(msg, StreamChunk):
+                for op, r in msg.compact().op_rows():
+                    state[r] = state.get(r, 0) + (1 if op.is_insert else -1)
+        out = [r for r, n in state.items() for _ in range(n)]
+        if q.order_by:
+            name_of = {c.name: i for i, c in
+                       reversed(list(enumerate(ns.cols[:n_vis])))}
+            for e, desc in reversed(q.order_by):
+                if not isinstance(e, A.Col) or e.name not in name_of:
+                    raise ValueError("ORDER BY after UNION must reference "
+                                     "output columns")
+                i = name_of[e.name]
+                out.sort(key=lambda r: _sort_key(r[i]), reverse=desc)
+        if q.offset:
+            out = out[q.offset:]
+        if q.limit is not None:
+            out = out[: q.limit]
+        return [r[:n_vis] for r in out]
+
+    def _run_batch_select(self, q) -> List[Tuple]:
+        # SELECT without FROM: evaluate constant expressions
+        if isinstance(q, A.SetOp):
+            return self._run_batch_setop(q)
+        if q.from_ is None:
+            row = tuple(_eval_const(i.expr, None) for i in q.items)
+            self.last_description = [
+                (it.alias or "?column?", _const_dtype(v))
+                for it, v in zip(q.items, row)]
+            return [row]
+        self.flush(1)
+        inj = BarrierInjector()
+        subscribe = self._batch_subscribe(inj)
         # plan without limit/order; ORDER BY columns ride along as hidden
         # trailing items (PG allows ordering by non-output expressions)
         items = list(q.items) + [A.SelectItem(e, f"__ord{i}")
                                  for i, (e, _) in enumerate(q.order_by)]
         plan_q = A.Select(items, q.from_, q.where, q.group_by, q.having,
                          [], None, None, q.distinct)
-        execu, ns = Planner(subscribe).plan_select(plan_q)
+        execu, ns = self._make_planner(subscribe,
+                                       inj=inj).plan_select(plan_q)
         # visible = user items (stars expanded) — minus hidden ORDER BY
         # helpers and planner-appended stream-key columns
         n_vis = (ns.n_visible or len(ns.cols)) - len(q.order_by)
@@ -846,6 +923,8 @@ def _source_names(q: A.Select) -> List[str]:
             out.append(r.name)
         elif isinstance(r, A.SubqueryTable):
             walk(r.query)
+        elif isinstance(r, A.ChangelogTable):
+            out.append(r.inner)
         elif isinstance(r, A.WindowTable):
             walk_ref(r.inner)
         elif isinstance(r, A.Join):
@@ -853,7 +932,10 @@ def _source_names(q: A.Select) -> List[str]:
             walk_ref(r.right)
 
     def walk(s):
-        if s.from_ is not None:
+        if isinstance(s, A.SetOp):
+            walk(s.left)
+            walk(s.right)
+        elif s.from_ is not None:
             walk_ref(s.from_)
 
     walk(q)
@@ -887,19 +969,8 @@ def _coerce(v, dtype: DataType):
 
 
 def _eval_const(e: A.ExprNode, dtype: Optional[DataType]):
-    from .planner import Binder, Namespace
-    b = Binder(Namespace([]))
-    expr = b.bind(e)
-    chunk = StreamChunk.from_rows([T.INT64], [(Op.INSERT, (0,))])
-    col = expr.eval(chunk)
-    v = col.get(0)
-    if dtype is not None and v is not None:
-        from ..expr import cast as _cast
-        from ..expr import Literal
-        lit = Literal(v, expr.return_type)
-        casted = _cast(lit, dtype)
-        v = casted.eval(chunk).get(0)
-    return v
+    from .planner import eval_const
+    return eval_const(e, dtype)
 
 
 def _extract_delay(bound, time_idx: int) -> int:

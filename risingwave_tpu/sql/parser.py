@@ -96,6 +96,9 @@ class Parser:
     def __init__(self, sql: str):
         self.toks = tokenize(sql)
         self.i = 0
+        # CTE scope for the query being parsed: name -> Select | SetOp |
+        # A.ChangelogTable; referenced names desugar in _table_factor
+        self._ctes: dict = {}
 
     # ---- token helpers --------------------------------------------------
     def peek(self, ahead: int = 0) -> Token:
@@ -150,7 +153,7 @@ class Parser:
         t = self.peek()
         if t.kind == "kw":
             if t.value == "select":
-                return self.parse_select()
+                return self.parse_query()
             if t.value == "create":
                 return self.parse_create()
             if t.value == "drop":
@@ -185,7 +188,7 @@ class Parser:
             if t.value == "alter":
                 return self.parse_alter()
             if t.value == "with":
-                raise ValueError("WITH (CTE) not supported yet")
+                return self.parse_query()
         raise ValueError(f"cannot parse statement at {t!r}")
 
     # ---- DDL ------------------------------------------------------------
@@ -244,7 +247,7 @@ class Parser:
             self.expect_kw("view")
             name = self.ident()
             self.expect_kw("as")
-            q = self.parse_select()
+            q = self.parse_query()
             self._accept_emit_clause(q)
             return A.CreateMaterializedView(name, q)
         if self.accept_kw("sink"):
@@ -254,7 +257,7 @@ class Parser:
                 from_name = self.ident()
             else:
                 self.expect_kw("as")
-                query = self.parse_select()
+                query = self.parse_query()
             opts = self._with_options()
             return A.CreateSink(name, from_name, query, opts)
         if self.accept_kw("index"):
@@ -383,7 +386,7 @@ class Parser:
                 if not self.accept("op", ","):
                     break
             return A.Insert(table, cols, rows)
-        q = self.parse_select()
+        q = self.parse_query()
         return A.Insert(table, cols, [], q)
 
     def parse_delete(self) -> A.Delete:
@@ -408,6 +411,51 @@ class Parser:
         return A.Update(table, assigns, where)
 
     # ---- SELECT ---------------------------------------------------------
+    def parse_query(self) -> A.Query:
+        """[WITH ctes] select [UNION [ALL] select]... — the `ast/query.rs`
+        Query/SetExpr surface. CTEs include the changelog form
+        (`WITH name AS changelog FROM obj`)."""
+        saved = self._ctes
+        if self.accept_kw("with"):
+            self._ctes = dict(saved)
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                if self.peek().kind == "id" \
+                        and self.peek().value == "changelog":
+                    self.next()
+                    self.expect_kw("from")
+                    obj = self.ident()
+                    while self.accept("op", "."):   # schema-qualified
+                        obj = self.ident()
+                    self._ctes[name] = A.ChangelogTable(obj, alias=name)
+                else:
+                    self.expect("op", "(")
+                    self._ctes[name] = self.parse_query()
+                    self.expect("op", ")")
+                if not self.accept("op", ","):
+                    break
+        try:
+            q: A.Query = self.parse_select()
+            while self.accept_kw("union"):
+                prev = q.right if isinstance(q, A.SetOp) else q
+                if prev.order_by or prev.limit is not None:
+                    raise ValueError("ORDER BY/LIMIT before UNION must be "
+                                     "parenthesized")
+                all_ = bool(self.accept_kw("all"))
+                if self.accept_kw("distinct"):
+                    all_ = False
+                q = A.SetOp("union", all_, q, self.parse_select())
+            if isinstance(q, A.SetOp):
+                # trailing ORDER BY/LIMIT bind to the whole set operation
+                last = q.right
+                q.order_by = last.order_by
+                q.limit, q.offset = last.limit, last.offset
+                last.order_by, last.limit, last.offset = [], None, None
+        finally:
+            self._ctes = saved
+        return q
+
     def parse_select(self) -> A.Select:
         self.expect_kw("select")
         distinct = bool(self.accept_kw("distinct"))
@@ -516,8 +564,9 @@ class Parser:
             alias = self._alias()
             return A.WindowTable(kind, inner, tc, args, alias)
         if self.accept("op", "("):
-            if self.peek().kind == "kw" and self.peek().value == "select":
-                q = self.parse_select()
+            if self.peek().kind == "kw" and self.peek().value in ("select",
+                                                                  "with"):
+                q = self.parse_query()
                 self.expect("op", ")")
                 return A.SubqueryTable(q, self._alias())
             t = self._table_expr()
@@ -527,7 +576,13 @@ class Parser:
                 t.alias = a
             return t
         name = self.ident()
-        return A.NamedTable(name, self._alias())
+        alias = self._alias()
+        cte = self._ctes.get(name)
+        if cte is not None:
+            if isinstance(cte, A.ChangelogTable):
+                return A.ChangelogTable(cte.inner, alias or name)
+            return A.SubqueryTable(cte, alias or name)
+        return A.NamedTable(name, alias)
 
     def _alias(self) -> Optional[str]:
         if self.accept_kw("as"):

@@ -68,6 +68,7 @@ class Namespace:
     # pk must cover it or duplicate rows collapse.
     stream_key: List[int] = field(default_factory=list)
     n_visible: Optional[int] = None    # hidden stream-key cols sit past this
+    watermark_idx: Optional[int] = None   # column carrying the watermark
 
     def resolve(self, name: str, table: Optional[str] = None) -> int:
         hits = [i for i, c in enumerate(self.cols)
@@ -249,7 +250,7 @@ class Planner:
     def __init__(self, subscribe: Callable[[str], Tuple[Executor, Schema]],
                  make_state: Optional[Callable[[Sequence[DataType],
                                                 Sequence[int]], Any]] = None,
-                 device=None):
+                 device=None, barrier_source=None, watermark_of=None):
         self.subscribe = subscribe
         # state-table factory: (dtypes, pk) -> StateTable | None. Called in
         # a DETERMINISTIC order per statement so table ids line up when the
@@ -260,6 +261,14 @@ class Planner:
         # lower onto DeviceHashAggExecutor. Must be stable across restarts
         # of the same data directory (state-table layouts differ).
         self.device = device
+        # () -> Executor yielding only barriers; required to plan NOW()
+        # (the `now.rs` barrier-receiver registration)
+        self.barrier_source = barrier_source
+        # name -> watermark column index | None (EOWC Sort planning)
+        self.watermark_of = watermark_of or (lambda name: None)
+        # host-path fragment parallelism (SET streaming_parallelism): >1
+        # plans HashAgg as Dispatch -> k agg fragments -> Merge
+        self.parallelism = 1
 
     def _make_hash_agg(self, input: Executor, group_indices: List[int],
                        calls: List[AggCall], gdtypes: List[DataType],
@@ -293,6 +302,31 @@ class Planner:
                                          mesh=self.device.mesh,
                                          capacity=self.device.capacity,
                                          append_only=ao)
+        if self.parallelism > 1 and group_indices and not eowc:
+            # Dispatch -> k parallel agg fragments -> Merge: the reference's
+            # hash-exchange topology (`dispatch.rs:777` HashDataDispatcher,
+            # `merge.rs:235` alignment) run inside one process. Group keys
+            # hash to disjoint vnode blocks, so each fragment owns its
+            # groups and the merged change stream equals the 1-fragment one.
+            from ..ops import (Channel, ChannelSource, DispatchExecutor,
+                               MergeExecutor)
+            from ..ops.exchange import FragmentPump
+            k = self.parallelism
+            in_ch = [Channel(capacity=4096) for _ in range(k)]
+            disp = DispatchExecutor(input, in_ch, kind="hash",
+                                    key_indices=list(group_indices))
+            out_ch = [Channel(capacity=4096) for _ in range(k)]
+            pumps = []
+            schema = None
+            for i in range(k):
+                st = self.make_state(gdtypes + [T.BYTEA],
+                                     list(range(len(group_indices))))
+                frag = HashAggExecutor(
+                    ChannelSource(in_ch[i], input.schema, disp),
+                    group_indices, calls, state_table=st)
+                schema = frag.schema
+                pumps.append(FragmentPump(frag, out_ch[i]))
+            return MergeExecutor(out_ch, schema, pumps=pumps)
         st = self.make_state(gdtypes + [T.BYTEA],
                              list(range(len(group_indices))))
         return HashAggExecutor(input, group_indices, calls, state_table=st,
@@ -303,14 +337,17 @@ class Planner:
     def _plan_table(self, ref: A.TableRef) -> Tuple[Executor, Namespace]:
         if isinstance(ref, A.NamedTable):
             execu, schema, pk = self.subscribe(ref.name)
-            return execu, Namespace.of_schema(schema, ref.alias or ref.name,
-                                              pk)
+            ns = Namespace.of_schema(schema, ref.alias or ref.name, pk)
+            ns.watermark_idx = self.watermark_of(ref.name)
+            return execu, ns
         if isinstance(ref, A.SubqueryTable):
-            execu, ns = self.plan_select(ref.query)
+            execu, ns = self.plan_query(ref.query)
             alias = ref.alias
             return execu, Namespace(
                 [ColumnEntry(alias, c.name, c.dtype) for c in ns.cols],
                 list(ns.stream_key))
+        if isinstance(ref, A.ChangelogTable):
+            return self._plan_changelog(ref)
         if isinstance(ref, A.WindowTable):
             execu, ns = self._plan_table(ref.inner)
             ti = ns.resolve(ref.time_col)
@@ -331,10 +368,28 @@ class Planner:
                      ColumnEntry(alias, "window_end", T.TIMESTAMP)]
             # each input row appears once per window: key = input key + win
             sk = list(ns.stream_key) + [len(cols) - 2]
-            return execu, Namespace(cols, sk)
+            out = Namespace(cols, sk)
+            out.watermark_idx = ns.watermark_idx
+            return execu, out
         if isinstance(ref, A.Join):
             return self._plan_join(ref)
         raise ValueError(f"cannot plan table ref {ref!r}")
+
+    def _plan_changelog(self, ref: A.ChangelogTable
+                        ) -> Tuple[Executor, Namespace]:
+        """WITH x AS changelog FROM t (`changelog.rs` + the frontend's
+        CteInner::ChangeLog lowering): upstream change stream ->
+        append-only rows + `changelog_op` + hidden `_changelog_row_id`."""
+        from ..ops import ChangelogExecutor, RowIdGenExecutor
+        execu, schema, _pk = self.subscribe(ref.inner)
+        chg = ChangelogExecutor(execu, op_name="changelog_op",
+                                with_row_id=True)
+        rid = len(chg.schema.fields) - 1
+        execu = RowIdGenExecutor(chg, row_id_index=rid)
+        alias = ref.alias or ref.inner
+        cols = [ColumnEntry(alias, f.name, f.dtype)
+                for f in chg.schema.fields]
+        return execu, Namespace(cols, [rid])
 
     def _plan_join(self, ref: A.Join) -> Tuple[Executor, Namespace]:
         lexec, lns = self._plan_table(ref.left)
@@ -386,6 +441,128 @@ class Planner:
         return execu, ns
 
     # ---- SELECT ---------------------------------------------------------
+    def plan_query(self, q: A.Query) -> Tuple[Executor, Namespace]:
+        if isinstance(q, A.SetOp):
+            return self._plan_setop(q)
+        return self.plan_select(q)
+
+    def _plan_setop(self, q: A.SetOp) -> Tuple[Executor, Namespace]:
+        """UNION [ALL] -> UnionExecutor (`union.rs`). Branch rows stay
+        distinguishable via a hidden `_branch` discriminator appended to
+        the stream key (the reference StreamUnion's hidden source column);
+        UNION distinct dedups with a group-only HashAgg over the visible
+        columns, like the reference's UNION -> Union + Agg rewrite."""
+        from ..ops import UnionExecutor
+        if getattr(q, "emit_on_window_close", False):
+            raise ValueError("EMIT ON WINDOW CLOSE is not supported on "
+                             "UNION queries")
+        branches: List[Tuple[Executor, Namespace]] = []
+        for part in (q.left, q.right):
+            if isinstance(part, A.Select) and part.from_ is None:
+                branches.append(self._plan_values(part))
+            else:
+                branches.append(self.plan_query(part))
+        l_ns = branches[0][1]
+        lv = l_ns.n_visible if l_ns.n_visible is not None else len(l_ns.cols)
+        for _, ns in branches[1:]:
+            v = ns.n_visible if ns.n_visible is not None else len(ns.cols)
+            if v != lv:
+                raise ValueError("each UNION query must have the same "
+                                 "number of columns")
+            for i in range(lv):
+                if ns.cols[i].dtype != l_ns.cols[i].dtype:
+                    raise ValueError(
+                        f"UNION types {l_ns.cols[i].dtype} and "
+                        f"{ns.cols[i].dtype} cannot be matched (column "
+                        f"{l_ns.cols[i].name!r})")
+        if not q.all:
+            # visible columns only; the dedup agg restores set semantics
+            parts = []
+            for execu, ns in branches:
+                exprs = [InputRef(i, ns.cols[i].dtype) for i in range(lv)]
+                parts.append(ProjectExecutor(
+                    execu, exprs, [c.name for c in ns.cols[:lv]]))
+            union: Executor = UnionExecutor(parts)
+            dts = [c.dtype for c in l_ns.cols[:lv]]
+            union = self._make_hash_agg(union, list(range(lv)), [], dts)
+            out = Namespace([ColumnEntry(None, c.name, c.dtype)
+                             for c in l_ns.cols[:lv]], list(range(lv)), lv)
+            return self._setop_limit(q, union, out)
+        # UNION ALL: carry each branch's stream key + a branch literal; the
+        # key layouts must agree or output rows lose identity. Append-only
+        # branches whose key layout differs get a minted row-id identity
+        # (retraction-free, so fresh ids are safe).
+        sk_dtypes = [[ns.cols[i].dtype for i in ns.stream_key]
+                     for _, ns in branches]
+        if any(d != sk_dtypes[0] for d in sk_dtypes[1:]):
+            from ..ops import RowIdGenExecutor
+            target = next((d for (e, _), d in zip(branches, sk_dtypes)
+                           if not e.append_only), [T.INT64])
+            for bi, ((execu, ns), skd) in enumerate(zip(list(branches),
+                                                        sk_dtypes)):
+                if skd == target or not execu.append_only:
+                    continue
+                if len(target) != 1 or target[0] not in (T.INT64, T.SERIAL):
+                    break
+                idx = len(ns.cols)
+                execu = RowIdGenExecutor(execu, row_id_index=idx)
+                ns = Namespace(ns.cols + [ColumnEntry(None, "_uid",
+                                                      target[0])],
+                               [idx], ns.n_visible)
+                branches[bi] = (execu, ns)
+                sk_dtypes[bi] = target
+        if any(d != sk_dtypes[0] for d in sk_dtypes[1:]):
+            raise ValueError("UNION ALL branches derive incompatible "
+                             "stream keys; add DISTINCT or align the "
+                             "branch row identities")
+        parts = []
+        for bi, (execu, ns) in enumerate(branches):
+            exprs = [InputRef(i, ns.cols[i].dtype) for i in range(lv)]
+            names = [c.name for c in ns.cols[:lv]]
+            for ki, si in enumerate(ns.stream_key):
+                exprs.append(InputRef(si, ns.cols[si].dtype))
+                names.append(f"_sk{ki}")
+            exprs.append(Literal(bi, T.INT32))
+            names.append("_branch")
+            parts.append(ProjectExecutor(execu, exprs, names))
+        union = UnionExecutor(parts)
+        cols = [ColumnEntry(None, c.name, c.dtype) for c in l_ns.cols[:lv]]
+        nsk = len(sk_dtypes[0])
+        cols += [ColumnEntry(None, f"_sk{k}", d)
+                 for k, d in enumerate(sk_dtypes[0])]
+        cols.append(ColumnEntry(None, "_branch", T.INT32))
+        out = Namespace(cols, list(range(lv, lv + nsk + 1)), lv)
+        return self._setop_limit(q, union, out)
+
+    def _setop_limit(self, q: A.SetOp, execu: Executor, ns: Namespace
+                     ) -> Tuple[Executor, Namespace]:
+        if getattr(q, "limit", None) is None:
+            return execu, ns
+        order = [(ns.resolve(_order_name(e, ns)), d)
+                 for e, d in q.order_by] if q.order_by else []
+        st = self.make_state([c.dtype for c in ns.cols],
+                             list(range(len(ns.cols))))
+        return TopNExecutor(execu, order, q.limit, q.offset or 0,
+                            state_table=st), ns
+
+    def _plan_values(self, q: A.Select) -> Tuple[Executor, Namespace]:
+        """Constant SELECT (no FROM) inside a set operation -> a one-shot
+        Values source (`values.rs`)."""
+        if self.barrier_source is None:
+            raise ValueError("SELECT without FROM is a batch-only statement")
+        from ..core.schema import Field, Schema
+        from ..ops import ValuesExecutor
+        row, fields = [], []
+        for it in q.items:
+            dt = const_expr_type(it.expr)
+            row.append(eval_const(it.expr, dt))
+            fields.append(Field(it.alias or _default_name(it.expr), dt))
+        schema = Schema(fields)
+        execu = ValuesExecutor(schema, [tuple(row)], self.barrier_source())
+        ns = Namespace([ColumnEntry(None, f.name, f.dtype) for f in fields],
+                       [], len(fields))
+        return execu, ns
+
     def plan_select(self, q: A.Select) -> Tuple[Executor, Namespace]:
         # logical rewrites (sql/optimizer.py) run once per tree; subquery
         # recursion below sees already-optimized nodes
@@ -397,7 +574,17 @@ class Planner:
         execu, ns = self._plan_table(q.from_)
 
         if q.where is not None:
-            execu = FilterExecutor(execu, Binder(ns).bind(q.where))
+            plain: List[A.ExprNode] = []
+            for conj in _split_and(q.where):
+                if _contains_now(conj):
+                    execu = self._plan_now_filter(execu, ns, conj)
+                else:
+                    plain.append(conj)
+            if plain:
+                node = plain[0]
+                for c in plain[1:]:
+                    node = A.BinOp("and", node, c)
+                execu = FilterExecutor(execu, Binder(ns).bind(node))
 
         # expand stars (hidden system/stream-key columns stay hidden,
         # like PG's ctid)
@@ -434,6 +621,7 @@ class Planner:
         exprs = [b.bind(i.expr) for i in items]
         names = [i.alias or _default_name(i.expr) for i in items]
         n_visible = len(items)
+        ns_watermark_idx = ns.watermark_idx
         out_sk: List[int] = []
         if q.distinct:
             out_sk = list(range(n_visible))   # output is set-like
@@ -453,9 +641,36 @@ class Planner:
                        out_sk, n_visible)
 
         if q.distinct:
-            execu = self._make_hash_agg(execu, list(range(len(ns.cols))), [],
-                                        [c.dtype for c in ns.cols])
+            if execu.append_only:
+                # insert-only input: dedup needs no counts, only a seen-set
+                # (`dedup/append_only_dedup.rs`)
+                from ..ops import AppendOnlyDedupExecutor
+                dts = [c.dtype for c in ns.cols]
+                st = self.make_state(dts, list(range(len(dts))))
+                execu = AppendOnlyDedupExecutor(
+                    execu, list(range(len(ns.cols))), state_table=st)
+            else:
+                execu = self._make_hash_agg(execu,
+                                            list(range(len(ns.cols))), [],
+                                            [c.dtype for c in ns.cols])
             # schema unchanged: group keys only
+
+        if getattr(q, "emit_on_window_close", False) and not has_aggs:
+            # EOWC without aggregation: emit rows in event-time order once
+            # the watermark passes (`sort.rs`); requires the watermark
+            # column in the output
+            tc = next((j for j, e in enumerate(exprs)
+                       if isinstance(e, InputRef)
+                       and e.index == ns_watermark_idx), None) \
+                if ns_watermark_idx is not None else None
+            if tc is None:
+                raise ValueError(
+                    "EMIT ON WINDOW CLOSE requires a watermarked time "
+                    "column in the select list")
+            from ..ops import SortExecutor
+            st = self.make_state([c.dtype for c in ns.cols],
+                                 list(ns.stream_key))
+            execu = SortExecutor(execu, tc, state_table=st)
 
         if q.limit is not None:
             order = [(ns.resolve(_order_name(e, ns)), d)
@@ -465,6 +680,36 @@ class Planner:
             execu = TopNExecutor(execu, order, q.limit, q.offset or 0,
                                  state_table=st)
         return execu, ns
+
+    def _plan_now_filter(self, execu: Executor, ns: Namespace,
+                         conj: A.ExprNode) -> Executor:
+        """`col <cmp> f(now())` -> Now + DynamicFilter (`now.rs`,
+        `dynamic_filter.rs`): the bound is a one-row stream advancing with
+        the barrier clock; rows enter/leave the output as it moves."""
+        from ..ops import DynamicFilterExecutor, NowExecutor
+        if self.barrier_source is None:
+            raise ValueError("NOW() requires a streaming context")
+        if not (isinstance(conj, A.BinOp) and conj.op in (">", ">=", "<",
+                                                          "<=")):
+            raise ValueError("NOW() is only supported in temporal filter "
+                             "comparisons (col > NOW() - interval)")
+        flip = {">": "<", ">=": "<=", "<": ">", "<=": ">="}
+        lhs, rhs, cmp = conj.left, conj.right, conj.op
+        if _contains_now(lhs):
+            lhs, rhs, cmp = rhs, lhs, flip[cmp]
+        if not isinstance(lhs, A.Col) or _contains_now(lhs):
+            raise ValueError("the non-NOW() side of a temporal filter must "
+                             "be a plain column")
+        key_col = ns.resolve(lhs.name, lhs.table)
+        now_st = self.make_state([T.TIMESTAMP], [0])
+        now_src = NowExecutor(self.barrier_source(), state_table=now_st)
+        now_ns = Namespace([ColumnEntry(None, "now", T.TIMESTAMP)], [0])
+        bound = Binder(now_ns).bind(_rewrite_now(rhs))
+        rhs_exec = ProjectExecutor(now_src, [bound], ["bound"])
+        dts = [c.dtype for c in ns.cols]
+        df_st = self.make_state(dts + [T.INT64], list(range(len(dts))))
+        return DynamicFilterExecutor(execu, rhs_exec, key_col, cmp,
+                                     state_table=df_st)
 
     def _plan_agg(self, execu: Executor, ns: Namespace, q: A.Select,
                   items: List[A.SelectItem]
@@ -581,6 +826,38 @@ class Planner:
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
+
+
+def eval_const(e: A.ExprNode, dtype: Optional[DataType] = None):
+    """Evaluate a constant expression (no column refs) to a Python value."""
+    from ..core.chunk import Op, StreamChunk
+    b = Binder(Namespace([]))
+    expr = b.bind(e)
+    chunk = StreamChunk.from_rows([T.INT64], [(Op.INSERT, (0,))])
+    col = expr.eval(chunk)
+    v = col.get(0)
+    if dtype is not None and v is not None:
+        from ..expr import cast as _cast
+        lit = Literal(v, expr.return_type)
+        v = _cast(lit, dtype).eval(chunk).get(0)
+    return v
+
+
+def const_expr_type(e: A.ExprNode) -> DataType:
+    return Binder(Namespace([])).bind(e).return_type
+
+
+def _contains_now(node: A.ExprNode) -> bool:
+    if isinstance(node, A.FuncCall) and node.name == "now" and not node.args:
+        return True
+    return any(_contains_now(c) for c in _children(node))
+
+
+def _rewrite_now(node: A.ExprNode) -> A.ExprNode:
+    """now() -> the Now stream's single column."""
+    if isinstance(node, A.FuncCall) and node.name == "now" and not node.args:
+        return A.Col("now")
+    return _clone_with(node, _rewrite_now)
 
 
 def _split_and(node: Optional[A.ExprNode]) -> List[A.ExprNode]:
