@@ -274,7 +274,7 @@ class _Fuser:
             else:
                 decs.append(NUM)
             rngs.append(_range_of(de, m.ranges))
-        idx = self.add(MapNode(m.idx, dexprs, dts, decs, rngs))
+        idx = self.add(MapNode(m.idx, dexprs))
         return Meta(idx, dts, decs, rngs, m.rows_bound, m.append_only,
                     is_pair=m.is_pair)
 
@@ -337,7 +337,7 @@ class _Fuser:
             if pk_pack is None:
                 raise FuseReject("agg change-row identity not packable")
         node = AggNode(m.idx, gidx, calls, pack, spec, self.capacity,
-                       out_dec, out_dt, out_rng, pk_pack)
+                       pk_pack)
         idx = self.add(node)
         return Meta(idx, out_dt, out_dec, out_rng,
                     rows_bound=2 * m.rows_bound, append_only=False,
@@ -381,7 +381,6 @@ class _Fuser:
                               else jnp.int64 for d in dts]
         node = JoinNode(lm.idx, rm.idx, lkeys, rkeys, pack, dcond,
                         self.capacity, 4 * self.capacity,
-                        out_dec, out_dt, out_rng,
                         to_dev(lm.dtypes), to_dev(rm.dtypes))
         idx = self.add(node)
         rb = min(lm.rows_bound * rm.rows_bound, _HORIZON)
