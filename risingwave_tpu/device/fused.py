@@ -221,6 +221,14 @@ class Node:
     def _sig(self) -> Tuple:
         return (id(self),)            # default: no structural sharing
 
+    def _mut_sig(self) -> Tuple:
+        """Trace-shaping attributes that `grow` MUTATES (JoinNode.m).
+        jit static arguments must be immutable — jax's dispatch fast path
+        keys on object identity, so a mutated node would silently reuse
+        the executable traced with the OLD value (the r03 q5 growth bug).
+        These ride as a separate static argument that changes value."""
+        return ()
+
     def __hash__(self):
         return hash((type(self).__name__,) + self._sig())
 
@@ -233,10 +241,11 @@ def _node_step(node: Node, epoch_events: int, state, ins, extra):
     global _JIT_STEP
     if _JIT_STEP is None:
         _JIT_STEP = jax.jit(
-            lambda state, ins, extra, *, node, epoch_events:
+            lambda state, ins, extra, *, node, epoch_events, salt:
             node.apply(state, ins, extra, epoch_events),
-            static_argnames=("node", "epoch_events"))
-    return _JIT_STEP(state, ins, extra, node=node, epoch_events=epoch_events)
+            static_argnames=("node", "epoch_events", "salt"))
+    return _JIT_STEP(state, ins, extra, node=node, epoch_events=epoch_events,
+                     salt=node._mut_sig())
 
 
 _JIT_STEP = None
@@ -507,9 +516,11 @@ class JoinNode(Node):
     def _sig(self):
         return (tuple(self.l_keys), tuple(self.r_keys), self.pack,
                 _expr_sig(self.cond) if self.cond is not None else None,
-                self.m,
                 tuple(str(d) for d in self.l_val_dtypes),
                 tuple(str(d) for d in self.r_val_dtypes))
+
+    def _mut_sig(self):
+        return (self.m,)              # grow() mutates the pair capacity
 
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp

@@ -114,3 +114,35 @@ def test_unfusable_plan_falls_back_and_activates_source():
         "CREATE MATERIALIZED VIEW q4a AS SELECT auction, avg(bidder) "
         "AS b FROM bid GROUP BY auction", "q4a", 2000))
     assert got == want
+
+
+def test_join_pair_capacity_growth_replay():
+    """Regression (r03): JoinNode.grow mutates the pair capacity `m`, a
+    jit-static trace parameter — jax's dispatch fast path keys static
+    arguments by object identity, so without the _mut_sig salt the grown
+    join silently reused the executable traced with the old m and dropped
+    pairs. Tiny capacities force the full grow->replay cascade."""
+    q7ish = ("CREATE MATERIALIZED VIEW j AS "
+             "SELECT AB.auction, AB.num FROM ("
+             "  SELECT bid.auction, count(*) AS num, window_start AS ws"
+             "  FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)"
+             "  GROUP BY window_start, bid.auction) AB JOIN ("
+             "  SELECT max(CB.num) AS maxn, CB.ws AS wsc FROM ("
+             "    SELECT count(*) AS num, window_start AS ws"
+             "    FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)"
+             "    GROUP BY bid.auction, window_start) CB GROUP BY CB.ws"
+             ") MB ON AB.ws = MB.wsc AND AB.num >= MB.maxn")
+    dev = Database(device=DeviceConfig(capacity=64))
+    dev.run(BID_SRC.format(n=N, c=CHUNK))
+    dev.run(q7ish)
+    job = dev._fused.get("j")
+    assert job is not None
+    m0 = next(n.m for n in job.program.nodes
+              if type(n).__name__ == "JoinNode")
+    drive(dev)
+    m1 = next(n.m for n in job.program.nodes
+              if type(n).__name__ == "JoinNode")
+    assert m1 > m0, "test must exercise pair-capacity growth"
+    got = sorted(dev.query("SELECT * FROM j"))
+    want = sorted(host_rows(BID_SRC.format(n=N, c=CHUNK), q7ish, "j"))
+    assert got == want and len(got) > 0
