@@ -566,6 +566,145 @@ def _split_part_host(ret, values, valids, n):
     return out, np.ones(n, dtype=np.bool_)
 
 
+_TO_CHAR_FIELDS = [
+    # (pattern, formatter) — longest first; numeric patterns are
+    # case-insensitive like Postgres (`to_char` datetime templates)
+    ("YYYY", lambda d: f"{d.year:04d}"),
+    ("HH24", lambda d: f"{d.hour:02d}"),
+    ("HH12", lambda d: f"{(d.hour % 12) or 12:02d}"),
+    ("MM", lambda d: f"{d.month:02d}"),
+    ("DD", lambda d: f"{d.day:02d}"),
+    ("HH", lambda d: f"{(d.hour % 12) or 12:02d}"),
+    ("MI", lambda d: f"{d.minute:02d}"),
+    ("SS", lambda d: f"{d.second:02d}"),
+    ("MS", lambda d: f"{d.microsecond // 1000:03d}"),
+    ("US", lambda d: f"{d.microsecond:06d}"),
+    ("AM", lambda d: "AM" if d.hour < 12 else "PM"),
+    ("PM", lambda d: "AM" if d.hour < 12 else "PM"),
+    ("am", lambda d: "am" if d.hour < 12 else "pm"),
+    ("pm", lambda d: "am" if d.hour < 12 else "pm"),
+]
+_TO_CHAR_CACHE: Dict[str, List] = {}
+
+
+def _to_char_compile(fmt: str):
+    prog = _TO_CHAR_CACHE.get(fmt)
+    if prog is None:
+        prog = []
+        i = 0
+        while i < len(fmt):
+            for pat, f in _TO_CHAR_FIELDS:
+                if fmt[i:i + len(pat)].upper() == pat.upper() \
+                        and (pat not in ("AM", "PM", "am", "pm")
+                             or fmt[i:i + 2] == pat):
+                    prog.append(f)
+                    i += len(pat)
+                    break
+            else:
+                prog.append(fmt[i])
+                i += 1
+        _TO_CHAR_CACHE[fmt] = prog
+    return prog
+
+
+def _to_char_host(ret, values, valids, n):
+    import datetime
+    ts, fmt = values
+    out = np.empty(n, dtype=object)
+    epoch = datetime.datetime(1970, 1, 1)
+    for i in range(n):
+        if fmt[i] is None:
+            out[i] = None
+            continue
+        d = epoch + datetime.timedelta(microseconds=int(ts[i]))
+        out[i] = "".join(p if isinstance(p, str) else p(d)
+                         for p in _to_char_compile(str(fmt[i])))
+    return out, np.ones(n, dtype=np.bool_)
+
+
+def _regexp_match_idx_host(ret, values, valids, n):
+    """regexp_match(s, pat)[k] — group k of the match (1-based, like the
+    PG array over capture groups); NULL when no match / group empty."""
+    import re
+    s, pat, idx = values
+    out = np.empty(n, dtype=object)
+    cache: Dict[str, Any] = {}
+    for i in range(n):
+        if s[i] is None or pat[i] is None:
+            out[i] = None
+            continue
+        p = str(pat[i])
+        rx = cache.get(p)
+        if rx is None:
+            rx = cache[p] = re.compile(p)
+        m = rx.search(str(s[i]))
+        k = int(idx[i])
+        out[i] = (m.group(k) if m is not None and 0 < k <= rx.groups
+                  else None)
+    valid = np.array([x is not None for x in out], dtype=np.bool_)
+    return out, valid
+
+
+# ---------------------------------------------------------------------------
+# UDFs (the reference's embedded-Python flavor, udf/python.rs): registered
+# by CREATE FUNCTION ... LANGUAGE python; host eval is a row loop over the
+# chunk. The registry is process-global (DDL-logged, so recovery
+# re-registers); CREATE OR REPLACE overwrites.
+# ---------------------------------------------------------------------------
+
+class UserFunc:
+    def __init__(self, name: str, fn: Callable, arg_types: List[DataType],
+                 return_type: DataType):
+        self.name = name
+        self.fn = fn
+        self.arg_types = arg_types
+        self.return_type = return_type
+
+
+UDF_REGISTRY: Dict[str, UserFunc] = {}
+
+
+def register_python_udf(name: str, body: str, arg_types: List[DataType],
+                        return_type: DataType, replace: bool = False) -> None:
+    if name.lower() in UDF_REGISTRY and not replace:
+        raise ValueError(f"function {name!r} already exists")
+    ns: Dict[str, Any] = {}
+    exec(body, ns)                      # noqa: S102 — user-supplied UDF body
+    fn = ns.get(name)
+    if not callable(fn):
+        fns = [v for v in ns.values() if callable(v)
+               and getattr(v, "__module__", None) is None]
+        if len(fns) == 1:
+            fn = fns[0]
+        else:
+            raise ValueError(
+                f"LANGUAGE python body must define a function {name!r}")
+    UDF_REGISTRY[name.lower()] = UserFunc(name, fn, arg_types, return_type)
+
+
+def _udf_host(udf: UserFunc):
+    def host(ret, values, valids, n):
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            args = [v[i] for v in values]
+            try:
+                out[i] = udf.fn(*args)
+            except Exception:       # noqa: BLE001 — UDF errors become NULL
+                out[i] = None       # (the reference's non-strict wrapper)
+        valid = np.array([x is not None for x in out], dtype=np.bool_)
+        if ret.np_dtype is not None and ret.np_dtype != np.dtype(object):
+            fixed = np.zeros(n, dtype=ret.np_dtype)
+            for i in range(n):
+                if valid[i]:
+                    try:
+                        fixed[i] = out[i]
+                    except (TypeError, ValueError, OverflowError):
+                        valid[i] = False   # uncoercible result -> NULL
+            return fixed, valid
+        return out, valid
+    return host
+
+
 # ---------------------------------------------------------------------------
 # Math (fixed-width, device-capable)
 # ---------------------------------------------------------------------------
@@ -743,6 +882,20 @@ def build_func(name: str, args: List[Expr]) -> Expr:
             import jax.numpy as jnp
             return jnp.power(vals[0].astype(jnp.float64), vals[1].astype(jnp.float64)), ok[0] & ok[1]
         return FunctionCall(name, args, T.FLOAT64, FuncSig(name, host, dev))
+    if name == "to_char":
+        return FunctionCall(name, args, T.VARCHAR,
+                            FuncSig(name, _to_char_host, None))
+    if name == "regexp_match_idx":
+        return FunctionCall(name, args, T.VARCHAR,
+                            FuncSig(name, _regexp_match_idx_host, None,
+                                    strict=False))
+    if name in UDF_REGISTRY:
+        udf = UDF_REGISTRY[name]
+        if len(args) != len(udf.arg_types):
+            raise ValueError(f"function {name} takes {len(udf.arg_types)} "
+                             f"arguments, got {len(args)}")
+        return FunctionCall(name, args, udf.return_type,
+                            FuncSig(name, _udf_host(udf), None))
     if name in ("greatest", "least"):
         op = "greater_than" if name == "greatest" else "less_than"
         expr = args[0]

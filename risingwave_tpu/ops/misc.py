@@ -113,7 +113,7 @@ class NowExecutor(Executor):
 
 
 _CMP = {">": operator.gt, ">=": operator.ge,
-        "<": operator.lt, "<=": operator.le}
+        "<": operator.lt, "<=": operator.le, "=": operator.eq}
 
 
 class DynamicFilterExecutor(Executor):

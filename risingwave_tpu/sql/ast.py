@@ -49,6 +49,7 @@ class FuncCall(ExprNode):
     args: List[ExprNode]
     distinct: bool = False
     over: Optional["WindowSpec"] = None
+    filter: Optional[ExprNode] = None   # FILTER (WHERE ...) on aggregates
 
 
 @dataclass
@@ -100,6 +101,21 @@ class Between(ExprNode):
 @dataclass
 class SubqueryExpr(ExprNode):
     query: "Select"
+
+
+@dataclass
+class InSubquery(ExprNode):
+    """operand [NOT] IN (SELECT ...) — plans as a semi/anti join."""
+    operand: ExprNode
+    query: "Select"
+    negated: bool
+
+
+@dataclass
+class Index(ExprNode):
+    """expr[i] — array subscript (regexp_match group access)."""
+    operand: ExprNode
+    index: int
 
 
 # ---------------------------------------------------------------------------
@@ -215,6 +231,18 @@ class CreateTable:
 class CreateMaterializedView:
     name: str
     query: Select
+
+
+@dataclass
+class CreateFunction:
+    """CREATE FUNCTION name(argtypes) RETURNS t LANGUAGE python AS $$..$$
+    (the reference's embedded-Python UDF, `src/expr/impl/src/udf/python.rs`)."""
+    name: str
+    arg_types: List[str]
+    return_type: str
+    language: str
+    body: str
+    or_replace: bool = False
 
 
 @dataclass

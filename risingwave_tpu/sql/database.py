@@ -106,6 +106,7 @@ class Database:
         # DDL log (catalog persistence): table id 0 holds (seq, sql) rows;
         # replayed on open so a restarted process rebuilds its dataflows
         # (the meta catalog + recovery analog, `worker.rs:664`)
+        self._functions: set = set()      # this session's UDF names
         self._ddl_log = StateTable(self.store, 0, [T.INT64, T.VARCHAR], [0])
         self._ddl_seq = 0
         self._replaying = False
@@ -175,7 +176,7 @@ class Database:
             result = self._execute(stmt)
             if isinstance(stmt, (A.CreateTable, A.CreateMaterializedView,
                                  A.CreateSink, A.DropObject,
-                                 A.AlterParallelism)) \
+                                 A.AlterParallelism, A.CreateFunction)) \
                     or (isinstance(stmt, A.SetVar) and stmt.system):
                 if isinstance(stmt, A.CreateMaterializedView):
                     # plan shape depends on this session var; pin it in the
@@ -198,6 +199,8 @@ class Database:
             return self._create_table(stmt)
         if isinstance(stmt, A.CreateMaterializedView):
             return self._create_mv(stmt)
+        if isinstance(stmt, A.CreateFunction):
+            return self._create_function(stmt)
         if isinstance(stmt, A.CreateSink):
             return self._create_sink(stmt)
         if isinstance(stmt, A.DropObject):
@@ -571,6 +574,27 @@ class Database:
             stack.extend(getattr(e, "inputs", ()))   # Union/Merge children
         obj.parallelism = n
         return f"ALTER_PARALLELISM_{rescaled}"
+
+    def _create_function(self, stmt: A.CreateFunction) -> str:
+        """CREATE FUNCTION ... LANGUAGE python (`udf/python.rs` analog):
+        the body executes in-process and registers a scalar function.
+        DDL-logged, so recovery re-registers it before dependent MVs
+        replay."""
+        if stmt.language.lower() != "python":
+            raise ValueError(f"LANGUAGE {stmt.language} not supported "
+                             "(python only)")
+        if stmt.name.lower() in self._functions and not stmt.or_replace \
+                and not self._replaying:
+            raise ValueError(f"function {stmt.name!r} already exists")
+        from ..expr.functions import register_python_udf
+        # the registry is process-global (build_func has no session scope);
+        # duplicate detection is per-Database, last registration wins
+        register_python_udf(
+            stmt.name, stmt.body,
+            [type_from_name(t) for t in stmt.arg_types],
+            type_from_name(stmt.return_type), replace=True)
+        self._functions.add(stmt.name.lower())
+        return "CREATE_FUNCTION"
 
     def _create_sink(self, stmt: A.CreateSink) -> str:
         self._pending_subs = []

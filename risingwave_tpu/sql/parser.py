@@ -18,6 +18,7 @@ _TOKEN_RE = re.compile(r"""
     (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
+  | (?P<dollar>\$\$.*?\$\$)
   | (?P<qid>"(?:[^"]|"")*")
   | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
   | (?P<op><>|!=|<=|>=|\|\||::|[-+*/%(),.;=<>\[\]])
@@ -72,6 +73,8 @@ def tokenize(sql: str) -> List[Token]:
             out.append(Token("id", text[1:-1].replace('""', '"'), start))
         elif kind == "str":
             out.append(Token("str", text[1:-1].replace("''", "'"), start))
+        elif kind == "dollar":
+            out.append(Token("str", text[2:-2], start))
         else:
             out.append(Token(kind, text, start))
     out.append(Token("eof", "", len(sql)))
@@ -250,6 +253,10 @@ class Parser:
             q = self.parse_query()
             self._accept_emit_clause(q)
             return A.CreateMaterializedView(name, q)
+        if (self.peek().kind == "id" and self.peek().value == "function") \
+                or (self.peek().kind == "kw" and self.peek().value == "or"
+                    and self.peek(1).value == "replace"):
+            return self._create_function()
         if self.accept_kw("sink"):
             name = self.ident()
             from_name, query = None, None
@@ -271,6 +278,47 @@ class Parser:
             self.expect("op", ")")
             return A.CreateIndex(name, table, cols)
         raise ValueError(f"CREATE what? {self.peek()!r}")
+
+    def _create_function(self) -> A.CreateFunction:
+        """CREATE [OR REPLACE] FUNCTION name(t1, t2) RETURNS t
+        LANGUAGE python AS $$ ... $$"""
+        or_replace = False
+        if self.peek().value == "or":
+            self.next()
+            if self.ident() != "replace":
+                raise ValueError("expected REPLACE after CREATE OR")
+            or_replace = True
+        if self.ident() != "function":
+            raise ValueError("CREATE what?")
+        name = self.ident()
+        arg_types: List[str] = []
+        self.expect("op", "(")
+        if not (self.peek().kind == "op" and self.peek().value == ")"):
+            arg_types.append(self._func_param())
+            while self.accept("op", ","):
+                arg_types.append(self._func_param())
+        self.expect("op", ")")
+        word = self.ident()
+        if word == "returns":
+            ret = self._type_name()
+            word = self.ident()
+        else:
+            raise ValueError("CREATE FUNCTION requires RETURNS <type>")
+        if word != "language":
+            raise ValueError("CREATE FUNCTION requires LANGUAGE")
+        language = self.ident()
+        self.expect_kw("as")
+        body = self.expect("str").value
+        return A.CreateFunction(name, arg_types, ret, language, body,
+                                or_replace)
+
+    def _func_param(self) -> str:
+        """[pname] type — the optional parameter name is skipped."""
+        if self.peek().kind == "id" and self.peek().value not in _TYPE_NAMES \
+                and self.peek(1).kind in ("id", "kw") \
+                and self.peek(1).value in _TYPE_NAMES:
+            self.next()
+        return self._type_name()
 
     def _accept_emit_clause(self, q: A.Select) -> None:
         if self.accept_kw("emit"):
@@ -635,6 +683,12 @@ class Parser:
                     e = A.Between(e, lo, hi, False)
                 elif t.value == "in":
                     self.expect("op", "(")
+                    if self.peek().kind == "kw" and \
+                            self.peek().value == "select":
+                        q = self.parse_select()
+                        self.expect("op", ")")
+                        e = A.InSubquery(e, q, False)
+                        continue
                     items = [self.parse_expr()]
                     while self.accept("op", ","):
                         items.append(self.parse_expr())
@@ -655,6 +709,12 @@ class Parser:
                     e = A.Between(e, lo, hi, True)
                 elif kw == "in":
                     self.expect("op", "(")
+                    if self.peek().kind == "kw" and \
+                            self.peek().value == "select":
+                        q = self.parse_select()
+                        self.expect("op", ")")
+                        e = A.InSubquery(e, q, True)
+                        continue
                     items = [self.parse_expr()]
                     while self.accept("op", ","):
                         items.append(self.parse_expr())
@@ -698,9 +758,16 @@ class Parser:
 
     def _postfix_expr(self) -> A.ExprNode:
         e = self._primary()
-        while self.accept("op", "::"):
-            e = A.CastExpr(e, self._type_name())
-        return e
+        while True:
+            if self.accept("op", "::"):
+                e = A.CastExpr(e, self._type_name())
+            elif self.peek().kind == "op" and self.peek().value == "[":
+                self.next()
+                idx = int(self.expect("num").value)
+                self.expect("op", "]")
+                e = A.Index(e, idx)
+            else:
+                return e
 
     def _primary(self) -> A.ExprNode:
         t = self.peek()
@@ -769,10 +836,19 @@ class Parser:
                 while self.accept("op", ","):
                     args.append(self.parse_expr())
             self.expect("op", ")")
+            filt = None
+            if self.peek().kind == "id" and self.peek().value == "filter" \
+                    and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "(":
+                self.next()
+                self.expect("op", "(")
+                self.expect_kw("where")
+                filt = self.parse_expr()
+                self.expect("op", ")")
             over = None
             if self.accept_kw("over"):
                 over = self._window_spec()
-            return A.FuncCall(name, args, distinct, over)
+            return A.FuncCall(name, args, distinct, over, filt)
         if self.accept("op", "."):
             col = self.ident()
             return A.Col(col, table=name)

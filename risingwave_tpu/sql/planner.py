@@ -146,6 +146,9 @@ class Binder:
             if node.name in ("count", "sum", "min", "max", "avg") \
                     and node.over is None:
                 raise ValueError(f"aggregate {node.name} in scalar context")
+            if node.filter is not None:
+                raise ValueError("FILTER is only supported on aggregate "
+                                 "function calls")
             if node.name == "concat_op":
                 return build_func("concat_op", [self.bind(a)
                                                 for a in node.args])
@@ -183,6 +186,20 @@ class Binder:
             if node.negated:
                 e = A.UnaryOp("not", e)
             return self.bind(e)
+        if isinstance(node, A.Index):
+            inner = node.operand
+            if isinstance(inner, A.FuncCall) and inner.name == "regexp_match":
+                args = [self.bind(a) for a in inner.args]
+                args.append(Literal(node.index, T.INT32))
+                return build_func("regexp_match_idx", args)
+            raise ValueError("subscript is only supported on "
+                             "regexp_match(...)")
+        if isinstance(node, A.InSubquery):
+            raise ValueError("IN (SELECT ...) is only supported as a "
+                             "top-level WHERE condition")
+        if isinstance(node, A.SubqueryExpr):
+            raise ValueError("scalar subqueries are only supported on one "
+                             "side of a WHERE/HAVING comparison")
         raise ValueError(f"cannot bind {node!r}")
 
 
@@ -221,6 +238,8 @@ def _children(node: A.ExprNode) -> List[A.ExprNode]:
         return [node.operand, node.low, node.high]
     if isinstance(node, A.InList):
         return [node.operand] + node.items
+    if isinstance(node, (A.Index, A.InSubquery)):
+        return [node.operand]
     return []
 
 
@@ -395,11 +414,23 @@ class Planner:
         lexec, lns = self._plan_table(ref.left)
         rexec, rns = self._plan_table(ref.right)
         ns = lns.concat(rns)
-        if ref.kind == "cross":
-            raise ValueError("cross join without equi-condition is not "
-                             "supported in streaming plans")
-        # split ON into equi-conjuncts and residual condition
         conjuncts = _split_and(ref.on)
+        if ref.kind == "cross":
+            # comma-join: steal equi conjuncts from the WHERE clause (the
+            # reference's cross-join elimination / predicate-pushdown-into-
+            # join rewrite, `optimizer/rule/` translate_apply + push rules);
+            # `FROM a, b WHERE a.k = b.k` plans as an inner hash join
+            stolen = []
+            for c in list(self._pending_where):
+                if _equi_pair(c, ns, len(lns.cols)) is not None:
+                    stolen.append(c)
+                    self._pending_where.remove(c)
+            if not stolen:
+                raise ValueError("cross join without equi-condition is not "
+                                 "supported in streaming plans")
+            conjuncts = stolen
+            ref = A.Join(ref.left, ref.right, "inner", None)
+        # split ON into equi-conjuncts and residual condition
         lkeys: List[int] = []
         rkeys: List[int] = []
         residual: List[A.ExprNode] = []
@@ -571,13 +602,23 @@ class Planner:
             optimize(q)
         if q.from_ is None:
             raise ValueError("SELECT without FROM is a batch-only statement")
+        # WHERE conjuncts are visible to FROM planning so comma-joins can
+        # steal their equi conditions (cross-join elimination)
+        outer_pw = getattr(self, "_pending_where", [])
+        self._pending_where = _split_and(q.where)
         execu, ns = self._plan_table(q.from_)
+        conjs = self._pending_where
+        self._pending_where = outer_pw
 
-        if q.where is not None:
+        if conjs:
             plain: List[A.ExprNode] = []
-            for conj in _split_and(q.where):
+            for conj in conjs:
                 if _contains_now(conj):
                     execu = self._plan_now_filter(execu, ns, conj)
+                elif isinstance(conj, A.InSubquery):
+                    execu = self._plan_in_subquery(execu, ns, conj)
+                elif _subquery_cmp(conj) is not None:
+                    execu = self._plan_subquery_filter(execu, ns, conj)
                 else:
                     plain.append(conj)
             if plain:
@@ -711,6 +752,57 @@ class Planner:
         return DynamicFilterExecutor(execu, rhs_exec, key_col, cmp,
                                      state_table=df_st)
 
+    def _plan_in_subquery(self, execu: Executor, ns: Namespace,
+                          conj: A.InSubquery) -> Executor:
+        """col [NOT] IN (SELECT ...) -> left semi/anti hash join (the
+        reference's subquery unnesting into StreamHashJoin, `hash_join.rs`
+        LeftSemi/LeftAnti arms). NOTE: NULLs in the subquery follow join
+        semantics, not PG's three-valued NOT IN (no NULL-producing
+        subqueries in the supported workloads)."""
+        if not isinstance(conj.operand, A.Col):
+            raise ValueError("IN (SELECT ...) requires a plain column on "
+                             "the left")
+        li = ns.resolve(conj.operand.name, conj.operand.table)
+        sub_exec, sub_ns = self.plan_query(conj.query)
+        nvis = sub_ns.n_visible if sub_ns.n_visible is not None \
+            else len(sub_ns.cols)
+        if nvis != 1:
+            raise ValueError("IN subquery must select exactly one column")
+        ldtypes = [c.dtype for c in ns.cols]
+        rdtypes = [c.dtype for c in sub_ns.cols]
+        left_state = self.make_state(ldtypes + [T.INT64],
+                                     list(range(len(ldtypes))))
+        right_state = self.make_state(rdtypes + [T.INT64],
+                                      list(range(len(rdtypes))))
+        jt = JoinType.LEFT_ANTI if conj.negated else JoinType.LEFT_SEMI
+        return HashJoinExecutor(execu, sub_exec, [li], [0], jt,
+                                left_state=left_state,
+                                right_state=right_state)
+
+    def _plan_subquery_filter(self, execu: Executor, ns: Namespace,
+                              conj: A.ExprNode) -> Executor:
+        """col CMP (SELECT scalar) -> DynamicFilter with the one-row
+        subquery stream as the moving bound (`dynamic_filter.rs`; the
+        reference unnests uncorrelated scalar subqueries the same way)."""
+        lhs, rhs, cmp = _subquery_cmp(conj)
+        if not isinstance(lhs, A.Col):
+            raise ValueError("the non-subquery side of the comparison must "
+                             "be a plain column")
+        key_col = ns.resolve(lhs.name, lhs.table)
+        sub_exec, sub_ns = self.plan_query(rhs.query)
+        nvis = sub_ns.n_visible if sub_ns.n_visible is not None \
+            else len(sub_ns.cols)
+        if nvis != 1:
+            raise ValueError("scalar subquery must select exactly one "
+                             "column")
+        sub_exec = ProjectExecutor(
+            sub_exec, [InputRef(0, sub_ns.cols[0].dtype)], ["bound"])
+        dts = [c.dtype for c in ns.cols]
+        df_st = self.make_state(dts + [T.INT64], list(range(len(dts))))
+        from ..ops import DynamicFilterExecutor
+        return DynamicFilterExecutor(execu, sub_exec, key_col, cmp,
+                                     state_table=df_st)
+
     def _plan_agg(self, execu: Executor, ns: Namespace, q: A.Select,
                   items: List[A.SelectItem]
                   ) -> Tuple[Executor, Namespace, List[A.SelectItem]]:
@@ -736,7 +828,15 @@ class Planner:
                 call_arg = InputRef(idx, arg.return_type)
             else:
                 call_arg = None
-            calls.append(AggCall(a.name, call_arg, distinct=a.distinct))
+            filt_ref = None
+            if a.filter is not None:
+                fe = b.bind(a.filter)
+                fi = len(pre_exprs)
+                pre_exprs.append(fe)
+                pre_names.append(f"f{i}")
+                filt_ref = InputRef(fi, T.BOOLEAN)
+            calls.append(AggCall(a.name, call_arg, distinct=a.distinct,
+                                 filter=filt_ref))
         if not pre_exprs:
             # count(*)-only: chunks must keep their cardinality, and a
             # zero-column chunk cannot (`DataChunk` derives capacity from
@@ -786,7 +886,18 @@ class Planner:
         new_items = [A.SelectItem(rewrite(i.expr), i.alias) for i in items]
         out: Executor = agg
         if q.having is not None:
-            out = FilterExecutor(out, Binder(post_ns).bind(rewrite(q.having)))
+            plain: List[A.ExprNode] = []
+            for conj in _split_and(q.having):
+                conj = rewrite(conj)
+                if _subquery_cmp(conj) is not None:
+                    out = self._plan_subquery_filter(out, post_ns, conj)
+                else:
+                    plain.append(conj)
+            if plain:
+                node = plain[0]
+                for c in plain[1:]:
+                    node = A.BinOp("and", node, c)
+                out = FilterExecutor(out, Binder(post_ns).bind(node))
         return out, post_ns, new_items
 
     def _plan_over_window(self, execu: Executor, ns: Namespace,
@@ -803,6 +914,9 @@ class Planner:
         calls = []
         for s in specs:
             f: A.FuncCall = s.expr
+            if f.filter is not None:
+                raise ValueError("FILTER on window functions is not "
+                                 "supported")
             arg = b.bind(f.args[0]) if f.args else None
             calls.append(WindowFuncCall(f.name, arg))
         st = self.make_state([c.dtype for c in ns.cols],
@@ -845,6 +959,22 @@ def eval_const(e: A.ExprNode, dtype: Optional[DataType] = None):
 
 def const_expr_type(e: A.ExprNode) -> DataType:
     return Binder(Namespace([])).bind(e).return_type
+
+
+def _subquery_cmp(node: A.ExprNode):
+    """(lhs, SubqueryExpr, cmp) when `node` is a comparison with a scalar
+    subquery on exactly one side (cmp flipped if it's the left)."""
+    if not (isinstance(node, A.BinOp)
+            and node.op in (">", ">=", "<", "<=", "=")):
+        return None
+    flip = {">": "<", ">=": "<=", "<": ">", "<=": ">=", "=": "="}
+    if isinstance(node.right, A.SubqueryExpr) \
+            and not isinstance(node.left, A.SubqueryExpr):
+        return (node.left, node.right, node.op)
+    if isinstance(node.left, A.SubqueryExpr) \
+            and not isinstance(node.right, A.SubqueryExpr):
+        return (node.right, node.left, flip[node.op])
+    return None
 
 
 def _contains_now(node: A.ExprNode) -> bool:
@@ -931,7 +1061,7 @@ def _clone_with(node: A.ExprNode, f) -> A.ExprNode:
         return A.UnaryOp(node.op, f(node.operand))
     if isinstance(node, A.FuncCall):
         return A.FuncCall(node.name, [f(a) for a in node.args],
-                          node.distinct, node.over)
+                          node.distinct, node.over, node.filter)
     if isinstance(node, A.CaseExpr):
         return A.CaseExpr(f(node.operand) if node.operand else None,
                           [(f(c), f(r)) for c, r in node.branches],
@@ -948,4 +1078,8 @@ def _clone_with(node: A.ExprNode, f) -> A.ExprNode:
     if isinstance(node, A.InList):
         return A.InList(f(node.operand), [f(i) for i in node.items],
                         node.negated)
+    if isinstance(node, A.Index):
+        return A.Index(f(node.operand), node.index)
+    if isinstance(node, A.InSubquery):
+        return A.InSubquery(f(node.operand), node.query, node.negated)
     return node
