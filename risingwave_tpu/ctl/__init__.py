@@ -1,0 +1,167 @@
+"""risectl-lite: operator CLI against a live data directory.
+
+The `src/ctl/src/cmd_impl/` analog (hummock/meta/table subcommands) for
+the single-process runtime: inspect the DDL log, the LSM manifest, state
+tables, and metrics, or trigger a full compaction — without writing any
+Python.
+
+    python -m risingwave_tpu.ctl <command> --data-dir DIR [...]
+
+Commands:
+    jobs                      list catalog objects from the DDL log
+    ddl-log                   print the raw DDL log entries
+    manifest                  committed epoch + per-table runs/sizes
+    dump NAME [--limit N]     rows of an object's state table
+    compact                   merge every table's runs into one base
+    metrics                   Prometheus exposition after recovery
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, List, Optional
+
+
+def _store(data_dir: str):
+    from ..state import SpillStateStore
+    if not os.path.exists(os.path.join(data_dir, "MANIFEST.json")):
+        raise SystemExit(f"{data_dir}: no MANIFEST.json — not a data dir")
+    return SpillStateStore(data_dir)
+
+
+def _ddl_entries(store) -> List[Any]:
+    """(seq, sql) rows of the DDL log without a Database."""
+    from ..sql.database import DDL_LOG_DTYPES, DDL_LOG_PK, DDL_LOG_TABLE_ID
+    from ..state import StateTable
+    log = StateTable(store, DDL_LOG_TABLE_ID, list(DDL_LOG_DTYPES),
+                     list(DDL_LOG_PK))
+    return sorted(log.iter_all())
+
+
+def cmd_ddl_log(args) -> int:
+    store = _store(args.data_dir)
+    for seq, sql in _ddl_entries(store):
+        print(f"{seq:6d}  {sql}")
+    return 0
+
+
+def cmd_jobs(args) -> int:
+    """Catalog objects, parsed from the DDL log (no dataflow rebuild)."""
+    from ..sql import ast as A
+    from ..sql.parser import parse_sql
+    store = _store(args.data_dir)
+    live = {}
+    for _seq, sql in _ddl_entries(store):
+        try:
+            stmts = parse_sql(sql)
+        except ValueError:
+            continue
+        for stmt in stmts:
+            if isinstance(stmt, A.CreateTable):
+                kind = "SOURCE" if stmt.is_source else "TABLE"
+                live[stmt.name] = (kind, f"{len(stmt.columns)} columns")
+            elif isinstance(stmt, A.CreateMaterializedView):
+                live[stmt.name] = ("MATERIALIZED VIEW", "")
+            elif isinstance(stmt, A.CreateSink):
+                live[stmt.name] = ("SINK", stmt.with_options.get(
+                    "connector", "collect"))
+            elif isinstance(stmt, A.CreateFunction):
+                live[stmt.name] = ("FUNCTION", stmt.language)
+            elif isinstance(stmt, A.DropObject):
+                live.pop(stmt.name, None)
+    for name, (kind, extra) in live.items():
+        print(f"{kind:18s} {name}" + (f"  ({extra})" if extra else ""))
+    return 0
+
+
+def cmd_manifest(args) -> int:
+    store = _store(args.data_dir)
+    m = store._manifest
+    out = {"committed_epoch": m["committed_epoch"], "tables": {}}
+    for tid, runs in sorted(m["tables"].items(), key=lambda kv: int(kv[0])):
+        sizes = []
+        for name in runs:
+            try:
+                sizes.append(os.path.getsize(store._run_path(name)))
+            except OSError:
+                sizes.append(-1)
+        out["tables"][tid] = {
+            "rows": m["counts"].get(tid, 0),
+            "runs": [{"name": n, "bytes": s}
+                     for n, s in zip(runs, sizes)],
+        }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_dump(args) -> int:
+    """Rows of an object's state table, decoded through the catalog (the
+    `ctl table scan` analog). Opens a full Database (DDL replay) so the
+    schema and key layout are exact."""
+    from ..sql import Database
+    db = Database(data_dir=args.data_dir)
+    try:
+        obj = db.catalog.get(args.name)
+    except KeyError:
+        raise SystemExit(f"no such object: {args.name}")
+    job = (obj.runtime or {}).get("fused_job")
+    st = (obj.runtime or {}).get("state_table")
+    if job is None and st is None:
+        raise SystemExit(f"{args.name}: object has no state table "
+                         f"({obj.kind})")
+    rows = job.mv_rows_now() if job is not None else list(st.iter_all())
+    names = [f.name for f in obj.schema.fields]
+    print("\t".join(names))
+    for i, r in enumerate(rows):
+        if args.limit is not None and i >= args.limit:
+            print(f"... ({len(rows) - args.limit} more)")
+            break
+        print("\t".join("NULL" if v is None else str(v) for v in r))
+    print(f"-- {len(rows)} rows")
+    return 0
+
+
+def cmd_compact(args) -> int:
+    store = _store(args.data_dir)
+    merged = store.compact_all()
+    if not merged:
+        print("nothing to compact")
+    for tid, n in sorted(merged.items(), key=lambda kv: int(kv[0])):
+        print(f"table {tid}: merged {n} runs -> 1 base")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Read-only: recover and expose, WITHOUT ticking a barrier (a
+    diagnostic must not advance the committed epoch)."""
+    from ..sql import Database
+    from ..utils.metrics import REGISTRY
+    db = Database(data_dir=args.data_dir)
+    REGISTRY.gauge("committed_epoch", "last committed epoch"
+                   ).set(db.store.committed_epoch)
+    REGISTRY.gauge("streaming_jobs", "running dataflows"
+                   ).set(len(db._iters) + len(db._fused))
+    print(db.metrics())
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m risingwave_tpu.ctl",
+        description="risectl-lite: inspect/operate a data directory")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name, fn in [("jobs", cmd_jobs), ("ddl-log", cmd_ddl_log),
+                     ("manifest", cmd_manifest), ("compact", cmd_compact),
+                     ("metrics", cmd_metrics)]:
+        sp = sub.add_parser(name)
+        sp.add_argument("--data-dir", required=True)
+        sp.set_defaults(fn=fn)
+    sp = sub.add_parser("dump")
+    sp.add_argument("name")
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--limit", type=int, default=None)
+    sp.set_defaults(fn=cmd_dump)
+    args = p.parse_args(argv)
+    return args.fn(args)

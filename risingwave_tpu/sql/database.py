@@ -38,6 +38,11 @@ from .parser import parse_sql
 from .planner import Binder, Namespace, Planner, type_from_name
 
 ROWID = "_row_id"
+# DDL log layout (shared with risingwave_tpu.ctl): table id 0 holds
+# (seq, sql) rows keyed by seq
+DDL_LOG_TABLE_ID = 0
+DDL_LOG_DTYPES = (T.INT64, T.VARCHAR)
+DDL_LOG_PK = (0,)
 
 
 class _Backfill(Executor):
@@ -107,7 +112,8 @@ class Database:
         # replayed on open so a restarted process rebuilds its dataflows
         # (the meta catalog + recovery analog, `worker.rs:664`)
         self._functions: set = set()      # this session's UDF names
-        self._ddl_log = StateTable(self.store, 0, [T.INT64, T.VARCHAR], [0])
+        self._ddl_log = StateTable(self.store, DDL_LOG_TABLE_ID,
+                                   list(DDL_LOG_DTYPES), list(DDL_LOG_PK))
         self._ddl_seq = 0
         self._replaying = False
         self._recover_catalog()

@@ -222,10 +222,15 @@ def _merge(sources: List[Iterator[Tuple[bytes, Optional[Tuple]]]]
 class SpillStateStore(StateStore):
     """Durable store: epoch-delta memtables over block-indexed spill runs."""
 
+    # dirs this PROCESS owns (multi-open within a process is the normal
+    # recovery-test pattern; cross-process sharing is what must fail fast)
+    _process_locks: Dict[str, Any] = {}
+
     def __init__(self, directory: str,
                  cache_blocks: int = DEFAULT_CACHE_BLOCKS):
         self.dir = directory
         os.makedirs(os.path.join(directory, "runs"), exist_ok=True)
+        self._acquire_dir_lock(directory)
         # keyed by (epoch, table) so committing epoch N persists exactly the
         # deltas ingested for epochs <= N — data already ingested for N+1
         # must NOT become durable under N's checkpoint ('uncommitted epochs
@@ -239,6 +244,28 @@ class SpillStateStore(StateStore):
         self.cache = BlockCache(cache_blocks)
         self._readers: Dict[str, RunReader] = {}
         self._recover()
+
+    @classmethod
+    def _acquire_dir_lock(cls, directory: str) -> None:
+        """One OWNING PROCESS per data directory: an advisory flock held
+        for the process lifetime. A second process (another server, or
+        `risingwave_tpu.ctl` against a live dir) fails fast instead of
+        clobbering the manifest under the owner
+        (`HummockManager` single-writer invariant)."""
+        key = os.path.realpath(directory)
+        if key in cls._process_locks:
+            return
+        import fcntl
+        fd = os.open(os.path.join(directory, ".lock"),
+                     os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            raise RuntimeError(
+                f"data directory {directory!r} is locked by another "
+                "process (a live Database owns it)")
+        cls._process_locks[key] = fd
 
     # ---- write path -----------------------------------------------------
     def ingest_batch(self, table_id, batch, epoch):
@@ -384,6 +411,24 @@ class SpillStateStore(StateStore):
         self._manifest["tables"][str(table_id)] = [base]
         self._manifest["counts"][str(table_id)] = w.count  # exact again
         return list(names)
+
+    def compact_all(self) -> Dict[str, int]:
+        """Operator-triggered full compaction (risectl `hummock
+        trigger-full-gc` / manual compaction analog): every table with
+        more than one run merges to a single base. Returns
+        {table_id: runs_merged}."""
+        merged: Dict[str, int] = {}
+        garbage: List[str] = []
+        epoch = self._manifest["committed_epoch"]
+        for tid_s, runs in list(self._manifest["tables"].items()):
+            if len(runs) <= 1:
+                continue
+            merged[tid_s] = len(runs)
+            garbage += self._compact(int(tid_s), epoch)
+        if merged:
+            self._write_manifest()
+            self._gc(garbage)
+        return merged
 
     def _gc(self, names: Sequence[str]) -> None:
         for n in names:
