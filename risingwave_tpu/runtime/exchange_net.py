@@ -1,0 +1,382 @@
+"""Cross-process exchange: chunk/barrier wire protocol + socket transport
+with permit-based credit flow control.
+
+The reference's remote exchange stack re-hosted for this runtime:
+
+* wire contract — the `ExchangeService::GetStream` analog
+  (`proto/task_service.proto:149`, `src/compute/src/rpc/service/
+  exchange_service.rs:77`): one TCP stream per (job, channel), framed
+  messages, rows in the column-aware value encoding (`core/encoding.py`)
+  so the bytes that cross processes are the same bytes the state tables
+  persist;
+* credit flow control — the permit channel analog
+  (`src/stream/src/executor/exchange/permit.rs:35`): DATA frames consume
+  permits granted by the receiver (`AddPermits` frames back); barriers
+  and watermarks are exempt, so backpressure can never block a
+  checkpoint;
+* `RemoteInput` — the consumer-side executor
+  (`exchange/input.rs:167` RemoteInput): yields Chunk/Barrier/Watermark
+  from the socket and returns permits as it consumes.
+
+Frames: u32 big-endian length, 1 tag byte, body.
+  C chunk      u16 nrows, nrows x (u8 op, u32 len, value-encoded row)
+  B barrier    u64 curr, u64 prev, u8 kind, u8 mutation
+  W watermark  u16 col_idx, u8 type_kind, u32 len, value-encoded datum
+  P permits    u32 n                (receiver -> sender)
+  H hello      u16 channel_id       (receiver -> sender, once)
+  E eos
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..core.chunk import Op, StreamChunk, StreamChunkBuilder
+from ..core.dtypes import DataType, TypeKind
+from ..core.encoding import decode_value_datum, encode_row
+from ..core.epoch import EpochPair
+from ..core.schema import Schema
+from ..ops.executor import Executor
+from ..ops.message import (Barrier, BarrierKind, Message, Mutation,
+                           MutationKind, Watermark)
+
+DEFAULT_PERMITS = 256          # initial credit per connection (in chunks)
+
+# stable wire ids for the string-valued enums
+_MUT = {None: 0, MutationKind.STOP: 1, MutationKind.PAUSE: 2,
+        MutationKind.RESUME: 3}
+_MUT_INV = {v: k for k, v in _MUT.items()}
+_BKIND = {BarrierKind.INITIAL: 0, BarrierKind.BARRIER: 1,
+          BarrierKind.CHECKPOINT: 2}
+_BKIND_INV = {v: k for k, v in _BKIND.items()}
+_TKIND = {k: i for i, k in enumerate(TypeKind)}
+_TKIND_INV = {v: k for k, v in _TKIND.items()}
+
+
+def _decode_row(buf: bytes, dtypes: Sequence[DataType]) -> Tuple:
+    out = []
+    pos = 0
+    for dt in dtypes:
+        v, pos = decode_value_datum(buf, pos, dt)
+        out.append(v)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, tag: bytes, body: bytes = b"") -> None:
+    sock.sendall(struct.pack(">I", len(body) + 1) + tag + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("exchange peer closed")
+        buf += part
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[bytes, bytes]:
+    (ln,) = struct.unpack(">I", _recv_exact(sock, 4))
+    body = _recv_exact(sock, ln)
+    return body[:1], body[1:]
+
+
+MAX_FRAME_ROWS = 0xFFFF        # u16 row count per C frame
+
+
+def encode_chunk_frames(chunk: StreamChunk, dtypes: Sequence[DataType]
+                        ) -> List[bytes]:
+    """One or more C-frame bodies (chunks larger than the u16 row bound
+    split; update pairs never split — MAX_FRAME_ROWS is odd-safe because
+    splitting at an even offset keeps U-/U+ adjacency within a frame)."""
+    chunk = chunk.compact()
+    rows = [(int(chunk.ops[i]), encode_row(chunk.row_at(i), dtypes))
+            for i in range(chunk.capacity)]
+    out = []
+    step = MAX_FRAME_ROWS - 1          # even split point preserves pairs
+    for lo in range(0, len(rows), step) or [0]:
+        part = rows[lo:lo + step]
+        frame = [struct.pack(">H", len(part))]
+        for op, row in part:
+            frame.append(struct.pack(">BI", op, len(row)))
+            frame.append(row)
+        out.append(b"".join(frame))
+    return out or [struct.pack(">H", 0)]
+
+
+def decode_chunk(body: bytes, dtypes: Sequence[DataType]
+                 ) -> Optional[StreamChunk]:
+    (n,) = struct.unpack(">H", body[:2])
+    pos = 2
+    builder = StreamChunkBuilder(list(dtypes))
+    for _ in range(n):
+        op, ln = struct.unpack(">BI", body[pos:pos + 5])
+        pos += 5
+        row = _decode_row(body[pos:pos + ln], dtypes)
+        pos += ln
+        builder.append_row(Op(op), row)
+    chunks = builder.drain()
+    return chunks[0] if chunks else None
+
+
+def encode_message(msg: Message, dtypes: Sequence[DataType]
+                   ) -> Tuple[bytes, bytes]:
+    if isinstance(msg, StreamChunk):
+        frames = encode_chunk_frames(msg, dtypes)
+        assert len(frames) == 1, "use encode_chunk_frames for large chunks"
+        return b"C", frames[0]
+    if isinstance(msg, Barrier):
+        # unsupported mutation kinds (scale/backfill control) must fail
+        # loudly, not silently arrive as plain barriers
+        mut = _MUT[msg.mutation.kind if msg.mutation else None]
+        return b"B", struct.pack(">QQBB", msg.epoch.curr, msg.epoch.prev,
+                                 _BKIND[msg.kind], mut)
+    if isinstance(msg, Watermark):
+        from ..core.encoding import encode_value_datum
+        datum = encode_value_datum(msg.value, msg.dtype)
+        return b"W", struct.pack(">HBI", msg.col_idx,
+                                 _TKIND[msg.dtype.kind], len(datum)) + datum
+    raise TypeError(f"cannot encode {type(msg).__name__}")
+
+
+def decode_message(tag: bytes, body: bytes, dtypes: Sequence[DataType]
+                   ) -> Optional[Message]:
+    if tag == b"C":
+        return decode_chunk(body, dtypes)
+    if tag == b"B":
+        curr, prev, kind, mut = struct.unpack(">QQBB", body)
+        mutation = (Mutation(_MUT_INV[mut]) if mut else None)
+        return Barrier(EpochPair(curr, prev), _BKIND_INV[kind], mutation)
+    if tag == b"W":
+        col_idx, kind, ln = struct.unpack(">HBI", body[:7])
+        dt = DataType(_TKIND_INV[kind])
+        v, _ = decode_value_datum(body[7:7 + ln], 0, dt)
+        return Watermark(col_idx, dt, v)
+    raise ValueError(f"unknown frame {tag!r}")
+
+
+# ---------------------------------------------------------------------------
+# sender side (the fragment OUTPUT boundary)
+# ---------------------------------------------------------------------------
+
+
+class NetChannel:
+    """Producer-side queue for one downstream consumer. A writer thread
+    drains it to the socket, spending permits on DATA frames and blocking
+    (backpressure) when credit runs out — barriers pass regardless. The
+    queue itself is bounded for DATA, so a slow consumer backpressures
+    the producer's pump instead of buffering the whole stream."""
+
+    def __init__(self, dtypes: Sequence[DataType],
+                 capacity: int = 4 * DEFAULT_PERMITS):
+        self.dtypes = list(dtypes)
+        self.capacity = capacity
+        self.buf: Deque[Message] = deque()
+        self.cv = threading.Condition()
+        self.closed = False
+        self.eos_sent = threading.Event()   # writer delivered everything
+
+    def _data_len(self) -> int:
+        return sum(1 for m in self.buf if isinstance(m, StreamChunk))
+
+    # Channel-compatible surface for DispatchExecutor
+    def send(self, msg: Message) -> None:
+        with self.cv:
+            if isinstance(msg, StreamChunk):
+                while self._data_len() >= self.capacity and not self.closed:
+                    self.cv.wait()
+            self.buf.append(msg)
+            self.cv.notify_all()
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+
+
+class ExchangeServer:
+    """Accepts one connection per registered channel and streams it.
+
+    `register` returns the NetChannel the producer writes into (via
+    DispatchExecutor). The server owns the listener + per-connection
+    writer/permit threads; `close()` after all channels saw EOS."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.addr = self._lsock.getsockname()
+        self.channels: Dict[int, NetChannel] = {}
+        self._claimed: set = set()
+        self._claim_lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def register(self, channel_id: int, dtypes: Sequence[DataType],
+                 capacity: int = 4 * DEFAULT_PERMITS) -> NetChannel:
+        ch = NetChannel(dtypes, capacity)
+        self.channels[channel_id] = ch
+        return ch
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return                      # listener closed
+            # handshake off-thread with a deadline: a stalled or garbage
+            # client (health checks, port scanners) must never block the
+            # accept loop or the other streams
+            t = threading.Thread(target=self._handshake, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _handshake(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(30.0)
+            tag, body = _recv_frame(conn)
+            if tag != b"H" or len(body) != 2:
+                conn.close()
+                return
+            (cid,) = struct.unpack(">H", body)
+            with self._claim_lock:
+                ch = self.channels.get(cid)
+                if ch is None or cid in self._claimed:
+                    # unknown or already-streamed channel: refuse loudly
+                    # rather than split one stream across two consumers
+                    conn.close()
+                    return
+                self._claimed.add(cid)
+            conn.settimeout(None)
+        except (ConnectionError, OSError, struct.error):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self._writer(conn, ch)
+
+    def _writer(self, conn: socket.socket, ch: NetChannel) -> None:
+        permits = [DEFAULT_PERMITS]
+        pcv = threading.Condition()
+
+        def permit_reader():
+            try:
+                while True:
+                    tag, body = _recv_frame(conn)
+                    if tag == b"P":
+                        with pcv:
+                            permits[0] += struct.unpack(">I", body)[0]
+                            pcv.notify_all()
+            except (ConnectionError, OSError):
+                with pcv:
+                    permits[0] = 1 << 30     # unblock a dying writer
+                    pcv.notify_all()
+
+        preader = threading.Thread(target=permit_reader, daemon=True)
+        preader.start()
+        try:
+            while True:
+                with ch.cv:
+                    while not ch.buf and not ch.closed:
+                        ch.cv.wait()
+                    if not ch.buf and ch.closed:
+                        _send_frame(conn, b"E")
+                        break
+                    msg = ch.buf.popleft()
+                    ch.cv.notify_all()      # wake a blocked send()
+                if isinstance(msg, StreamChunk):
+                    for body in encode_chunk_frames(msg, ch.dtypes):
+                        # credit: block until the receiver granted room
+                        with pcv:
+                            while permits[0] <= 0:
+                                pcv.wait()
+                            permits[0] -= 1
+                        _send_frame(conn, b"C", body)
+                    continue
+                tag, body = encode_message(msg, ch.dtypes)
+                _send_frame(conn, tag, body)
+        except (ConnectionError, OSError):
+            return
+        finally:
+            # Linger until the consumer hangs up: exiting the process with
+            # permit frames still in flight would RST the connection and
+            # destroy undelivered data on it (and on sibling streams).
+            try:
+                conn.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+            preader.join(timeout=60)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            ch.eos_sent.set()
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until every channel's consumer received EOS (the producer
+        process must outlive its streams)."""
+        ok = True
+        for ch in self.channels.values():
+            ok = ch.eos_sent.wait(timeout) and ok
+        return ok
+
+    def close(self) -> None:
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# receiver side (the fragment INPUT boundary)
+# ---------------------------------------------------------------------------
+
+
+class RemoteInput(Executor):
+    """Executor over a remote exchange stream (`exchange/input.rs:167`):
+    connects, then yields the peer's messages; every consumed chunk
+    returns one permit so the sender's credit stays topped up."""
+
+    def __init__(self, addr: Tuple[str, int], channel_id: int,
+                 schema: Schema, append_only: bool = False):
+        super().__init__(schema, f"RemoteInput[{channel_id}]")
+        self.append_only = append_only
+        self.addr = addr
+        self.channel_id = channel_id
+
+    def execute(self) -> Iterator[Message]:
+        sock = socket.create_connection(self.addr)
+        try:
+            _send_frame(sock, b"H", struct.pack(">H", self.channel_id))
+            dtypes = self.schema.dtypes
+            while True:
+                tag, body = _recv_frame(sock)
+                if tag == b"E":
+                    return
+                msg = decode_message(tag, body, dtypes)
+                if msg is None:
+                    continue
+                if isinstance(msg, StreamChunk):
+                    _send_frame(sock, b"P", struct.pack(">I", 1))
+                yield msg
+                if isinstance(msg, Barrier) and msg.is_stop():
+                    return
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
