@@ -1,4 +1,4 @@
-"""Nexmark benchmarks: device (TPU) vs honest CPU baselines.
+"""Nexmark benchmarks: device (TPU) vs honest CPU baselines — timeout-proof.
 
 Workloads (BASELINE.json targets; reference SQL from
 `/root/reference/src/tests/simulation/src/nexmark/q{5,7,8}.sql`):
@@ -11,7 +11,7 @@ Workloads (BASELINE.json targets; reference SQL from
    executor stack, epochs on the TPU, recovery persistence on. Ingest-
    inclusive (host->device transfer is in the measured path).
 3. **q5 / q7 / q8 through SQL** — the full reference queries (hop/tumble
-   windows, self-joins) on the device path, small-to-moderate scale.
+   windows, self-joins) on the device path.
 
 Baselines, stated per workload:
 - `numpy_batch_eps`: a vectorized single-node CPU implementation of the
@@ -25,10 +25,21 @@ independently computed numpy oracle over the SAME event stream (bit-exact
 multiset equality). The fused ceiling is verified against the numpy
 groupby of its on-device-generated stream.
 
+**Un-killable by construction** (BENCH_r03 was rc=124 with zero output —
+never again): every stage runs in its own subprocess under a wall-clock
+budget; a stage that overruns is SIGKILLed and retried at a smaller scale;
+results accumulate in `bench_progress.json` after every stage; the final
+aggregate prints even on SIGTERM/SIGINT. A transient device-tunnel stall
+can cost one stage, not the whole run.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
+Flags: --smoke (tiny scales, <2 min); env RW_BENCH_BUDGET=secs total.
 """
 import json
+import multiprocessing as mp
 import os
+import signal
+import sys
 import time
 
 import numpy as np
@@ -38,15 +49,15 @@ EPOCHS = 50
 ROWS = 262_144
 N_AUCTIONS = 10_000
 # SQL-path scales (events are 1:3:46 person:auction:bid out of 50).
-# Device scales are sized so the per-process fixed costs (compiled-program
-# loads from the persistent cache, ~seconds) amortize against epochs that
-# run in milliseconds; every epoch is 64 x 8192-row chunks = 524288 events.
-Q4_SQL_EVENTS = 8_388_608            # 16 fused epochs
-QX_SQL_EVENTS = 4_194_304            # 8 fused epochs per source
-HOST_SQL_EVENTS = 131_072            # host path is per-row Python
-HOST_QX_EVENTS = 16_384              # hop expansion is 5x rows on host
+# Each entry is (full, fallback) — a stage that blows its wall budget at
+# full scale is killed and re-run once at the fallback scale.
+Q4_SQL_EVENTS = (8_388_608, 2_097_152)   # 16 fused epochs, fallback 4
+QX_SQL_EVENTS = (4_194_304, 1_048_576)   # 8 fused epochs per source
+HOST_SQL_EVENTS = 131_072                # host path is per-row Python
+HOST_QX_EVENTS = 16_384                  # hop expansion is 5x rows on host
 
 USEC = 1_000_000
+PROGRESS_PATH = os.environ.get("RW_BENCH_PROGRESS", "bench_progress.json")
 
 BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
            " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
@@ -174,9 +185,9 @@ def numpy_q5(auction, ts):
 def numpy_q7(auction, bidder, price, ts):
     size = 10 * USEC
     wend = (ts // size) * size + size
-    keys, (mp,) = groupby_reduce(wend, [("max", price)])
+    keys, (mp_,) = groupby_reduce(wend, [("max", price)])
     rows = []
-    for e, m in zip(keys, mp):
+    for e, m in zip(keys, mp_):
         sel = (price == m) & (ts >= e - size) & (ts <= e)
         for i in np.flatnonzero(sel):
             rows.append((int(auction[i]), int(price[i]), int(bidder[i]),
@@ -195,13 +206,16 @@ def numpy_q8(p_id, p_name, p_ts, a_seller, a_ts):
 
 
 # ---------------------------------------------------------------------------
-# workload 1: fused device ceiling
+# stage bodies (each runs in a fresh subprocess under a wall budget)
 # ---------------------------------------------------------------------------
 
-def run_fused():
+def stage_fused(epochs, rows):
+    """Workload 1: fused device ceiling + oracle verify + CPU baselines."""
     import jax
     import jax.numpy as jnp
     from risingwave_tpu.device.agg_step import DeviceAggSpec
+    from risingwave_tpu.device.datagen import gen_bids
+    from risingwave_tpu.device.materialize import mv_rows
     from risingwave_tpu.device.pipeline import bid_agg_epoch, make_bid_pipeline
 
     spec = DeviceAggSpec.build(["count_star", "sum", "max"],
@@ -209,33 +223,54 @@ def run_fused():
     agg, mv = make_bid_pipeline(spec, 1 << 14)
     rng = jax.random.PRNGKey(42)
     zero = jnp.zeros((), jnp.int32)
-    a, m, r, mn = bid_agg_epoch(spec, ROWS, N_AUCTIONS, agg, mv, rng, zero)
+    a, m, r, mn = bid_agg_epoch(spec, rows, N_AUCTIONS, agg, mv, rng, zero)
     jax.block_until_ready(mn)      # compile
     rng = jax.random.PRNGKey(42)
     mn = zero
     t0 = time.perf_counter()
-    for _ in range(EPOCHS):
-        agg, mv, rng, mn = bid_agg_epoch(spec, ROWS, N_AUCTIONS, agg, mv,
+    for _ in range(epochs):
+        agg, mv, rng, mn = bid_agg_epoch(spec, rows, N_AUCTIONS, agg, mv,
                                          rng, mn)
     jax.block_until_ready(mn)
     dt = time.perf_counter() - t0
     assert int(mn) <= agg.keys.shape[0], "state overflow: results invalid"
-    return EPOCHS * ROWS / dt, (spec, agg, mv)
+    fused_eps = epochs * rows / dt
 
-
-def fused_event_stream():
-    """Replay the fused pipeline's on-device generator on host (device
-    arrays accumulate, ONE batched pull — remote links pay per transfer)."""
-    import jax
-    from risingwave_tpu.device.datagen import gen_bids
+    # replay the on-device generator (device arrays accumulate, ONE
+    # batched pull — remote links pay per transfer)
     rng = jax.random.PRNGKey(42)
     auctions, prices = [], []
-    for _ in range(EPOCHS):
-        auction, price, rng = gen_bids(rng, ROWS, N_AUCTIONS)
+    for _ in range(epochs):
+        auction, price, rng = gen_bids(rng, rows, N_AUCTIONS)
         auctions.append(auction)
         prices.append(price)
     auctions, prices = jax.device_get((auctions, prices))
-    return np.concatenate(auctions), np.concatenate(prices)
+    auction = np.concatenate(auctions)
+    price = np.concatenate(prices)
+
+    t0 = time.perf_counter()
+    oracle = numpy_q4(auction, price)
+    numpy_q4_eps = len(auction) / (time.perf_counter() - t0)
+
+    keys, cols, nulls = mv_rows(mv, [c.acc_dtype for c in spec.calls])
+    assert len(keys) == len(oracle), (len(keys), len(oracle))
+    for i, key in enumerate(keys.tolist()):
+        got = (int(cols[0][i]), int(cols[1][i]), int(cols[2][i]))
+        assert got == oracle[key], (key, got, oracle[key])
+
+    dict_eps = host_dict_eps(auction, price)
+    return {
+        "platform": jax.devices()[0].platform,
+        "q4_fused": {
+            "device_eps": round(fused_eps),
+            "numpy_batch_eps": round(numpy_q4_eps),
+            "python_dict_eps": round(dict_eps),
+            "events": epochs * rows, "groups": len(oracle),
+            "mv_verified": True,
+            "note": "datagen on device; numpy baseline is compute-only "
+                    "sort-reduce over the identical replayed stream",
+        },
+    }
 
 
 def host_dict_eps(auction, price, n=2 * ROWS):
@@ -244,6 +279,7 @@ def host_dict_eps(auction, price, n=2 * ROWS):
     from risingwave_tpu.expr.agg import AggCall, create_agg_state
     from risingwave_tpu.expr.expression import InputRef
     from risingwave_tpu.core import dtypes as T
+    n = min(n, len(auction))
     price_ref = InputRef(1, T.INT64)
     calls = [AggCall("count"), AggCall("sum", price_ref),
              AggCall("max", price_ref)]
@@ -258,19 +294,6 @@ def host_dict_eps(auction, price, n=2 * ROWS):
         g[2].apply(1, int(price[i]))
     return n / (time.perf_counter() - t0)
 
-
-def verify_fused(spec, mv, oracle):
-    from risingwave_tpu.device.materialize import mv_rows
-    keys, cols, nulls = mv_rows(mv, [c.acc_dtype for c in spec.calls])
-    assert len(keys) == len(oracle), (len(keys), len(oracle))
-    for i, key in enumerate(keys.tolist()):
-        got = (int(cols[0][i]), int(cols[1][i]), int(cols[2][i]))
-        assert got == oracle[key], (key, got, oracle[key])
-
-
-# ---------------------------------------------------------------------------
-# SQL-path workloads
-# ---------------------------------------------------------------------------
 
 def nexmark_host_columns(n_events):
     """Replay the SQL connector's generator host-side (same seed/config)."""
@@ -303,7 +326,7 @@ def _device_cfg(on, capacity):
     return DeviceConfig(capacity=capacity)
 
 
-def run_q4_sql(on, n_events):
+def _q4_db(on, n_events):
     from risingwave_tpu.sql import Database
     db = Database(device=_device_cfg(on, 1 << 20))
     db.run(BID_SRC.format(n=n_events))
@@ -313,10 +336,31 @@ def run_q4_sql(on, n_events):
     return n_events / dt, rows
 
 
-def run_qx_sql(on, n_events):
+def stage_q4_device(n_events):
+    """Workload 2: q4 through SQL on the device path + oracle verify."""
+    eps, rows = _q4_db(True, n_events)
+    cols = nexmark_host_columns(n_events)["bid"]
+    oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
+    assert len(rows) == len(oracle)
+    for a, c, s, m in rows:
+        assert oracle[int(a)] == (int(c), int(s), int(m)), a
+    return {"q4_sql": {
+        "device_eps": round(eps), "events": n_events, "groups": len(rows),
+        "mv_verified": True,
+        "note": "full SQL stack, ingest-inclusive (host nexmark datagen + "
+                "chunk transfer in the measured path)",
+    }}
+
+
+def stage_q4_host(n_events):
+    eps, _ = _q4_db(False, n_events)
+    return {"q4_sql_host": {"host_sql_eps": round(eps), "events": n_events}}
+
+
+def _qx_db(on, n_events, capacity):
     """q5+q7+q8 in one database (sources shared, compile cache shared)."""
     from risingwave_tpu.sql import Database
-    db = Database(device=_device_cfg(on, 1 << 16))
+    db = Database(device=_device_cfg(on, capacity))
     db.run(BID_SRC.format(n=n_events))
     db.run(AUCTION_SRC.format(n=n_events))
     db.run(PERSON_SRC.format(n=n_events))
@@ -332,94 +376,204 @@ def run_qx_sql(on, n_events):
     return n_events / dt, out
 
 
-def main():
-    import jax
-    detail = {"platform": jax.devices()[0].platform}
-
-    # -- workload 1: fused ceiling + its baselines ------------------------
-    fused_eps, (spec, agg, mv) = run_fused()
-    auction, price = fused_event_stream()
+def stage_qx_device(n_events):
+    """Workload 3: q5/q7/q8 through SQL on the device path + oracles."""
+    eps, qx = _qx_db(True, n_events, 1 << 16)
+    c = nexmark_host_columns(n_events)
+    bid, auc, per = c["bid"], c["auction"], c["person"]
     t0 = time.perf_counter()
-    oracle = numpy_q4(auction, price)
-    numpy_q4_eps = len(auction) / (time.perf_counter() - t0)
-    verify_fused(spec, mv, oracle)
-    dict_eps = host_dict_eps(auction, price)
-    detail["q4_fused"] = {
-        "device_eps": round(fused_eps),
-        "numpy_batch_eps": round(numpy_q4_eps),
-        "python_dict_eps": round(dict_eps),
-        "events": EPOCHS * ROWS, "groups": len(oracle),
+    q5_oracle = numpy_q5(bid[0].astype(np.int64), bid[5].astype(np.int64))
+    q5_np_eps = len(bid[0]) / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    q7_oracle = numpy_q7(bid[0].astype(np.int64), bid[1].astype(np.int64),
+                         bid[2].astype(np.int64), bid[5].astype(np.int64))
+    q7_np_eps = len(bid[0]) / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    q8_oracle = numpy_q8(per[0].astype(np.int64), per[1],
+                         per[6].astype(np.int64),
+                         auc[7].astype(np.int64), auc[5].astype(np.int64))
+    q8_np_eps = (len(per[0]) + len(auc[0])) / (time.perf_counter() - t0)
+    assert sorted((int(a), int(n)) for a, n in qx["q5"]) == q5_oracle
+    assert sorted((int(a), int(p), int(b), int(t))
+                  for a, p, b, t in qx["q7"]) == q7_oracle
+    assert sorted((int(i), str(nm), int(w))
+                  for i, nm, w in qx["q8"]) == q8_oracle
+    return {"q5_q7_q8_sql": {
+        "device_eps": round(eps), "events": n_events,
+        "numpy_batch_eps": {"q5": round(q5_np_eps), "q7": round(q7_np_eps),
+                            "q8": round(q8_np_eps)},
+        "rows": {k: len(v) for k, v in qx.items()},
         "mv_verified": True,
-        "note": "datagen on device; numpy baseline is compute-only "
-                "sort-reduce over the identical replayed stream",
-    }
+        "note": "three reference-SQL MVs concurrently over shared "
+                "sources; device_eps counts each source event once; "
+                "oracles computed independently in numpy",
+    }}
 
-    # -- workload 2: q4 through SQL ---------------------------------------
-    q4_eps, q4_rows = run_q4_sql(True, Q4_SQL_EVENTS)
-    cols = nexmark_host_columns(Q4_SQL_EVENTS)["bid"]
-    q4_oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
-    assert len(q4_rows) == len(q4_oracle)
-    for a, c, s, m in q4_rows:
-        assert q4_oracle[int(a)] == (int(c), int(s), int(m)), a
-    host_q4_eps, _ = run_q4_sql(False, HOST_SQL_EVENTS)
-    detail["q4_sql"] = {
-        "device_eps": round(q4_eps), "host_sql_eps": round(host_q4_eps),
-        "events": Q4_SQL_EVENTS, "groups": len(q4_rows),
-        "mv_verified": True,
-        "note": "full SQL stack, ingest-inclusive (host nexmark datagen + "
-                "chunk transfer in the measured path); host_sql_eps "
-                f"measured at {HOST_SQL_EVENTS} events",
-    }
 
-    # -- workload 3: q5/q7/q8 through SQL ---------------------------------
+def stage_qx_host(n_events):
+    eps, _ = _qx_db(False, n_events, 1 << 16)
+    return {"q5_q7_q8_sql_host": {"host_sql_eps": round(eps),
+                                  "events": n_events}}
+
+
+# ---------------------------------------------------------------------------
+# the un-killable harness
+# ---------------------------------------------------------------------------
+
+_STAGES = {
+    "fused": stage_fused,
+    "q4_device": stage_q4_device,
+    "q4_host": stage_q4_host,
+    "qx_device": stage_qx_device,
+    "qx_host": stage_qx_host,
+}
+
+
+def _stage_child(name, args, out_path):
+    """Subprocess entry: run one stage, dump its dict to out_path.
+    Write-then-rename so the parent can never read a half-written file."""
     try:
-        qx_eps, qx = run_qx_sql(True, QX_SQL_EVENTS)
-        c = nexmark_host_columns(QX_SQL_EVENTS)
-        bid, auc, per = c["bid"], c["auction"], c["person"]
-        t0 = time.perf_counter()
-        q5_oracle = numpy_q5(bid[0].astype(np.int64),
-                             bid[5].astype(np.int64))
-        q5_np_eps = len(bid[0]) / (time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        q7_oracle = numpy_q7(bid[0].astype(np.int64), bid[1].astype(np.int64),
-                             bid[2].astype(np.int64), bid[5].astype(np.int64))
-        q7_np_eps = len(bid[0]) / (time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        q8_oracle = numpy_q8(per[0].astype(np.int64), per[1],
-                             per[6].astype(np.int64),
-                             auc[7].astype(np.int64), auc[5].astype(np.int64))
-        q8_np_eps = (len(per[0]) + len(auc[0])) / (time.perf_counter() - t0)
-        assert sorted((int(a), int(n)) for a, n in qx["q5"]) == q5_oracle
-        assert sorted((int(a), int(p), int(b), int(t))
-                      for a, p, b, t in qx["q7"]) == q7_oracle
-        assert sorted((int(i), str(nm), int(w))
-                      for i, nm, w in qx["q8"]) == q8_oracle
-        host_qx_eps, _ = run_qx_sql(False, HOST_QX_EVENTS)
-        detail["q5_q7_q8_sql"] = {
-            "device_eps": round(qx_eps), "host_sql_eps": round(host_qx_eps),
-            "events": QX_SQL_EVENTS,
-            "numpy_batch_eps": {"q5": round(q5_np_eps),
-                                "q7": round(q7_np_eps),
-                                "q8": round(q8_np_eps)},
-            "rows": {k: len(v) for k, v in qx.items()},
-            "mv_verified": True,
-            "note": "three reference-SQL MVs concurrently over shared "
-                    "sources; device_eps counts each source event once; "
-                    "oracles computed independently in numpy",
-        }
-    except Exception as e:  # keep the headline even if qx trips
-        detail["q5_q7_q8_sql"] = {"error": f"{type(e).__name__}: {e}"}
+        result = _STAGES[name](*args)
+        payload = {"ok": True, "result": result}
+    except BaseException as e:  # report, don't propagate — parent decides
+        payload = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    with open(out_path + ".part", "w") as f:
+        json.dump(payload, f)
+    os.replace(out_path + ".part", out_path)
 
-    result = {
-        "metric": "nexmark_q4_agg_throughput",
-        "value": round(fused_eps),
-        "unit": "events/s",
-        # honest denominator: the vectorized numpy batch baseline, not the
-        # per-row Python loop BENCH_r01 used (that ratio is in detail)
-        "vs_baseline": round(fused_eps / numpy_q4_eps, 3),
-        "detail": detail,
-    }
-    print(json.dumps(result))
+
+class Harness:
+    def __init__(self, total_budget):
+        self.deadline = time.monotonic() + total_budget
+        self.detail = {}
+        self.log = []
+        self._printed = False
+        self._proc = None               # live stage subprocess, if any
+        signal.signal(signal.SIGTERM, self._on_term)
+        signal.signal(signal.SIGINT, self._on_term)
+
+    def _on_term(self, signum, frame):
+        self.log.append(f"signal {signum} — emitting partial results")
+        if self._proc is not None and self._proc.is_alive():
+            self._proc.kill()          # os._exit skips mp atexit cleanup
+        self.emit()
+        os._exit(1)
+
+    def remaining(self):
+        return self.deadline - time.monotonic()
+
+    def run_stage(self, name, args, budget, note=""):
+        """Run one stage subprocess under a wall budget; merge its result."""
+        budget = min(budget, max(5.0, self.remaining() - 10.0))
+        if budget <= 5.0:
+            self.log.append(f"{name}{args}: skipped (total budget exhausted)")
+            self._progress()
+            return False
+        out_path = f"{PROGRESS_PATH}.{name}.tmp"
+        if os.path.exists(out_path):
+            os.unlink(out_path)
+        ctx = mp.get_context("spawn")
+        t0 = time.monotonic()
+        proc = ctx.Process(target=_stage_child, args=(name, args, out_path),
+                           daemon=True)
+        self._proc = proc
+        proc.start()
+        proc.join(budget)
+        wall = time.monotonic() - t0
+        if proc.is_alive():
+            proc.kill()
+            proc.join(10)
+            self._proc = None
+            self.log.append(f"{name}{args}: KILLED after {wall:.0f}s "
+                            f"(budget {budget:.0f}s){note}")
+            self._progress()
+            return False
+        self._proc = None
+        ok = False
+        payload = None
+        if os.path.exists(out_path):
+            try:
+                with open(out_path) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError) as e:   # truncated/unreadable
+                payload = {"ok": False, "error": f"result unreadable: {e}"}
+            os.unlink(out_path)
+        if payload is not None:
+            if payload.get("ok"):
+                self.detail.update(payload["result"])
+                self.log.append(f"{name}{args}: ok in {wall:.0f}s")
+                ok = True
+            else:
+                self.log.append(f"{name}{args}: {payload['error']}")
+        else:
+            self.log.append(f"{name}{args}: died (rc={proc.exitcode}) "
+                            f"after {wall:.0f}s")
+        self._progress()
+        return ok
+
+    def _progress(self):
+        tmp = PROGRESS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"detail": self.detail, "log": self.log}, f, indent=1)
+        os.replace(tmp, PROGRESS_PATH)
+
+    def emit(self):
+        if self._printed:
+            return
+        self._printed = True
+        d = self.detail
+        # fold the separately-staged host baselines into their workloads
+        # (host runs at its own, smaller scale — keep that visible)
+        if "q4_sql" in d and "q4_sql_host" in d:
+            h = d.pop("q4_sql_host")
+            d["q4_sql"]["host_sql_eps"] = h["host_sql_eps"]
+            d["q4_sql"]["host_sql_events"] = h["events"]
+        if "q5_q7_q8_sql" in d and "q5_q7_q8_sql_host" in d:
+            h = d.pop("q5_q7_q8_sql_host")
+            d["q5_q7_q8_sql"]["host_sql_eps"] = h["host_sql_eps"]
+            d["q5_q7_q8_sql"]["host_sql_events"] = h["events"]
+        d["stage_log"] = self.log
+        fused = d.get("q4_fused", {})
+        value = fused.get("device_eps", 0)
+        base = fused.get("numpy_batch_eps")
+        if not value:  # fused stage lost — fall back to the SQL headline
+            value = d.get("q4_sql", {}).get("device_eps", 0)
+            base = d.get("q4_sql", {}).get("host_sql_eps")
+        result = {
+            "metric": "nexmark_q4_agg_throughput",
+            "value": value,
+            "unit": "events/s",
+            # honest denominator: the vectorized numpy batch baseline, not
+            # the per-row Python loop BENCH_r01 used
+            "vs_baseline": round(value / base, 3) if base else None,
+            "detail": d,
+        }
+        print(json.dumps(result), flush=True)
+
+
+def main():
+    smoke = "--smoke" in sys.argv
+    total = float(os.environ.get("RW_BENCH_BUDGET", "100" if smoke
+                                 else "540"))
+    h = Harness(total)
+    if smoke:
+        h.run_stage("fused", (10, 65_536), 60)
+        h.run_stage("q4_device", (524_288,), 60)
+        h.run_stage("q4_host", (32_768,), 30)
+        h.run_stage("qx_device", (262_144,), 60)
+        h.run_stage("qx_host", (8_192,), 30)
+    else:
+        if not h.run_stage("fused", (EPOCHS, ROWS), 150):
+            h.run_stage("fused", (10, ROWS), 60, " — retrying smaller")
+        if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 150):
+            h.run_stage("q4_device", (Q4_SQL_EVENTS[1],), 90,
+                        " — retrying smaller")
+        h.run_stage("q4_host", (HOST_SQL_EVENTS,), 60)
+        if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 180):
+            h.run_stage("qx_device", (QX_SQL_EVENTS[1],), 120,
+                        " — retrying smaller")
+        h.run_stage("qx_host", (HOST_QX_EVENTS,), 60)
+    h.emit()
 
 
 if __name__ == "__main__":

@@ -35,6 +35,11 @@ class DeviceConfig:
     # whole-fragment fusion (device/fuse_planner.py): eligible MV plans
     # become one jitted epoch program. Off forces the per-operator path.
     fuse: bool = True
+    # fused jobs mirror their MV into the host state table for non-device
+    # readers every N checkpoints (plus at drain/recovery). 1 = every
+    # checkpoint (reference-strict); higher trades mirror freshness for
+    # throughput — queries always serve live device state regardless.
+    mv_persist_every: int = 8
 
 
 @dataclass

@@ -101,7 +101,7 @@ def cmd_dump(args) -> int:
     `ctl table scan` analog). Opens a full Database (DDL replay) so the
     schema and key layout are exact."""
     from ..sql import Database
-    db = Database(data_dir=args.data_dir)
+    db = Database(data_dir=args.data_dir, device="auto")
     try:
         obj = db.catalog.get(args.name)
     except KeyError:
@@ -138,7 +138,7 @@ def cmd_metrics(args) -> int:
     diagnostic must not advance the committed epoch)."""
     from ..sql import Database
     from ..utils.metrics import REGISTRY
-    db = Database(data_dir=args.data_dir)
+    db = Database(data_dir=args.data_dir, device="auto")
     REGISTRY.gauge("committed_epoch", "last committed epoch"
                    ).set(db.store.committed_epoch)
     REGISTRY.gauge("streaming_jobs", "running dataflows"

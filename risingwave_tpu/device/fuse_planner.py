@@ -467,7 +467,9 @@ def try_fuse(execu, ns, device_cfg, name: str,
         return FusedJob(name, program, pull, f.max_events,
                         mv_state_table=mv_state_table,
                         job_state_table=job_table,
-                        mv_schema_len=len(ns.cols))
+                        mv_schema_len=len(ns.cols),
+                        persist_every=getattr(device_cfg,
+                                              "mv_persist_every", 1))
     except FuseReject:
         return None
 

@@ -381,7 +381,7 @@ class AggNode(Node):
         # row identity of emitted change rows = pack(group, outputs); None
         # when no join/pair-MV consumes this stream (pk then unused)
         self.pk_pack = pk_pack
-        self.stat_names = tuple(["needed"]
+        self.stat_names = tuple(["needed", "touched"]
                                 + [f"ms{i}" for i in range(len(spec.minputs))]
                                 + ["packbad"])
 
@@ -394,8 +394,12 @@ class AggNode(Node):
         from .sorted_state import grow_state
         grew = False
         main = state.main
-        if stats["needed"] > main.capacity:
-            self.capacity = _bucket(stats["needed"], lo=main.capacity * 2)
+        # `touched` guards the change-set compaction bound (2 * capacity):
+        # an epoch touching more unique groups than capacity must grow and
+        # replay even if enough groups died for the merge itself to fit
+        need = max(stats["needed"], stats.get("touched", 0))
+        if need > main.capacity:
+            self.capacity = _bucket(need, lo=main.capacity * 2)
             main = grow_state(main, self.capacity, self.spec.kinds)
             grew = True
         ms = list(state.minputs)
@@ -426,6 +430,9 @@ class AggNode(Node):
                 tuple((c.kind, c.arg.index if c.arg is not None else None)
                       for c in self.calls),
                 self.pack, self.pk_pack, self.spec)
+
+    def _mut_sig(self):
+        return (self.capacity,)   # grow() mutates it; it shapes `bound`
 
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
@@ -462,12 +469,27 @@ class AggNode(Node):
         n = ch["keys"].shape[0]
         sign = cat(-jnp.ones(n, jnp.int32), jnp.ones(n, jnp.int32))
         mask = cat(old_found & changed, new_found & changed)
+        # Bound the emitted change set by 2 * capacity: an epoch cannot
+        # touch more groups than the state holds without growing (the
+        # `touched` stat triggers grow+replay before truncation could ever
+        # drop a live row). Without this, downstream static shapes inherit
+        # this node's INPUT row bound — q5's hop(5x) -> agg -> agg cascade
+        # compiled 5.2M-row programs the remote compile helper OOM-killed.
+        bound = 2 * min(n, self.capacity)
+        if bound < 2 * n:
+            from .sorted_state import compact_rows
+            out_rows = compact_rows(
+                mask, [], cols + [sign], bound,
+                [0] * len(cols) + [0])
+            cols, sign = list(out_rows[:-1]), out_rows[-1]
+            mask = sign != 0
         pk = None
         if self.pk_pack is not None:
             pk = self.pk_pack.pack(cols)
             packbad = packbad | self.pk_pack.check(cols, mask)
         out = Delta(cols, sign, mask, pk=pk)
-        stats = [needed.astype(jnp.int64)] \
+        stats = [needed.astype(jnp.int64),
+                 ch["count"].astype(jnp.int64)] \
             + [m.astype(jnp.int64) for m in ms_needed] + [packbad]
         return new_state, out, stats, ch
 
@@ -732,7 +754,8 @@ class FusedJob:
     def __init__(self, name: str, program: FusedProgram, pull: MVPull,
                  max_events: Optional[int],
                  mv_state_table=None, job_state_table=None,
-                 mv_schema_len: Optional[int] = None):
+                 mv_schema_len: Optional[int] = None,
+                 persist_every: int = 1):
         import jax.numpy as jnp
         self.name = name
         self.program = program
@@ -741,6 +764,11 @@ class FusedJob:
         self.mv_state_table = mv_state_table
         self.job_state_table = job_state_table
         self.mv_schema_len = mv_schema_len or len(pull.dtypes)
+        # mirror the MV into the host state table every N epochs-worth of
+        # checkpoints (pull + diff + row writes are host work that would
+        # otherwise throttle every epoch); drain always mirrors
+        self.persist_every = max(1, persist_every)
+        self._last_persist = -1
         self.counter = 0
         self.committed = 0
         self.states = program.init_states()
@@ -809,7 +837,13 @@ class FusedJob:
 
     def _checkpoint(self, epoch: int) -> None:
         self.sync()
-        self._persist_mv(epoch)
+        due = self.counter != self._last_persist and (
+            self.drained
+            or self.counter - max(0, self._last_persist)
+            >= self.persist_every * self.program.epoch_events)
+        if due:
+            self._persist_mv(epoch)
+            self._last_persist = self.counter
         if self.job_state_table is not None:
             if self.committed != self.counter or self.committed == 0:
                 self.job_state_table.insert((0, self.counter))
@@ -888,6 +922,7 @@ class FusedJob:
         if self.mv_state_table is not None:
             self._persisted = {tuple(r): None
                                for r in self.mv_state_table.iter_all()}
+        self._last_persist = -1     # mirror may be stale: refresh next ckpt
 
 
 def _np_unpack(pack: PackPlan, keys: np.ndarray) -> List[np.ndarray]:

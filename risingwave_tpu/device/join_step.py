@@ -58,13 +58,13 @@ def batch_reduce_rows(jk, pk, signs, mask, vals):
     """Unique (jk, pk) deltas: net sign (sum), payload (last write wins).
     Rows whose net sign is 0 are dropped at merge. Output is (jk,pk)-sorted
     with EMPTY padding."""
+    from .sorted_state import sort_cols
     b = jk.shape[0]
     jk = jnp.where(mask, jk, EMPTY_KEY)
     pk = jnp.where(mask, pk, EMPTY_KEY)
-    order = jnp.lexsort((pk, jk))
-    jk, pk = jk[order], pk[order]
-    signs = jnp.where(mask, signs, 0)[order]
-    vals = [v[order] for v in vals]
+    signs = jnp.where(mask, signs, 0)
+    (jk, pk), out = sort_cols([jk, pk], [signs] + list(vals))
+    signs, vals = out[0], list(out[1:])
     same = jnp.concatenate([jnp.zeros((1,), bool),
                             (jk[1:] == jk[:-1]) & (pk[1:] == pk[:-1])])
     seg = jnp.cumsum(~same) - 1
@@ -82,19 +82,23 @@ def batch_reduce_rows(jk, pk, signs, mask, vals):
 
 def merge_side(side: JoinSide, djk, dpk, dsign, dvals
                ) -> Tuple[JoinSide, jax.Array]:
-    """Apply unique (jk,pk) deltas: +1 insert/upsert, -1 delete, 0 no-op."""
+    """Apply unique (jk,pk) deltas: +1 insert/upsert, -1 delete, 0 no-op.
+
+    One stable variadic lexsort (state rows concatenated first, so they
+    precede their delta on ties — sorted_state.sort_cols rationale) +
+    combine + sort-based compaction. Zero-sign deltas merge as no-ops:
+    they pair with their state row (if any) contributing pres 0, and
+    compact away alone (pres_m == 0)."""
+    from .sorted_state import compact_rows, sort_cols
     c = side.jk.shape[0]
-    jk = jnp.concatenate([side.jk, jnp.where(dsign == 0, EMPTY_KEY, djk)])
-    pk = jnp.concatenate([side.pk, jnp.where(dsign == 0, EMPTY_KEY, dpk)])
-    pres = jnp.concatenate([
-        (side.jk != EMPTY_KEY).astype(jnp.int32), dsign])
+    jk = jnp.concatenate([side.jk, djk])
+    pk = jnp.concatenate([side.pk, dpk])
+    pres = jnp.concatenate([(side.jk != EMPTY_KEY).astype(jnp.int32),
+                            dsign.astype(jnp.int32)])
     vals = [jnp.concatenate([sv, dv.astype(sv.dtype)])
             for sv, dv in zip(side.vals, dvals)]
-    is_delta = jnp.concatenate([jnp.zeros((c,), bool),
-                                jnp.ones((djk.shape[0],), bool)])
-    order = jnp.lexsort((is_delta, pk, jk))   # state before delta in ties
-    jk, pk, pres, is_delta = jk[order], pk[order], pres[order], is_delta[order]
-    vals = [v[order] for v in vals]
+    (jk, pk), out = sort_cols([jk, pk], [pres] + vals)
+    pres, vals = out[0], list(out[1:])
     same_next = jnp.concatenate(
         [(jk[:-1] == jk[1:]) & (pk[:-1] == pk[1:]), jnp.zeros((1,), bool)])
     same_prev = jnp.concatenate(
@@ -104,27 +108,24 @@ def merge_side(side: JoinSide, djk, dpk, dsign, dvals
     vals_m = [jnp.where(same_next & (nxt(pres) > 0), nxt(v), v)
               for v in vals]   # upsert takes the delta payload
     alive = ~same_prev & (jk != EMPTY_KEY) & (pres_m > 0)
-    dest = jnp.cumsum(alive) - 1
     needed = jnp.sum(alive).astype(jnp.int32)
-    idx = jnp.where(alive, dest, jk.shape[0])
-    out_jk = jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(jk, mode="drop")
-    out_pk = jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(pk, mode="drop")
-    out_vals = tuple(jnp.zeros((c,), v.dtype).at[idx].set(v, mode="drop")
-                     for v in vals_m)
-    return JoinSide(out_jk, out_pk, jnp.minimum(needed, c), out_vals), needed
+    out = compact_rows(alive, [jk, pk], vals_m, c,
+                       [EMPTY_KEY, EMPTY_KEY] + [0] * len(vals_m))
+    return JoinSide(out[0], out[1], jnp.minimum(needed, c),
+                    tuple(out[2:])), needed
 
 
 def probe(side: JoinSide, qjk, qmask, m: int):
     """All matches of each probe key: (probe_row[m], state_idx[m], mask[m],
     needed_pairs). Ragged -> static via cumsum + searchsorted expansion."""
     qjk = jnp.where(qmask, qjk, EMPTY_KEY)
-    lo = jnp.searchsorted(side.jk, qjk, side="left")
-    hi = jnp.searchsorted(side.jk, qjk, side="right")
+    lo = jnp.searchsorted(side.jk, qjk, side="left", method="sort")
+    hi = jnp.searchsorted(side.jk, qjk, side="right", method="sort")
     cnt = jnp.where(qmask & (qjk != EMPTY_KEY), hi - lo, 0)
     off = jnp.cumsum(cnt)
     total = off[-1]
     t = jnp.arange(m)
-    row = jnp.searchsorted(off, t, side="right")
+    row = jnp.searchsorted(off, t, side="right", method="sort")
     row_c = jnp.clip(row, 0, qjk.shape[0] - 1)
     prev = jnp.where(row_c > 0, off[row_c - 1], 0)
     sidx = lo[row_c] + (t - prev)

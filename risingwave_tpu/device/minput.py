@@ -75,12 +75,12 @@ def ms_grow(ms: SortedMultiset, new_capacity: int) -> SortedMultiset:
 def ms_batch_reduce(k1, k2, delta, mask):
     """Rows -> unique (k1, k2) pairs with summed count deltas, sorted,
     EMPTY-padded. delta is +1/-1 (sign) per row; masked rows neutralized."""
+    from .sorted_state import sort_cols
     b = k1.shape[0]
     k1 = jnp.where(mask, k1, EMPTY_KEY)
     k2 = jnp.where(mask, k2, EMPTY_KEY)
     delta = jnp.where(mask, delta, 0).astype(jnp.int64)
-    order = jnp.lexsort((k2, k1))
-    k1, k2, delta = k1[order], k2[order], delta[order]
+    (k1, k2), (delta,) = sort_cols([k1, k2], [delta])
     same = jnp.concatenate([jnp.zeros((1,), bool),
                             (k1[1:] == k1[:-1]) & (k2[1:] == k2[:-1])])
     seg = jnp.cumsum(~same) - 1
@@ -95,13 +95,14 @@ def ms_merge(ms: SortedMultiset, u1, u2, ud
              ) -> Tuple[SortedMultiset, jax.Array]:
     """Merge unique pair deltas; pairs whose multiplicity reaches 0 compact
     away. Returns (new_ms, needed) — needed > capacity means grow+retry."""
+    from .sorted_state import compact_rows, sort_cols
     c = ms.capacity
-    dead = ud == 0
-    k1 = jnp.concatenate([ms.k1, jnp.where(dead, EMPTY_KEY, u1)])
-    k2 = jnp.concatenate([ms.k2, jnp.where(dead, EMPTY_KEY, u2)])
+    # zero-count deltas are no-ops: they add 0 to an existing pair's count
+    # or compact away alone (merged == 0) — no EMPTY remap needed
+    k1 = jnp.concatenate([ms.k1, u1])
+    k2 = jnp.concatenate([ms.k2, u2])
     cnt = jnp.concatenate([ms.cnt, ud])
-    order = jnp.lexsort((k2, k1))
-    k1, k2, cnt = k1[order], k2[order], cnt[order]
+    (k1, k2), (cnt,) = sort_cols([k1, k2], [cnt])
     same_next = jnp.concatenate(
         [(k1[:-1] == k1[1:]) & (k2[:-1] == k2[1:]), jnp.zeros((1,), bool)])
     same_prev = jnp.concatenate(
@@ -109,23 +110,19 @@ def ms_merge(ms: SortedMultiset, u1, u2, ud
     nxt = jnp.concatenate([cnt[1:], cnt[-1:]])
     merged = jnp.where(same_next, cnt + nxt, cnt)
     alive = ~same_prev & (k1 != EMPTY_KEY) & (merged != 0)
-    dest = jnp.cumsum(alive) - 1
     needed = jnp.sum(alive).astype(jnp.int32)
-    idx = jnp.where(alive, dest, k1.shape[0])
-    out = SortedMultiset(
-        jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(k1, mode="drop"),
-        jnp.full((c,), EMPTY_KEY, jnp.int64).at[idx].set(k2, mode="drop"),
-        jnp.minimum(needed, c),
-        jnp.zeros((c,), jnp.int64).at[idx].set(merged, mode="drop"))
-    return out, needed
+    out = compact_rows(alive, [k1, k2], [merged], c,
+                       [EMPTY_KEY, EMPTY_KEY, 0])
+    return SortedMultiset(out[0], out[1], jnp.minimum(needed, c),
+                          out[2]), needed
 
 
 def ms_group_minmax(ms: SortedMultiset, groups):
     """Per queried group: (found, min value, max value). Groups absent from
     the multiset return found=False (gate on it). k1 is itself sorted
     because the pairs are lexicographic."""
-    lo = jnp.searchsorted(ms.k1, groups, side="left")
-    hi = jnp.searchsorted(ms.k1, groups, side="right")
+    lo = jnp.searchsorted(ms.k1, groups, side="left", method="sort")
+    hi = jnp.searchsorted(ms.k1, groups, side="right", method="sort")
     found = (hi > lo) & (groups != EMPTY_KEY)
     lo_c = jnp.minimum(lo, ms.capacity - 1)
     hi_c = jnp.clip(hi - 1, 0, ms.capacity - 1)

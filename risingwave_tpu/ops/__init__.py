@@ -17,6 +17,9 @@ from .watermark import WatermarkFilterExecutor
 from .window import HopWindowExecutor, OverWindowExecutor, WindowFuncCall
 from .misc import (ChangelogExecutor, DynamicFilterExecutor, NowExecutor,
                    SortExecutor)
+from .project_set import (BoundTableFunction, ProjectSetExecutor,
+                          TableFunctionScanExecutor)
+from .temporal_join import TemporalJoinExecutor
 
 __all__ = [
     "Executor", "SharedStream", "UnaryExecutor", "BatchScan",
@@ -32,5 +35,6 @@ __all__ = [
     "WatermarkFilterExecutor", "Channel", "ChannelSource",
     "DispatchExecutor", "FragmentPump", "MergeExecutor",
     "ChangelogExecutor", "DynamicFilterExecutor", "NowExecutor",
-    "SortExecutor",
+    "SortExecutor", "BoundTableFunction", "ProjectSetExecutor",
+    "TableFunctionScanExecutor", "TemporalJoinExecutor",
 ]

@@ -305,13 +305,25 @@ class PgServer:
     """TCP server: every Postgres client connection gets a session thread
     over the shared Database."""
 
-    def __init__(self, db, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, db, host: str = "127.0.0.1", port: int = 0,
+                 enable_embedded_udf: bool = False):
         self.db = db
+        # network-reachable sessions exec() UDF bodies in-process; off by
+        # default, operator opt-in only (the reference gates embedded UDFs
+        # the same way). The gate rides the WIRE_SESSION thread-local of
+        # THIS server's handler threads — the embedding process's own
+        # Database API is never affected, and two servers sharing one db
+        # (e.g. a public port and an opted-in admin port) keep independent
+        # gates.
+        self.enable_embedded_udf = enable_embedded_udf
         self.lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self) -> None:
+                from ..sql.database import WIRE_SESSION
+                WIRE_SESSION.active = True
+                WIRE_SESSION.udf_allowed = outer.enable_embedded_udf
                 conn = _Conn(self.request, outer.db, outer.lock)
                 try:
                     conn.serve()

@@ -96,13 +96,20 @@ MAX_FRAME_ROWS = 0xFFFF        # u16 row count per C frame
 def encode_chunk_frames(chunk: StreamChunk, dtypes: Sequence[DataType]
                         ) -> List[bytes]:
     """One or more C-frame bodies (chunks larger than the u16 row bound
-    split; update pairs never split — MAX_FRAME_ROWS is odd-safe because
-    splitting at an even offset keeps U-/U+ adjacency within a frame)."""
+    split). A U-/U+ pair straddling a split boundary is degraded to
+    DELETE + INSERT — same-row semantics, frame-local validity — the same
+    fix the reference's hash dispatcher applies when a pair lands on two
+    actors (`src/stream/src/executor/dispatch.rs:891-909`)."""
     chunk = chunk.compact()
-    rows = [(int(chunk.ops[i]), encode_row(chunk.row_at(i), dtypes))
+    rows = [[int(chunk.ops[i]), encode_row(chunk.row_at(i), dtypes)]
             for i in range(chunk.capacity)]
+    step = MAX_FRAME_ROWS
+    for lo in range(step, len(rows), step):
+        if rows[lo - 1][0] == int(Op.UPDATE_DELETE) \
+                and rows[lo][0] == int(Op.UPDATE_INSERT):
+            rows[lo - 1][0] = int(Op.DELETE)
+            rows[lo][0] = int(Op.INSERT)
     out = []
-    step = MAX_FRAME_ROWS - 1          # even split point preserves pairs
     for lo in range(0, len(rows), step) or [0]:
         part = rows[lo:lo + step]
         frame = [struct.pack(">H", len(part))]
@@ -117,7 +124,10 @@ def decode_chunk(body: bytes, dtypes: Sequence[DataType]
                  ) -> Optional[StreamChunk]:
     (n,) = struct.unpack(">H", body[:2])
     pos = 2
-    builder = StreamChunkBuilder(list(dtypes))
+    # one frame = one chunk: the builder bound must exceed the u16 frame
+    # row bound or frames over 1024 rows would silently truncate
+    builder = StreamChunkBuilder(list(dtypes),
+                                 max_chunk_size=MAX_FRAME_ROWS + 1)
     for _ in range(n):
         op, ln = struct.unpack(">BI", body[pos:pos + 5])
         pos += 5
@@ -183,7 +193,8 @@ class NetChannel:
         self.buf: Deque[Message] = deque()
         self.cv = threading.Condition()
         self.closed = False
-        self.eos_sent = threading.Event()   # writer delivered everything
+        self.aborted = False                # writer died mid-stream
+        self.done = threading.Event()       # writer finished (EOS or abort)
 
     def _data_len(self) -> int:
         return sum(1 for m in self.buf if isinstance(m, StreamChunk))
@@ -191,10 +202,23 @@ class NetChannel:
     # Channel-compatible surface for DispatchExecutor
     def send(self, msg: Message) -> None:
         with self.cv:
+            if self.aborted:
+                return                      # consumer gone: drop, don't block
             if isinstance(msg, StreamChunk):
-                while self._data_len() >= self.capacity and not self.closed:
+                while self._data_len() >= self.capacity \
+                        and not (self.closed or self.aborted):
                     self.cv.wait()
+                if self.aborted:
+                    return
             self.buf.append(msg)
+            self.cv.notify_all()
+
+    def abort(self) -> None:
+        """Writer-side: the connection died. Unblock any producer stuck in
+        send() and mark the stream as NOT fully delivered."""
+        with self.cv:
+            self.aborted = True
+            self.buf.clear()
             self.cv.notify_all()
 
     def close(self) -> None:
@@ -288,6 +312,7 @@ class ExchangeServer:
 
         preader = threading.Thread(target=permit_reader, daemon=True)
         preader.start()
+        delivered = False
         try:
             while True:
                 with ch.cv:
@@ -295,6 +320,7 @@ class ExchangeServer:
                         ch.cv.wait()
                     if not ch.buf and ch.closed:
                         _send_frame(conn, b"E")
+                        delivered = True
                         break
                     msg = ch.buf.popleft()
                     ch.cv.notify_all()      # wake a blocked send()
@@ -310,8 +336,10 @@ class ExchangeServer:
                 tag, body = encode_message(msg, ch.dtypes)
                 _send_frame(conn, tag, body)
         except (ConnectionError, OSError):
-            return
+            pass
         finally:
+            if not delivered:
+                ch.abort()              # unblock producers; mark undelivered
             # Linger until the consumer hangs up: exiting the process with
             # permit frames still in flight would RST the connection and
             # destroy undelivered data on it (and on sibling streams).
@@ -324,14 +352,15 @@ class ExchangeServer:
                 conn.close()
             except OSError:
                 pass
-            ch.eos_sent.set()
+            ch.done.set()
 
     def wait_drained(self, timeout: Optional[float] = None) -> bool:
-        """Block until every channel's consumer received EOS (the producer
-        process must outlive its streams)."""
+        """Block until every channel's writer finished; True only if every
+        stream actually delivered EOS (an aborted connection is False, not
+        'drained' — the consumer did NOT get the full stream)."""
         ok = True
         for ch in self.channels.values():
-            ok = ch.eos_sent.wait(timeout) and ok
+            ok = ch.done.wait(timeout) and not ch.aborted and ok
         return ok
 
     def close(self) -> None:
@@ -368,10 +397,13 @@ class RemoteInput(Executor):
                 if tag == b"E":
                     return
                 msg = decode_message(tag, body, dtypes)
+                if tag == b"C":
+                    # refund one permit per C frame received — including
+                    # frames that decode to zero rows, or the sender's
+                    # credit would leak away one empty chunk at a time
+                    _send_frame(sock, b"P", struct.pack(">I", 1))
                 if msg is None:
                     continue
-                if isinstance(msg, StreamChunk):
-                    _send_frame(sock, b"P", struct.pack(">I", 1))
                 yield msg
                 if isinstance(msg, Barrier) and msg.is_stop():
                     return

@@ -53,6 +53,12 @@ class FuncCall(ExprNode):
 
 
 @dataclass
+class ArrayLit(ExprNode):
+    """ARRAY[e1, e2, ...] — consumed by UNNEST (no array columns yet)."""
+    items: List[ExprNode]
+
+
+@dataclass
 class WindowSpec:
     partition_by: List[ExprNode]
     order_by: List[Tuple[ExprNode, bool]]   # (expr, desc)
@@ -164,6 +170,23 @@ class ChangelogTable(TableRef):
     the upstream's retractable change stream as an append-only relation with
     a `changelog_op` column."""
     inner: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class TableFunctionTable(TableRef):
+    """FROM-clause table function: generate_series(...) / unnest(ARRAY[...])
+    (`src/expr/core/src/table_function/mod.rs:174`)."""
+    name: str                  # 'generate_series' | 'unnest'
+    args: List[ExprNode]
+    alias: Optional[str] = None
+
+
+@dataclass
+class TemporalTable(TableRef):
+    """t FOR SYSTEM_TIME AS OF PROCTIME() — the version side of a temporal
+    join (`src/stream/src/executor/temporal_join.rs:44`)."""
+    inner: "NamedTable"
     alias: Optional[str] = None
 
 

@@ -40,6 +40,13 @@ from .planner import Binder, Namespace, Planner, type_from_name
 ROWID = "_row_id"
 # DDL log layout (shared with risingwave_tpu.ctl): table id 0 holds
 # (seq, sql) rows keyed by seq
+import threading
+
+# Set (active=True) by pgwire handler threads: statements arriving over the
+# network carry this marker so security-sensitive DDL (embedded UDFs) can be
+# gated per-connection without touching the embedding process's local API.
+WIRE_SESSION = threading.local()
+
 DDL_LOG_TABLE_ID = 0
 DDL_LOG_DTYPES = (T.INT64, T.VARCHAR)
 DDL_LOG_PK = (0,)
@@ -94,6 +101,13 @@ class Database:
         # to the durable store and validated on reopen (fail fast instead of
         # corrupting recovered state).
         from ..config import resolve_device
+        # device="auto": adopt whatever policy the data directory was
+        # created with (inspection tools — risectl — must be able to open
+        # any directory without knowing its policy, and must not stamp a
+        # marker onto one that has none)
+        self._marker_readonly = device == "auto"
+        if device == "auto":
+            device = self._device_from_marker(data_dir)
         self.device = resolve_device(device)
         self._check_device_marker()
         self.catalog = Catalog()
@@ -125,6 +139,31 @@ class Database:
                 if self.device.mesh is not None else "single")
         return mode + (":minmax" if self.device.minmax else "")
 
+    @staticmethod
+    def _device_from_marker(data_dir: Optional[str]):
+        """Reconstruct the device argument a data directory was created
+        with (its device_mode.json marker); "off" when unmarked."""
+        import json
+        import os
+        if not data_dir:
+            return "off"
+        path = os.path.join(data_dir, "device_mode.json")
+        if not os.path.exists(path):
+            return "off"
+        with open(path) as f:
+            mode = json.load(f)["mode"]
+        if mode == "off":
+            return "off"
+        from ..config import DeviceConfig
+        parts = mode.split(":")
+        minmax = parts[-1] == "minmax"
+        if minmax:
+            parts = parts[:-1]
+        if parts[0] == "single":
+            return DeviceConfig(minmax=minmax)
+        from ..parallel import make_mesh
+        return DeviceConfig(mesh=make_mesh(int(parts[1])), minmax=minmax)
+
     def _check_device_marker(self) -> None:
         """Durable stores record the dispatch policy that shaped their state
         tables; a reopen under a different policy fails fast."""
@@ -143,7 +182,7 @@ class Database:
                     f"data directory was created with device={saved!r} but "
                     f"reopened with device={mode!r}; state-table layouts "
                     "differ between dispatch policies")
-        else:
+        elif not self._marker_readonly:
             with open(path, "w") as f:
                 json.dump({"mode": mode}, f)
 
@@ -589,6 +628,18 @@ class Database:
         if stmt.language.lower() != "python":
             raise ValueError(f"LANGUAGE {stmt.language} not supported "
                              "(python only)")
+        # embedded UDFs exec() arbitrary code in the server process; pgwire
+        # sessions (detected via the WIRE_SESSION thread-local their handler
+        # threads set) are refused unless the operator opted in (the
+        # reference gates embedded UDFs the same way). The embedding
+        # process's own local API is never gated, and DDL replay is exempt:
+        # the statement was authorized when it was first accepted.
+        via_wire = getattr(WIRE_SESSION, "active", False)
+        if via_wire and not getattr(WIRE_SESSION, "udf_allowed", False) \
+                and not self._replaying:
+            raise ValueError(
+                "embedded Python UDFs are disabled for network clients "
+                "(start the server with enable_embedded_udf=True)")
         if stmt.name.lower() in self._functions and not stmt.or_replace \
                 and not self._replaying:
             raise ValueError(f"function {stmt.name!r} already exists")

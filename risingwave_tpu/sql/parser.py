@@ -624,6 +624,32 @@ class Parser:
                 t.alias = a
             return t
         name = self.ident()
+        if name.lower() in ("generate_series", "unnest") \
+                and self.peek().kind == "op" and self.peek().value == "(":
+            self.expect("op", "(")
+            args = [self.parse_expr()]
+            while self.accept("op", ","):
+                args.append(self.parse_expr())
+            self.expect("op", ")")
+            return A.TableFunctionTable(name.lower(), args, self._alias())
+        # t FOR SYSTEM_TIME AS OF PROCTIME() — temporal join version side
+        if self.peek().kind == "kw" and self.peek().value == "for" \
+                and self.peek(1).kind == "id" \
+                and self.peek(1).value.lower() == "system_time":
+            self.next()
+            self.next()
+            self.expect_kw("as")
+            if not (self.peek().kind == "id"
+                    and self.peek().value.lower() == "of"):
+                raise ValueError("expected OF after FOR SYSTEM_TIME AS")
+            self.next()
+            fn = self.ident()
+            if fn.lower() != "proctime":
+                raise ValueError("only FOR SYSTEM_TIME AS OF PROCTIME() "
+                                 "is supported")
+            self.expect("op", "(")
+            self.expect("op", ")")
+            return A.TemporalTable(A.NamedTable(name, None), self._alias())
         alias = self._alias()
         cte = self._ctes.get(name)
         if cte is not None:
@@ -824,6 +850,17 @@ class Parser:
                 raise ValueError("EXISTS subqueries not supported yet")
             if t.value == "distinct":
                 raise ValueError("misplaced DISTINCT")
+        if t.kind == "id" and t.value.lower() == "array" \
+                and self.peek(1).kind == "op" and self.peek(1).value == "[":
+            self.next()
+            self.next()
+            items = []
+            if not (self.peek().kind == "op" and self.peek().value == "]"):
+                items.append(self.parse_expr())
+                while self.accept("op", ","):
+                    items.append(self.parse_expr())
+            self.expect("op", "]")
+            return A.ArrayLit(items)
         # identifier: column, qualified column, or function call
         name = self.ident()
         if self.accept("op", "("):

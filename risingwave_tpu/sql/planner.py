@@ -390,9 +390,50 @@ class Planner:
             out = Namespace(cols, sk)
             out.watermark_idx = ns.watermark_idx
             return execu, out
+        if isinstance(ref, A.TableFunctionTable):
+            return self._plan_table_function(ref)
+        if isinstance(ref, A.TemporalTable):
+            raise ValueError("FOR SYSTEM_TIME AS OF PROCTIME() is only "
+                             "valid as the right side of a join")
         if isinstance(ref, A.Join):
             return self._plan_join(ref)
         raise ValueError(f"cannot plan table ref {ref!r}")
+
+    def _plan_table_function(self, ref: A.TableFunctionTable
+                             ) -> Tuple[Executor, Namespace]:
+        """FROM generate_series(...) / UNNEST(ARRAY[...]) — a bounded scan
+        (`table_function/mod.rs:174`; batch `generate_series.rs`)."""
+        from ..ops import TableFunctionScanExecutor
+        if self.barrier_source is None:
+            raise ValueError("table functions need a streaming context")
+        tf = self._bind_table_function(ref.name, ref.args,
+                                       Binder(Namespace([], [])))
+        # PG: the alias of a single-column SRF names the COLUMN too
+        # (SELECT g FROM generate_series(1,3) AS g)
+        col = ref.alias or ref.name
+        execu = TableFunctionScanExecutor(tf, col, self.barrier_source())
+        cols = [ColumnEntry(col, col, tf.return_type),
+                ColumnEntry(col, "_row_id", T.INT64)]
+        return execu, Namespace(cols, [1], 1)
+
+    def _bind_table_function(self, name: str, args: List[A.ExprNode],
+                             b: "Binder"):
+        from ..ops import BoundTableFunction
+        from ..ops.project_set import series_return_type
+        if name == "unnest":
+            if len(args) != 1 or not isinstance(args[0], A.ArrayLit):
+                raise ValueError("UNNEST supports ARRAY[...] literals only "
+                                 "(array-typed columns are not supported)")
+            elems = [b.bind(x) for x in args[0].items]
+            if not elems:
+                raise ValueError("UNNEST of an empty array")
+            return BoundTableFunction("unnest", elems,
+                                      elems[0].return_type)
+        if not 2 <= len(args) <= 3:
+            raise ValueError("generate_series(start, stop[, step])")
+        bound = [b.bind(x) for x in args]
+        rt = series_return_type([e.return_type for e in bound])
+        return BoundTableFunction("generate_series", bound, rt)
 
     def _plan_changelog(self, ref: A.ChangelogTable
                         ) -> Tuple[Executor, Namespace]:
@@ -411,6 +452,11 @@ class Planner:
         return execu, Namespace(cols, [rid])
 
     def _plan_join(self, ref: A.Join) -> Tuple[Executor, Namespace]:
+        if isinstance(ref.right, A.TemporalTable):
+            return self._plan_temporal_join(ref)
+        if isinstance(ref.left, A.TemporalTable):
+            raise ValueError("the version table (FOR SYSTEM_TIME) must be "
+                             "the right side of a temporal join")
         lexec, lns = self._plan_table(ref.left)
         rexec, rns = self._plan_table(ref.right)
         ns = lns.concat(rns)
@@ -470,6 +516,53 @@ class Planner:
                 condition=cond,
                 left_state=left_state, right_state=right_state)
         return execu, ns
+
+    def _plan_temporal_join(self, ref: A.Join) -> Tuple[Executor, Namespace]:
+        """stream JOIN t FOR SYSTEM_TIME AS OF PROCTIME() ON ...
+        (`temporal_join.rs:44`): right side is a version index that is
+        looked up, not joined — output is append-only."""
+        from ..ops import TemporalJoinExecutor
+        if ref.kind not in ("inner", "left"):
+            raise ValueError("temporal joins support INNER and LEFT only")
+        tref: A.TemporalTable = ref.right
+        lexec, lns = self._plan_table(ref.left)
+        rexec, rschema, rpk = self.subscribe(tref.inner.name)
+        alias = tref.alias or tref.inner.name
+        rns = Namespace.of_schema(rschema, alias, rpk)
+        ns = lns.concat(rns)
+        lkeys: List[int] = []
+        rkeys: List[int] = []
+        residual: List[A.ExprNode] = []
+        nl = len(lns.cols)
+        for c in _split_and(ref.on):
+            pair = _equi_pair(c, ns, nl)
+            if pair is not None:
+                lkeys.append(pair[0])
+                rkeys.append(pair[1] - nl)
+            else:
+                residual.append(c)
+        if not lkeys:
+            raise ValueError("temporal join requires an equi-condition on "
+                             "the version table")
+        cond = None
+        if residual:
+            node = residual[0]
+            for r in residual[1:]:
+                node = A.BinOp("and", node, r)
+            cond = Binder(ns).bind(node)
+        rdtypes = [f.dtype for f in rschema.fields]
+        right_state = self.make_state(rdtypes, list(rpk or
+                                                    range(len(rdtypes))))
+        execu = TemporalJoinExecutor(
+            lexec, rexec, lkeys, rkeys, outer=ref.kind == "left",
+            condition=cond, right_pk=rpk, right_state=right_state)
+        # output identity comes from the left stream alone: right-side
+        # changes never retract emitted rows, so left stream key + right pk
+        # make output rows unique
+        out = Namespace(ns.cols, list(lns.stream_key)
+                        + [nl + i for i in (rpk or [])])
+        out.watermark_idx = lns.watermark_idx
+        return execu, out
 
     # ---- SELECT ---------------------------------------------------------
     def plan_query(self, q: A.Query) -> Tuple[Executor, Namespace]:
@@ -655,6 +748,28 @@ class Planner:
                for i in items):
             execu, ns, items = self._plan_over_window(execu, ns, items)
 
+        # set-returning functions in the SELECT list -> ProjectSet
+        # (`project_set.rs`); it subsumes the final projection
+        from ..ops.project_set import TABLE_FUNCTIONS
+        if any(isinstance(i.expr, A.FuncCall)
+               and i.expr.name.lower() in TABLE_FUNCTIONS
+               and i.expr.over is None for i in items):
+            if getattr(q, "emit_on_window_close", False):
+                raise ValueError("EMIT ON WINDOW CLOSE with set-returning "
+                                 "functions is not supported")
+            execu, ns = self._plan_project_set(execu, ns, items)
+            if q.distinct:
+                raise ValueError("SELECT DISTINCT with set-returning "
+                                 "functions is not supported")
+            if q.limit is not None:
+                order = [(ns.resolve(_order_name(e, ns)), d)
+                         for e, d in q.order_by] if q.order_by else []
+                st = self.make_state([c.dtype for c in ns.cols],
+                                     list(range(len(ns.cols))))
+                execu = TopNExecutor(execu, order, q.limit, q.offset or 0,
+                                     state_table=st)
+            return execu, ns
+
         # final projection; upstream stream-key columns ride along hidden
         # unless already selected, so the MV pk can preserve multiplicity
         # (StreamMaterialize pk derivation analog)
@@ -721,6 +836,36 @@ class Planner:
             execu = TopNExecutor(execu, order, q.limit, q.offset or 0,
                                  state_table=st)
         return execu, ns
+
+    def _plan_project_set(self, execu: Executor, ns: Namespace,
+                          items: List[A.SelectItem]
+                          ) -> Tuple[Executor, Namespace]:
+        """Lower the select list to ProjectSet items: scalar expressions
+        plus bound table functions, with the upstream stream key carried
+        hidden and `projected_row_id` completing the output identity."""
+        from ..ops import ProjectSetExecutor
+        from ..ops.project_set import TABLE_FUNCTIONS
+        b = Binder(ns)
+        ps_items: List[Tuple[str, Any]] = []
+        names: List[str] = []
+        for it in items:
+            e = it.expr
+            if isinstance(e, A.FuncCall) \
+                    and e.name.lower() in TABLE_FUNCTIONS and e.over is None:
+                tf = self._bind_table_function(e.name.lower(), e.args, b)
+                ps_items.append(("tf", tf))
+                names.append(it.alias or e.name.lower())
+            else:
+                be = b.bind(e)
+                ps_items.append(("s", be))
+                names.append(it.alias or _default_name(e))
+        n_visible = len(ps_items)
+        execu = ProjectSetExecutor(execu, ps_items, names,
+                                   carry=list(ns.stream_key))
+        cols = [ColumnEntry(None, f.name, f.dtype)
+                for f in execu.schema.fields]
+        sk = list(range(n_visible, len(cols)))
+        return execu, Namespace(cols, sk, n_visible)
 
     def _plan_now_filter(self, execu: Executor, ns: Namespace,
                          conj: A.ExprNode) -> Executor:

@@ -116,3 +116,165 @@ def test_hash64_never_hits_device_empty_sentinel():
     assert not (h == EMPTY_KEY).any()
     h2 = hash_columns64([col, col]).view(np.int64)
     assert not (h2 == EMPTY_KEY).any()
+
+
+# ---------------------------------------------------------------------------
+# round-3 advisor findings: exchange_net abort semantics, permit refunds,
+# U-pair frame splits, ctl device-mode auto-detection, UDF gating
+# ---------------------------------------------------------------------------
+
+
+def test_netchannel_abort_unblocks_sender_and_fails_wait_drained():
+    """A consumer that dies mid-stream must not hang a producer blocked on
+    channel capacity, and wait_drained must report the abort."""
+    import socket
+    import struct
+    import threading
+    import time
+
+    from risingwave_tpu.runtime.exchange_net import (ExchangeServer,
+                                                     _send_frame)
+
+    server = ExchangeServer()
+    ch = server.register(7, [T.INT64], capacity=2)
+    # connect, handshake, read nothing, then die
+    sock = socket.create_connection(server.addr)
+    _send_frame(sock, b"H", struct.pack(">H", 7))
+    time.sleep(0.1)
+
+    chunk = StreamChunk.from_rows([T.INT64], [(Op.INSERT, (1,))])
+    done = threading.Event()
+
+    def producer():
+        for _ in range(600):           # >> capacity + socket buffers
+            ch.send(chunk)
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not done.is_set(), \
+        "producer must be wedged on channel capacity before the kill"
+    sock.close()                       # consumer vanishes
+    t.join(timeout=10)
+    assert done.is_set(), "producer must unblock when the consumer dies"
+    assert ch.aborted
+    assert server.wait_drained(timeout=5) is False, \
+        "an aborted stream is not 'fully delivered'"
+    server.close()
+
+
+def test_zero_row_chunk_frames_refund_permits():
+    """Every C frame returns a permit, even one that decodes to zero rows
+    (e.g. a fully-invisible chunk) — otherwise credit drains away."""
+    from risingwave_tpu.core.schema import Schema
+    from risingwave_tpu.ops.message import Barrier, BarrierKind
+    from risingwave_tpu.core.epoch import EpochPair
+    from risingwave_tpu.runtime.exchange_net import (DEFAULT_PERMITS,
+                                                     ExchangeServer,
+                                                     RemoteInput)
+
+    server = ExchangeServer()
+    ch = server.register(3, [T.INT64])
+    # far more all-invisible chunks than the initial credit
+    empty = StreamChunk(
+        np.array([0], dtype=np.int8),
+        [Column(T.INT64, np.array([5], dtype=np.int64))],
+        visibility=np.array([False]))
+    for _ in range(2 * DEFAULT_PERMITS):
+        ch.send(empty)
+    ch.send(Barrier(EpochPair(2, 1), BarrierKind.CHECKPOINT, None))
+    ch.close()
+
+    inp = RemoteInput(server.addr, 3, Schema.of(("v", T.INT64)))
+    msgs = list(inp.execute())      # hangs forever if permits leak
+    assert any(isinstance(m, Barrier) for m in msgs)
+    assert server.wait_drained(timeout=5) is True
+    server.close()
+
+
+def test_update_pair_straddling_frame_split_degrades_to_delete_insert():
+    from risingwave_tpu.core.chunk import StreamChunkBuilder
+    from risingwave_tpu.runtime.exchange_net import (MAX_FRAME_ROWS,
+                                                     decode_chunk,
+                                                     encode_chunk_frames)
+
+    n_pairs = MAX_FRAME_ROWS // 2 + 2    # odd-aligns one pair on the split
+    builder = StreamChunkBuilder([T.INT64], max_chunk_size=1 << 22)
+    for i in range(n_pairs):
+        builder.append_row(Op.UPDATE_DELETE, (i,))
+        builder.append_row(Op.UPDATE_INSERT, (i,))
+    (chunk,) = builder.drain()
+    frames = encode_chunk_frames(chunk, [T.INT64])
+    assert len(frames) > 1
+    decoded = [decode_chunk(f, [T.INT64]) for f in frames]
+
+    def vis_ops(d):
+        vis = d.visibility if d.visibility is not None \
+            else np.ones(d.capacity, dtype=bool)
+        return [Op(int(o)) for o, v in zip(d.ops, vis) if v]
+
+    for d in decoded:
+        ops = vis_ops(d)
+        # no frame may end U- or begin with a dangling U+
+        assert not (ops and ops[-1] == Op.UPDATE_DELETE)
+        assert not (ops and ops[0] == Op.UPDATE_INSERT)
+    # multiset of (op-effect, value) is preserved: U-/U+ became D/I
+    total_del = sum(1 for d in decoded for o in vis_ops(d) if o.is_delete)
+    assert total_del == n_pairs
+
+
+def test_ctl_dump_metrics_open_device_data_dir(tmp_path, monkeypatch):
+    """dump/metrics on a device-mode data dir must adopt its policy (and
+    not stamp markers onto unmarked directories)."""
+    from risingwave_tpu.config import DeviceConfig
+    from risingwave_tpu.ctl import main as ctl_main
+    from risingwave_tpu.sql import Database
+
+    d = str(tmp_path / "data")
+    db = Database(data_dir=d, device=DeviceConfig(capacity=64))
+    db.run("CREATE TABLE t (v BIGINT)")
+    db.run("INSERT INTO t VALUES (1), (2)")
+    db.tick()
+    del db
+
+    rc = ctl_main(["dump", "t", "--data-dir", d])
+    assert rc == 0
+    rc = ctl_main(["metrics", "--data-dir", d])
+    assert rc == 0
+
+
+def test_pgwire_rejects_embedded_udf_by_default():
+    """A network client must not be able to exec() code in the server
+    process unless the operator opted in."""
+    from risingwave_tpu.pgwire import PgServer
+    from risingwave_tpu.sql import Database
+    from tests.test_pgwire import MiniClient
+
+    db = Database()
+    server = PgServer(db).start()
+    try:
+        c = MiniClient(server.host, server.port)
+        c.startup()
+        msgs = c.query("CREATE FUNCTION boom(x int) RETURNS int"
+                       " LANGUAGE python AS $$\ndef boom(x):\n"
+                       "    return x\n$$")
+        assert any(t == b"E" for t, _ in msgs), "UDF must be rejected"
+        # the SAME db's local (in-process) API stays ungated — the gate is
+        # per-connection, not a global flag stamped onto the Database
+        db.run("CREATE FUNCTION twice(x int) RETURNS int LANGUAGE python"
+               " AS $$\ndef twice(x):\n    return 2 * x\n$$")
+    finally:
+        server.stop()
+    # and an opted-in server accepts it
+    db3 = Database()
+    server3 = PgServer(db3, enable_embedded_udf=True).start()
+    try:
+        c = MiniClient(server3.host, server3.port)
+        c.startup()
+        msgs = c.query("CREATE FUNCTION thrice(x int) RETURNS int"
+                       " LANGUAGE python AS $$\ndef thrice(x):\n"
+                       "    return 3 * x\n$$")
+        assert not any(t == b"E" for t, _ in msgs)
+    finally:
+        server3.stop()

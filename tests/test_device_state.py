@@ -34,7 +34,8 @@ def test_merge_insert_update_delete():
           jnp.asarray([20, 10, 0, 0], dtype=jnp.int64)]
     st, needed = merge(st, dk, dv, [ReduceKind.SUM, ReduceKind.SUM])
     assert int(needed) == 2 and np_state(st) == {1: (2, 20), 2: (1, 10)}
-    # retract key 2 fully, update key 1, insert 7
+    # retract key 2 fully, update key 1, insert 7 — delta deliberately
+    # UNSORTED: merge's variadic sort handles any delta order
     dk = jnp.asarray([2, 1, 7, int(EMPTY_KEY)], dtype=jnp.int64)
     dv = [jnp.asarray([-1, 1, 3, 0], dtype=jnp.int64),
           jnp.asarray([-10, 5, 7, 0], dtype=jnp.int64)]
@@ -148,3 +149,29 @@ def test_capacity_growth():
     ch = agg.flush_epoch()
     assert int(ch["count"]) == 1000
     assert agg.state.capacity >= 1000 and int(agg.state.count) == 1000
+
+
+def test_sort_cols_stable_and_compact_rows():
+    """Variadic-sort building blocks: stable multi-key sort + stable
+    front-compaction with fills (the merge kernels' primitives)."""
+    from risingwave_tpu.device.sorted_state import compact_rows, sort_cols
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        n = int(rng.integers(1, 60))
+        k1 = rng.integers(0, 6, size=n).astype(np.int64)
+        k2 = rng.integers(0, 6, size=n).astype(np.int64)
+        v = np.arange(n, dtype=np.int64)
+        (s1, s2), (sv,) = sort_cols([jnp.asarray(k1), jnp.asarray(k2)],
+                                    [jnp.asarray(v)])
+        order = np.lexsort((v, k2, k1))   # stable: position breaks ties
+        assert list(np.asarray(s1)) == list(k1[order])
+        assert list(np.asarray(s2)) == list(k2[order])
+        assert list(np.asarray(sv)) == list(v[order])
+        # compact: keep even-valued rows, truncate to n, fill with -1
+        alive = (sv % 2) == 0
+        out = compact_rows(alive, [s1], [sv], n, [-1, -1])
+        want = [int(x) for x, a in zip(np.asarray(sv), np.asarray(alive))
+                if a]
+        got = list(np.asarray(out[1]))
+        assert got[:len(want)] == want
+        assert all(x == -1 for x in got[len(want):])

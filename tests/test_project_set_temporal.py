@@ -1,0 +1,202 @@
+"""ProjectSet / table-function scan / temporal join.
+
+Reference semantics: `src/stream/src/executor/project/project_set.rs`
+(PG-style zip with NULL padding, projected_row_id identity),
+`src/expr/impl/src/table_function/generate_series.rs` (inclusive bounds,
+zero step errors), `src/stream/src/executor/temporal_join.rs:44`
+(version-table lookups, append-only output, no retraction on version
+change).
+"""
+import pytest
+
+from risingwave_tpu.sql import Database
+
+
+def nsort(rows):
+    return sorted(rows, key=lambda r: tuple((v is None, v) for v in r))
+
+
+def ticks(db, n=3):
+    for _ in range(n):
+        db.tick()
+
+
+# ---------------------------------------------------------------------------
+# FROM-clause table functions
+# ---------------------------------------------------------------------------
+
+
+def test_generate_series_batch():
+    db = Database()
+    assert db.query("SELECT * FROM generate_series(1, 5)") == \
+        [(i,) for i in range(1, 6)]
+    assert db.query("SELECT * FROM generate_series(1, 10, 3)") == \
+        [(1,), (4,), (7,), (10,)]
+    assert db.query("SELECT * FROM generate_series(5, 1, -2)") == \
+        [(5,), (3,), (1,)]
+    # empty series
+    assert db.query("SELECT * FROM generate_series(5, 1)") == []
+
+
+def test_generate_series_zero_step_errors():
+    db = Database()
+    with pytest.raises(Exception, match="step"):
+        db.query("SELECT * FROM generate_series(1, 5, 0)")
+
+
+def test_generate_series_timestamps():
+    db = Database()
+    rows = db.query(
+        "SELECT * FROM generate_series("
+        "CAST('2024-01-01 00:00:00' AS TIMESTAMP),"
+        "CAST('2024-01-01 02:00:00' AS TIMESTAMP),"
+        "INTERVAL '1' HOUR)")
+    assert len(rows) == 3
+
+
+def test_unnest_batch_and_mv():
+    db = Database()
+    assert db.query("SELECT * FROM unnest(ARRAY[3, 1, 2])") == \
+        [(3,), (1,), (2,)]
+    db.run("CREATE MATERIALIZED VIEW u AS"
+           " SELECT * FROM unnest(ARRAY[7, 7, 8])")
+    ticks(db)
+    # duplicates preserved: _row_id keeps multiset identity
+    assert nsort(db.query("SELECT * FROM u")) == [(7,), (7,), (8,)]
+
+
+def test_mv_over_generate_series():
+    db = Database()
+    db.run("CREATE MATERIALIZED VIEW gs AS"
+           " SELECT * FROM generate_series(2, 6, 2)")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM gs")) == [(2,), (4,), (6,)]
+
+
+# ---------------------------------------------------------------------------
+# ProjectSet (SRF in the SELECT list)
+# ---------------------------------------------------------------------------
+
+
+def test_project_set_expands_and_retracts():
+    db = Database()
+    db.run("CREATE TABLE t (a BIGINT, b BIGINT)")
+    db.run("INSERT INTO t VALUES (1, 3), (10, 11)")
+    db.tick()
+    db.run("CREATE MATERIALIZED VIEW ps AS"
+           " SELECT a, generate_series(a, b) AS g FROM t")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM ps")) == \
+        [(1, 1), (1, 2), (1, 3), (10, 10), (10, 11)]
+    # deletes retract exactly the expanded rows (deterministic expansion)
+    db.run("DELETE FROM t WHERE a = 1")
+    db.run("INSERT INTO t VALUES (20, 20)")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM ps")) == \
+        [(10, 10), (10, 11), (20, 20)]
+
+
+def test_project_set_zip_null_padding():
+    """PG >= 10: multiple SRFs zip to the longest, shorter ones NULL-pad."""
+    db = Database()
+    db.run("CREATE TABLE t (a BIGINT, b BIGINT)")
+    db.run("INSERT INTO t VALUES (10, 11)")
+    db.tick()
+    db.run("CREATE MATERIALIZED VIEW z AS SELECT a,"
+           " generate_series(1, 2) AS g, unnest(ARRAY[a, b, 99]) AS u"
+           " FROM t")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM z")) == \
+        [(10, 1, 10), (10, 2, 11), (10, None, 99)]
+
+
+def test_project_set_empty_expansion_drops_row():
+    db = Database()
+    db.run("CREATE TABLE t (a BIGINT, b BIGINT)")
+    db.run("INSERT INTO t VALUES (5, 1), (1, 2)")   # (5,1): empty series
+    db.tick()
+    db.run("CREATE MATERIALIZED VIEW e AS"
+           " SELECT generate_series(a, b) AS g FROM t")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM e")) == [(1,), (2,)]
+
+
+# ---------------------------------------------------------------------------
+# temporal join
+# ---------------------------------------------------------------------------
+
+
+def _dim_fact():
+    db = Database()
+    db.run("CREATE TABLE dim (k BIGINT PRIMARY KEY, name VARCHAR)")
+    db.run("INSERT INTO dim VALUES (1, 'one'), (2, 'two')")
+    db.tick()
+    db.run("CREATE TABLE fact (k BIGINT, v BIGINT)")
+    return db
+
+
+def test_temporal_join_inner_lookup():
+    db = _dim_fact()
+    db.run("CREATE MATERIALIZED VIEW tj AS SELECT f.v, d.name FROM fact f"
+           " JOIN dim FOR SYSTEM_TIME AS OF PROCTIME() AS d"
+           " ON f.k = d.k")
+    db.run("INSERT INTO fact VALUES (1, 100), (3, 300)")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM tj")) == [(100, "one")]
+
+
+def test_temporal_join_no_retraction_on_version_change():
+    """The defining temporal-join property: emitted rows are frozen; only
+    NEW stream rows see the new version (`temporal_join.rs` semantics)."""
+    db = _dim_fact()
+    db.run("CREATE MATERIALIZED VIEW tj AS SELECT f.v, d.name FROM fact f"
+           " JOIN dim FOR SYSTEM_TIME AS OF PROCTIME() AS d"
+           " ON f.k = d.k")
+    db.run("INSERT INTO fact VALUES (1, 100)")
+    ticks(db)
+    db.run("UPDATE dim SET name = 'uno' WHERE k = 1")
+    db.tick()
+    db.run("INSERT INTO fact VALUES (1, 101)")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM tj")) == \
+        [(100, "one"), (101, "uno")]
+    # a version DELETE doesn't retract either; new rows just stop matching
+    db.run("DELETE FROM dim WHERE k = 1")
+    db.tick()
+    db.run("INSERT INTO fact VALUES (1, 102)")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM tj")) == \
+        [(100, "one"), (101, "uno")]
+
+
+def test_temporal_join_left_outer():
+    db = _dim_fact()
+    db.run("CREATE MATERIALIZED VIEW tj AS SELECT f.v, d.name FROM fact f"
+           " LEFT JOIN dim FOR SYSTEM_TIME AS OF PROCTIME() AS d"
+           " ON f.k = d.k")
+    db.run("INSERT INTO fact VALUES (1, 100), (3, 300)")
+    ticks(db)
+    assert nsort(db.query("SELECT * FROM tj")) == \
+        [(100, "one"), (300, None)]
+
+
+def test_temporal_join_recovery(tmp_path):
+    """The version index is state-backed: a restarted process rebuilds it
+    and new stream rows look up the committed version."""
+    d = str(tmp_path / "data")
+    db = Database(data_dir=d)
+    db.run("CREATE TABLE dim (k BIGINT PRIMARY KEY, name VARCHAR)")
+    db.run("INSERT INTO dim VALUES (1, 'one')")
+    db.tick()
+    db.run("CREATE TABLE fact (k BIGINT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW tj AS SELECT f.v, d.name FROM fact f"
+           " JOIN dim FOR SYSTEM_TIME AS OF PROCTIME() AS d"
+           " ON f.k = d.k")
+    db.run("INSERT INTO fact VALUES (1, 100)")
+    ticks(db)
+    del db
+    db2 = Database(data_dir=d)
+    db2.run("INSERT INTO fact VALUES (1, 200)")
+    ticks(db2)
+    assert nsort(db2.query("SELECT * FROM tj")) == \
+        [(100, "one"), (200, "one")]
