@@ -63,18 +63,18 @@ BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
            " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
            " extra VARCHAR) WITH (connector='nexmark',"
            " nexmark.table='bid', nexmark.max.events='{n}',"
-           " nexmark.chunk.size='8192')")
+           " nexmark.chunk.size='{c}')")
 AUCTION_SRC = ("CREATE SOURCE auction (id BIGINT, item_name VARCHAR,"
                " description VARCHAR, initial_bid BIGINT, reserve BIGINT,"
                " date_time TIMESTAMP, expires TIMESTAMP, seller BIGINT,"
                " category BIGINT, extra VARCHAR) WITH (connector='nexmark',"
                " nexmark.table='auction', nexmark.max.events='{n}',"
-               " nexmark.chunk.size='8192')")
+               " nexmark.chunk.size='{c}')")
 PERSON_SRC = ("CREATE SOURCE person (id BIGINT, name VARCHAR,"
               " email_address VARCHAR, credit_card VARCHAR, city VARCHAR,"
               " state VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
               " WITH (connector='nexmark', nexmark.table='person',"
-              " nexmark.max.events='{n}', nexmark.chunk.size='8192')")
+              " nexmark.max.events='{n}', nexmark.chunk.size='{c}')")
 
 Q4_MV = ("CREATE MATERIALIZED VIEW q4 AS SELECT auction, count(*) AS c,"
          " sum(price) AS s, max(price) AS m FROM bid GROUP BY auction")
@@ -329,7 +329,7 @@ def _device_cfg(on, capacity):
 def _q4_db(on, n_events):
     from risingwave_tpu.sql import Database
     db = Database(device=_device_cfg(on, 1 << 20))
-    db.run(BID_SRC.format(n=n_events))
+    db.run(BID_SRC.format(n=n_events, c=8192))
     db.run(Q4_MV)
     dt = drive(db, n_events)
     rows = db.query("SELECT * FROM q4")
@@ -357,17 +357,22 @@ def stage_q4_host(n_events):
     return {"q4_sql_host": {"host_sql_eps": round(eps), "events": n_events}}
 
 
+QX_CHUNK = 2048   # smaller fused epochs: q5's hop(5x)+agg cascade compiles
+                  # ~25x smaller programs than at 8192 (remote-compile RAM
+                  # killed the big ones), and growth replays stay short
+
+
 def _qx_db(on, n_events, capacity):
     """q5+q7+q8 in one database (sources shared, compile cache shared)."""
     from risingwave_tpu.sql import Database
     db = Database(device=_device_cfg(on, capacity))
-    db.run(BID_SRC.format(n=n_events))
-    db.run(AUCTION_SRC.format(n=n_events))
-    db.run(PERSON_SRC.format(n=n_events))
+    db.run(BID_SRC.format(n=n_events, c=QX_CHUNK))
+    db.run(AUCTION_SRC.format(n=n_events, c=QX_CHUNK))
+    db.run(PERSON_SRC.format(n=n_events, c=QX_CHUNK))
     db.run(Q5_MV)
     db.run(Q7_MV)
     db.run(Q8_MV)
-    dt = drive(db, n_events)
+    dt = drive(db, n_events, chunk=QX_CHUNK)
     out = {
         "q5": db.query("SELECT * FROM nexmark_q5"),
         "q7": db.query("SELECT * FROM nexmark_q7"),

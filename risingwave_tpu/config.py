@@ -146,6 +146,10 @@ SESSION_VAR_DEFAULTS: Dict[str, Any] = {
     "timezone": "UTC",
     "query_mode": "auto",
     "streaming_parallelism": 0,        # 0 = use the device config default
+    # 'local' = parallel fragments as in-process generators (topology
+    # only); 'process' = worker OS processes over the credit-flow exchange
+    # (real CPU parallelism — the compute-node placement analog)
+    "streaming_placement": "local",
     "application_name": "",
     "extra_float_digits": 1,
 }

@@ -14,6 +14,9 @@ Commands:
     dump NAME [--limit N]     rows of an object's state table
     compact                   merge every table's runs into one base
     metrics                   Prometheus exposition after recovery
+    trace [--last N]          per-barrier span summary; flags OPEN
+                              (stalled) epochs with the stuck job —
+                              works on a LIVE or wedged data dir
 """
 from __future__ import annotations
 
@@ -147,6 +150,19 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Offline barrier-span summary (`monitor_service.rs:82` await-tree
+    analog): reads the data dir's trace log without opening the Database,
+    so it works against a WEDGED process's directory too."""
+    from ..utils.trace import TRACE_FILE, diagnose
+    path = os.path.join(args.data_dir, TRACE_FILE)
+    if not os.path.exists(path):
+        print("no barrier trace (directory has no barrier_trace.jsonl)")
+        return 1
+    print(diagnose(path, last=args.last))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m risingwave_tpu.ctl",
@@ -163,5 +179,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--limit", type=int, default=None)
     sp.set_defaults(fn=cmd_dump)
+    sp = sub.add_parser("trace")
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--last", type=int, default=5)
+    sp.set_defaults(fn=cmd_trace)
     args = p.parse_args(argv)
     return args.fn(args)

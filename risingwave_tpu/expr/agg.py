@@ -39,7 +39,12 @@ class AggCall:
             self.return_type = T.INT64
         elif self.arg is not None:
             at = self.arg.return_type
-            if self.kind == "sum":
+            if self.kind == "sum0":
+                # type-preserving sum (the reference's `sum0`): merges
+                # partial counts/sums in 2-phase aggregation without PG's
+                # sum widening (sum of partial bigint counts stays bigint)
+                self.return_type = at
+            elif self.kind == "sum":
                 # PG: sum(int) -> bigint, sum(bigint) -> numeric
                 if at.kind in (TypeKind.INT16, TypeKind.INT32):
                     self.return_type = T.INT64
@@ -225,7 +230,7 @@ def create_agg_state(call: AggCall) -> AggState:
     k = call.kind
     if k == "count":
         return CountState()
-    if k == "sum":
+    if k in ("sum", "sum0"):
         return SumState(call.return_type.kind == TypeKind.DECIMAL)
     if k == "avg":
         return AvgState(call.return_type.kind == TypeKind.DECIMAL)
@@ -248,8 +253,9 @@ def create_agg_state(call: AggCall) -> AggState:
     raise ValueError(f"unknown aggregate {k}")
 
 
-AGG_KINDS = {"count", "sum", "avg", "min", "max", "bool_and", "bool_or",
-             "first_value", "last_value", "string_agg", "approx_count_distinct"}
+AGG_KINDS = {"count", "sum", "sum0", "avg", "min", "max", "bool_and",
+             "bool_or", "first_value", "last_value", "string_agg",
+             "approx_count_distinct"}
 
 # Aggregates whose device (HBM slot) implementation is exact under retraction.
 DEVICE_RETRACTABLE = {"count", "sum", "avg"}

@@ -322,6 +322,58 @@ class SimpleAggExecutor(UnaryExecutor):
             self.state_table.commit(barrier.epoch.curr)
 
 
+class StatelessPartialAggExecutor(UnaryExecutor):
+    """Grouped per-chunk partial aggregation with NO cross-epoch state —
+    the pre-shuffle stage of 2-phase aggregation (`stateless_simple_agg.rs`
+    generalized with a group key, as the reference's batch/stream 2-phase
+    agg rewrite plans it). Partials accumulate across the EPOCH and flush
+    one INSERT row per touched group at the barrier: (group cols...,
+    partial outputs...) — epoch granularity is what makes the reduction
+    effective (per-chunk partials barely compress keys that cluster over
+    time, like nexmark auction ids). Downstream merges with sum0/min/max.
+    Statelessness ACROSS barriers is the recovery story for remote
+    placement: a killed worker loses only uncommitted-epoch partials,
+    which the barrier protocol discards anyway."""
+
+    def __init__(self, input: Executor, group_indices: Sequence[int],
+                 calls: Sequence[AggCall]):
+        if not input.append_only:
+            raise ValueError("stateless partial aggregation requires an "
+                             "append-only input")
+        gfields = [input.schema.fields[i] for i in group_indices]
+        fields = gfields + [Field(f"agg#{i}", c.return_type)
+                            for i, c in enumerate(calls)]
+        super().__init__(input, Schema(fields), "StatelessPartialAgg")
+        self.append_only = True
+        self.group_key_indices = list(group_indices)
+        self.calls = list(calls)
+        self._groups: dict = {}
+
+    def on_chunk(self, chunk: StreamChunk) -> Iterator[Message]:
+        chunk = chunk.compact()
+        agg_vals = _eval_agg_inputs(self.calls, chunk)
+        signs = chunk.signs()
+        rows = chunk.data_chunk().rows()
+        for i, row in enumerate(rows):
+            if signs[i] < 0:
+                raise ValueError("retraction reached a stateless partial "
+                                 "aggregation (append-only violated)")
+            key = tuple(row[j] for j in self.group_key_indices)
+            g = self._groups.get(key)
+            if g is None:
+                g = self._groups[key] = AggGroup(self.calls)
+            g.apply(1, [v[i] for v in agg_vals])
+        return iter(())
+
+    def on_barrier(self, barrier: Barrier) -> Iterator[Message]:
+        if self._groups:
+            yield StreamChunk.from_rows(
+                self.schema.dtypes,
+                [(Op.INSERT, key + g.output())
+                 for key, g in self._groups.items()])
+            self._groups = {}
+
+
 class StatelessSimpleAggExecutor(UnaryExecutor):
     """Per-chunk partial aggregation emitted immediately — the pre-shuffle
     local agg (`stateless_simple_agg.rs`). Output rows are partial states

@@ -34,6 +34,7 @@ class Channel:
     def __init__(self, capacity: int = 64):
         self.capacity = capacity
         self.buf: Deque[Message] = deque()
+        self.closed = False          # producer done: recv drains then ends
 
     def try_send(self, msg: Message) -> bool:
         if isinstance(msg, StreamChunk) and self._data_len() >= self.capacity:
@@ -48,6 +49,9 @@ class Channel:
             raise RuntimeError("channel full: downstream not consuming "
                                "(permit backpressure would block here)")
 
+    def close(self) -> None:
+        self.closed = True
+
     def _data_len(self) -> int:
         return sum(1 for m in self.buf if isinstance(m, StreamChunk))
 
@@ -56,6 +60,52 @@ class Channel:
 
     def __len__(self) -> int:
         return len(self.buf)
+
+
+class ThreadedChannel(Channel):
+    """Channel with real blocking semantics for producer/consumer threads
+    or background socket drains: send blocks on capacity, recv stays
+    non-blocking (MergeExecutor polls), and a shared condition lets a
+    consumer sleep until ANY of its inputs has data (`wait`)."""
+
+    def __init__(self, capacity: int = 64, cond=None):
+        import threading
+        super().__init__(capacity)
+        self.cv = cond or threading.Condition()
+
+    def try_send(self, msg: Message) -> bool:
+        with self.cv:
+            if not super().try_send(msg):
+                return False
+            self.cv.notify_all()
+            return True
+
+    def send(self, msg: Message) -> None:
+        with self.cv:
+            while isinstance(msg, StreamChunk) \
+                    and self._data_len() >= self.capacity and not self.closed:
+                self.cv.wait(1.0)
+            if self.closed and isinstance(msg, StreamChunk):
+                return               # consumer gone; chunks are droppable
+            self.buf.append(msg)
+            self.cv.notify_all()
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+
+    def recv(self) -> Optional[Message]:
+        with self.cv:
+            msg = self.buf.popleft() if self.buf else None
+            if msg is not None:
+                self.cv.notify_all()    # wake a send() blocked on capacity
+            return msg
+
+    def wait(self, timeout: float = 0.05) -> None:
+        with self.cv:
+            if not self.buf and not self.closed:
+                self.cv.wait(timeout)
 
 
 class DispatchExecutor:
@@ -106,19 +156,21 @@ class DispatchExecutor:
         vnodes = compute_vnodes([chunk.columns[i] for i in self.key_indices],
                                 self.vnode_count)
         out_of_row = self.vnode_to_out[vnodes]
-        ops = chunk.ops.copy()
+        ops = chunk.ops
         # U-pair fixing: when the two halves of an update pair land on
         # different outputs, degrade them to Delete + Insert so each side
-        # sees a self-consistent chunk (dispatch.rs:891-909)
-        i = 0
-        while i < n - 1:
-            if ops[i] == Op.UPDATE_DELETE and ops[i + 1] == Op.UPDATE_INSERT \
-                    and out_of_row[i] != out_of_row[i + 1]:
-                ops[i] = Op.DELETE
-                ops[i + 1] = Op.INSERT
-                i += 2
-            else:
-                i += 1
+        # sees a self-consistent chunk (dispatch.rs:891-909). Vectorized:
+        # hits are (U-, U+) adjacencies split across outputs — they cannot
+        # overlap (a row can't be both U- and U+), so a bulk write is safe.
+        # Append-only streams skip this entirely.
+        if (ops >= Op.UPDATE_DELETE).any():
+            ops = ops.copy()
+            split = np.flatnonzero(
+                (ops[:-1] == Op.UPDATE_DELETE)
+                & (ops[1:] == Op.UPDATE_INSERT)
+                & (out_of_row[:-1] != out_of_row[1:]))
+            ops[split] = Op.DELETE
+            ops[split + 1] = Op.INSERT
         for oi, ch in enumerate(self.outputs):
             vis = out_of_row == oi
             if not vis.any():
@@ -127,7 +179,8 @@ class DispatchExecutor:
 
     def pump_until_barrier(self) -> Optional[Barrier]:
         """Forward messages until a barrier; the barrier goes to EVERY
-        output (Chandy-Lamport marker fan-out)."""
+        output (Chandy-Lamport marker fan-out). Exhaustion closes the
+        outputs so consumers (local fragments or remote workers) see EOS."""
         if self._iter is None:
             self._iter = self.input.execute()
         for msg in self._iter:
@@ -141,6 +194,10 @@ class DispatchExecutor:
             elif isinstance(msg, Watermark):
                 for ch in self.outputs:
                     ch.send(msg)
+        for ch in self.outputs:
+            close = getattr(ch, "close", None)
+            if close:
+                close()
         return None
 
 
@@ -186,6 +243,7 @@ class FragmentPump:
             self.out.send(msg)
             if isinstance(msg, Barrier):
                 return msg
+        self.out.close()
         return None
 
 
@@ -205,6 +263,10 @@ class MergeExecutor(Executor):
         self.pumps = list(pumps)   # upstream dispatchers to drive on demand
         self._wm: List[Optional[int]] = [None] * len(inputs)
         self._wm_emitted: Optional[int] = None
+        # hook polled while idle-waiting: remote deployments raise here
+        # when a worker died, instead of spinning on a barrier that will
+        # never align (the failure-detection seam)
+        self.health_check = lambda: None
 
     def execute(self) -> Iterator[Message]:
         n = len(self.inputs)
@@ -239,12 +301,21 @@ class MergeExecutor(Executor):
                 pending_barrier = [None] * n
                 continue
             if not progressed:
+                self.health_check()
                 # all unblocked channels empty: drive the upstream pumps
-                if not self.pumps:
-                    return
                 done = True
                 for p in self.pumps:
                     if p.pump_until_barrier() is not None:
                         done = False
-                if done:
+                if not done:
+                    continue
+                # pumps exhausted. Inputs backed by threads/processes may
+                # still be computing: drain until every channel is closed.
+                if all(ch.closed and len(ch) == 0 for ch in self.inputs):
                     return
+                waiter = next((ch for ch in self.inputs
+                               if hasattr(ch, "wait")
+                               and not (ch.closed and len(ch) == 0)), None)
+                if waiter is None:
+                    return     # plain channels: nothing will ever arrive
+                waiter.wait(0.05)

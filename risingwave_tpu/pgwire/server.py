@@ -59,6 +59,73 @@ def _text(v: Any, kind: Optional[TypeKind] = None) -> Optional[bytes]:
     return str(v).encode("utf-8")
 
 
+# OIDs whose text values are numeric/bool literals — substituted unquoted
+_UNQUOTED_OIDS = {16, 20, 21, 23, 700, 701, 1700}
+
+
+def _sql_segments(sql: str):
+    """(text, is_string_literal) segments — $n inside '...' is literal."""
+    out = []
+    i = 0
+    while i < len(sql):
+        if sql[i] == "'":
+            j = i + 1
+            while j < len(sql):
+                if sql[j] == "'" and j + 1 < len(sql) and sql[j + 1] == "'":
+                    j += 2
+                    continue
+                if sql[j] == "'":
+                    break
+                j += 1
+            out.append((sql[i:j + 1], True))
+            i = j + 1
+        else:
+            j = sql.find("'", i)
+            if j == -1:
+                j = len(sql)
+            out.append((sql[i:j], False))
+            i = j
+    return out
+
+
+def _count_params(sql: str) -> int:
+    import re
+    n = 0
+    for seg, lit in _sql_segments(sql):
+        if not lit:
+            for m in re.finditer(r"\$(\d+)", seg):
+                n = max(n, int(m.group(1)))
+    return n
+
+
+def _substitute_params(sql: str, values, param_oids=()) -> str:
+    """Inline $n placeholders as SQL literals (text-format Bind values).
+    The reference binds parameters into the bound statement's datums
+    (`pg_extended.rs`); a lite frontend reaches the same semantics by
+    substitution before planning. Quoting: numeric/bool OIDs (and
+    numeric-looking values of unknown OID) go bare; everything else as a
+    quoted string, which the binder's casts coerce."""
+    import re
+
+    def repl(m):
+        i = int(m.group(1)) - 1
+        if i >= len(values):
+            raise ValueError(f"no value for placeholder ${i + 1}")
+        v = values[i]
+        if v is None:
+            return "NULL"
+        oid = param_oids[i] if i < len(param_oids) else 0
+        if oid in _UNQUOTED_OIDS or (oid == 0 and re.fullmatch(
+                r"-?\d+(\.\d+)?([eE][+-]?\d+)?", v)):
+            return v
+        return "'" + v.replace("'", "''") + "'"
+
+    out = []
+    for seg, lit in _sql_segments(sql):
+        out.append(seg if lit else re.sub(r"\$(\d+)", repl, seg))
+    return "".join(out)
+
+
 class _Conn:
     def __init__(self, sock: socket.socket, db, lock: threading.Lock):
         self.sock = sock
@@ -203,7 +270,8 @@ class _Conn:
                     self._send(b"C", self._tag(result, 0).encode() + b"\0")
         return True
 
-    def _describe_sql(self, sql: Optional[str], statement: bool) -> None:
+    def _describe_sql(self, sql: Optional[str], statement: bool,
+                      param_oids: Tuple[int, ...] = ()) -> None:
         """Describe: RowDescription for a SELECT, NoData otherwise —
         drivers bind result handling off this answer. Statement-describe
         additionally answers ParameterDescription first (pgjdbc sends
@@ -211,9 +279,14 @@ class _Conn:
         from ..sql import ast as A
         from ..sql.parser import parse_sql
         if statement:
-            self._send(b"t", struct.pack(">H", 0))   # no parameters
+            n = max(len(param_oids), _count_params(sql or ""))
+            oids = list(param_oids) + [0] * (n - len(param_oids))
+            self._send(b"t", struct.pack(">H", n)
+                       + b"".join(struct.pack(">I", o) for o in oids))
+        probe = _substitute_params(sql or "", ["0"] * _count_params(sql or ""),
+                                   param_oids) if sql else sql
         try:
-            stmts = parse_sql(sql or "")
+            stmts = parse_sql(probe or "")
         except Exception:  # noqa: BLE001 — surfaces at Execute
             self._send(b"n")
             return
@@ -223,6 +296,39 @@ class _Conn:
             self._row_description(desc)
         else:
             self._send(b"n")
+
+    def _bind(self, body: bytes, parse_sql_by_name) -> str:
+        """Bind: substitute text-format parameter values into the prepared
+        statement's SQL (`pg_extended.rs` bind analog)."""
+        _portal, rest = body.split(b"\0", 1)
+        stmt_name, rest = rest.split(b"\0", 1)
+        if stmt_name not in parse_sql_by_name:
+            raise KeyError("prepared statement does not exist")
+        sql, oids = parse_sql_by_name[stmt_name]
+        (nfmt,) = struct.unpack(">H", rest[:2])
+        fmts = struct.unpack(f">{nfmt}H", rest[2:2 + 2 * nfmt])
+        pos = 2 + 2 * nfmt
+        (nvals,) = struct.unpack(">H", rest[pos:pos + 2])
+        pos += 2
+        values = []
+        for i in range(nvals):
+            (ln,) = struct.unpack(">i", rest[pos:pos + 4])
+            pos += 4
+            if ln < 0:
+                values.append(None)
+                continue
+            raw = rest[pos:pos + ln]
+            pos += ln
+            fmt = fmts[i] if i < len(fmts) else (fmts[0] if fmts else 0)
+            if fmt == 1:
+                raise ValueError("binary-format parameters are not "
+                                 "supported (send text format)")
+            values.append(raw.decode("utf-8"))
+        need = _count_params(sql)
+        if nvals < need:
+            raise ValueError(f"bind supplies {nvals} parameters, "
+                             f"statement needs {need}")
+        return _substitute_params(sql, values, oids)
 
     # ---- protocol loop --------------------------------------------------
     def serve(self) -> None:
@@ -251,14 +357,18 @@ class _Conn:
                 self._ready()
             elif tag == b"P":                            # Parse
                 name, rest = body.split(b"\0", 1)
-                sql, _rest = rest.split(b"\0", 1)
-                parse_sql_by_name[name] = sql.decode("utf-8")
+                sql, rest = rest.split(b"\0", 1)
+                (nparams,) = struct.unpack(">H", rest[:2])
+                oids = struct.unpack(f">{nparams}I", rest[2:2 + 4 * nparams])
+                parse_sql_by_name[name] = (sql.decode("utf-8"), oids)
                 self._send(b"1")
             elif tag == b"B":                            # Bind
-                portal, rest = body.split(b"\0", 1)
-                stmt_name, _ = rest.split(b"\0", 1)
-                self._portal_sql = parse_sql_by_name.get(stmt_name)
-                self._send(b"2")
+                try:
+                    self._portal_sql = self._bind(body, parse_sql_by_name)
+                    self._send(b"2")
+                except Exception as e:  # noqa: BLE001
+                    self._error(f"{type(e).__name__}: {e}", "08P01")
+                    skip_until_sync = True
             elif tag == b"D":                            # Describe
                 kind, name = body[:1], body[1:].split(b"\0", 1)[0]
                 try:
@@ -266,8 +376,9 @@ class _Conn:
                         if name not in parse_sql_by_name:
                             raise KeyError("prepared statement does not "
                                            "exist")
-                        self._describe_sql(parse_sql_by_name[name],
-                                           statement=True)
+                        sql, oids = parse_sql_by_name[name]
+                        self._describe_sql(sql, statement=True,
+                                           param_oids=oids)
                     else:
                         self._describe_sql(self._portal_sql, statement=False)
                 except Exception as e:  # noqa: BLE001 — e.g. unknown table

@@ -34,7 +34,9 @@ import threading
 from collections import deque
 from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
-from ..core.chunk import Op, StreamChunk, StreamChunkBuilder
+import numpy as np
+
+from ..core.chunk import Column, Op, StreamChunk, StreamChunkBuilder
 from ..core.dtypes import DataType, TypeKind
 from ..core.encoding import decode_value_datum, encode_row
 from ..core.epoch import EpochPair
@@ -120,6 +122,67 @@ def encode_chunk_frames(chunk: StreamChunk, dtypes: Sequence[DataType]
     return out or [struct.pack(">H", 0)]
 
 
+def encode_chunk_columnar(chunk: StreamChunk,
+                          dtypes: Sequence[DataType]) -> bytes:
+    """K-frame body: one whole chunk, COLUMNAR — ops as raw int8, per
+    column a packed validity bitmap plus either the raw fixed-width value
+    buffer (little-endian numpy) or a pickled scalar list for
+    object-dtype columns (varchar/decimal/interval). Vectorized at
+    numpy/pickle speed, ~100x cheaper than the per-row value encoding —
+    the C frame remains as the row-exact format shared with state-table
+    bytes; data-plane chunks ride K. Frames never split (u32 row count),
+    so U-pairs stay intact. Pickle is acceptable here for the same reason
+    the reference trusts its intra-cluster gRPC peers: both stream ends
+    are this framework's own processes."""
+    import pickle
+    chunk = chunk.compact()
+    n = chunk.capacity
+    parts = [struct.pack(">I", n), chunk.ops.astype(np.int8).tobytes()]
+    for col in chunk.columns:
+        vb = np.packbits(col.validity).tobytes()
+        if col.dtype.np_dtype == np.dtype(object):
+            payload = pickle.dumps(col.values.tolist(), protocol=5)
+            tag = 1
+        else:
+            payload = col.values.tobytes()
+            tag = 0
+        parts.append(struct.pack(">BI", tag, len(vb)))
+        parts.append(vb)
+        parts.append(struct.pack(">I", len(payload)))
+        parts.append(payload)
+    return b"".join(parts)
+
+
+def decode_chunk_columnar(body: bytes, dtypes: Sequence[DataType]
+                          ) -> Optional[StreamChunk]:
+    import pickle
+    (n,) = struct.unpack(">I", body[:4])
+    pos = 4
+    ops = np.frombuffer(body[pos:pos + n], dtype=np.int8)
+    pos += n
+    cols = []
+    for dt in dtypes:
+        tag, vlen = struct.unpack(">BI", body[pos:pos + 5])
+        pos += 5
+        validity = np.unpackbits(
+            np.frombuffer(body[pos:pos + vlen], dtype=np.uint8),
+            count=n).astype(np.bool_)
+        pos += vlen
+        (plen,) = struct.unpack(">I", body[pos:pos + 4])
+        pos += 4
+        payload = body[pos:pos + plen]
+        pos += plen
+        if tag == 1:
+            values = np.empty(n, dtype=object)
+            values[:] = pickle.loads(payload)
+        else:
+            values = np.frombuffer(payload, dtype=dt.np_dtype)
+        cols.append(Column(dt, values, validity))
+    if n == 0:
+        return None
+    return StreamChunk(ops, cols)
+
+
 def decode_chunk(body: bytes, dtypes: Sequence[DataType]
                  ) -> Optional[StreamChunk]:
     (n,) = struct.unpack(">H", body[:2])
@@ -162,6 +225,8 @@ def decode_message(tag: bytes, body: bytes, dtypes: Sequence[DataType]
                    ) -> Optional[Message]:
     if tag == b"C":
         return decode_chunk(body, dtypes)
+    if tag == b"K":
+        return decode_chunk_columnar(body, dtypes)
     if tag == b"B":
         curr, prev, kind, mut = struct.unpack(">QQBB", body)
         mutation = (Mutation(_MUT_INV[mut]) if mut else None)
@@ -258,6 +323,9 @@ class ExchangeServer:
         while True:
             try:
                 conn, _ = self._lsock.accept()
+                # barriers/permits are tiny frames on the critical path:
+                # Nagle+delayed-ACK would add ~40ms per epoch round trip
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             except OSError:
                 return                      # listener closed
             # handshake off-thread with a deadline: a stalled or garbage
@@ -322,19 +390,23 @@ class ExchangeServer:
                         _send_frame(conn, b"E")
                         delivered = True
                         break
-                    msg = ch.buf.popleft()
+                    # drain a batch per wakeup: one cv round trip per
+                    # MESSAGE starves the pipeline on GIL handoffs
+                    batch = list(ch.buf)
+                    ch.buf.clear()
                     ch.cv.notify_all()      # wake a blocked send()
-                if isinstance(msg, StreamChunk):
-                    for body in encode_chunk_frames(msg, ch.dtypes):
+                for msg in batch:
+                    if isinstance(msg, StreamChunk):
                         # credit: block until the receiver granted room
                         with pcv:
                             while permits[0] <= 0:
                                 pcv.wait()
                             permits[0] -= 1
-                        _send_frame(conn, b"C", body)
-                    continue
-                tag, body = encode_message(msg, ch.dtypes)
-                _send_frame(conn, tag, body)
+                        _send_frame(conn, b"K",
+                                    encode_chunk_columnar(msg, ch.dtypes))
+                        continue
+                    tag, body = encode_message(msg, ch.dtypes)
+                    _send_frame(conn, tag, body)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -389,6 +461,7 @@ class RemoteInput(Executor):
 
     def execute(self) -> Iterator[Message]:
         sock = socket.create_connection(self.addr)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             _send_frame(sock, b"H", struct.pack(">H", self.channel_id))
             dtypes = self.schema.dtypes
@@ -397,7 +470,7 @@ class RemoteInput(Executor):
                 if tag == b"E":
                     return
                 msg = decode_message(tag, body, dtypes)
-                if tag == b"C":
+                if tag in (b"C", b"K"):
                     # refund one permit per C frame received — including
                     # frames that decode to zero rows, or the sender's
                     # credit would leak away one empty chunk at a time

@@ -1,0 +1,91 @@
+"""Compute-worker process: builds one stream fragment from a serialized
+plan and runs it against the coordinator's exchange.
+
+The SQL-driven multi-process deployment seam — the analog of the
+reference's worker-side stream manager building actors from a StreamNode
+proto received over the control stream
+(`src/stream/src/task/stream_manager.rs:610` create_actor,
+`src/meta/src/stream/stream_manager.rs:254` job placement,
+`proto/stream_service.proto:150`). The plan wire format here is JSON
+(fragment kind + schema + agg spec + channel routing) instead of proto,
+and transport is the credit-flow exchange (`runtime/exchange_net.py`).
+
+Usage (spawned by `runtime/remote_fragments.py`):
+    python -m risingwave_tpu.runtime.worker '<plan json>'
+
+The worker prints one line `ADDR <host> <port>` (its result exchange) to
+stdout, then streams: coordinator exchange --RemoteInput--> fragment
+executor --> its own ExchangeServer channel 0 --> coordinator.
+
+Real host parallelism lives HERE: fragments in separate OS processes
+scale with cores, which Python threads cannot (GIL) — the same reason
+the reference runs actors on distributed compute nodes, not one.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+from ..core.schema import Field, Schema
+from ..expr.agg import AggCall
+from ..expr.expression import InputRef
+from ..ops import HashAggExecutor
+from ..state import MemoryStateStore, StateTable
+from .exchange_net import ExchangeServer, RemoteInput
+
+
+def _schema(cols: List[List[str]]) -> Schema:
+    from ..sql.planner import type_from_name
+    return Schema([Field(n, type_from_name(t)) for n, t in cols])
+
+
+def build_fragment(plan: Dict[str, Any], upstream) -> Any:
+    frag = plan["fragment"]
+    in_schema = upstream.schema
+    calls = []
+    for kind, arg in frag["calls"]:
+        expr = None
+        if arg is not None:
+            expr = InputRef(arg, in_schema.fields[arg].dtype)
+        calls.append(AggCall(kind, expr))
+    if frag["kind"] == "partial_hash_agg":
+        # stateless pre-shuffle stage: nothing to persist, nothing to
+        # recover — a respawned worker is immediately correct
+        from ..ops.agg import StatelessPartialAggExecutor
+        return StatelessPartialAggExecutor(upstream,
+                                           frag["group_indices"], calls)
+    if frag["kind"] != "hash_agg":
+        raise ValueError(f"unknown fragment kind {frag['kind']!r}")
+    gd = [in_schema.fields[i].dtype for i in frag["group_indices"]]
+    from ..core import dtypes as T
+    st = StateTable(MemoryStateStore(), 1, gd + [T.BYTEA],
+                    list(range(len(gd))))
+    return HashAggExecutor(upstream, frag["group_indices"], calls,
+                           state_table=st)
+
+
+def main(argv: List[str]) -> int:
+    plan = json.loads(argv[0])
+    host, port = plan["coord"]
+    upstream = RemoteInput((host, port), plan["in_channel"],
+                           _schema(plan["in_schema"]),
+                           append_only=plan.get("append_only", False))
+    execu = build_fragment(plan, upstream)
+    server = ExchangeServer()
+    out = server.register(0, execu.schema.dtypes)
+    print(f"ADDR {server.addr[0]} {server.addr[1]}", flush=True)
+    try:
+        for msg in execu.execute():
+            out.send(msg)
+    except (ConnectionError, OSError):
+        return 2          # coordinator gone: exit quietly, nothing to save
+    finally:
+        out.close()
+    ok = server.wait_drained(timeout=120)
+    server.close()
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -111,6 +111,11 @@ class Database:
         self.device = resolve_device(device)
         self._check_device_marker()
         self.catalog = Catalog()
+        # per-barrier span tree (inject -> per-job collect -> commit),
+        # ring-buffered for rw_barrier_trace and file-logged in the data
+        # dir for offline hang diagnosis (risectl trace)
+        from ..utils.trace import BarrierTracer
+        self.tracer = BarrierTracer(data_dir)
         self.injector = BarrierInjector(checkpoint_frequency)
         self.sinks: List[Tuple[str, Iterator[Message]]] = []   # job pumps
         self._iters: Dict[str, Iterator[Message]] = {}
@@ -224,11 +229,14 @@ class Database:
                                  A.AlterParallelism, A.CreateFunction)) \
                     or (isinstance(stmt, A.SetVar) and stmt.system):
                 if isinstance(stmt, A.CreateMaterializedView):
-                    # plan shape depends on this session var; pin it in the
-                    # log so replay replans with the same fragment count
+                    # plan shape depends on these session vars; pin them in
+                    # the log so replay replans the same fragment topology
                     k = int(self.session_vars.get("streaming_parallelism")
                             or 0)
                     self._log_ddl(f"SET streaming_parallelism TO {k}")
+                    pl = self.session_vars.get("streaming_placement")
+                    if pl and pl != "local":
+                        self._log_ddl(f"SET streaming_placement TO {pl}")
                 self._log_ddl(text)
             out.append(result)
         return out
@@ -315,14 +323,23 @@ class Database:
                                [T.INT64, schema.fields[ti].dtype], [0])
             src = WatermarkFilterExecutor(src, ti, delay, wm_st)
             obj.watermark_col = ti
-        mv_table = StateTable(self.store, tid, schema.dtypes, pk)
-        # minted rowids never collide, so the conflict scan is pure
-        # overhead there — and NO_CHECK is what lets Materialize keep the
-        # append-only property for the device agg specialization
-        mat = MaterializeExecutor(src, mv_table,
-                                  ConflictBehavior.NO_CHECK if not has_pk
-                                  else ConflictBehavior.OVERWRITE)
-        shared = SharedStream(mat)
+        if stmt.is_source and connector != "dml":
+            # SOURCES are passive pipes, not tables (`source_executor.rs`:
+            # the reference never persists a source's stream; an MV on a
+            # source starts from its creation point). Skipping the
+            # per-row materialization is also the host path's single
+            # biggest per-event cost.
+            mv_table = None
+            shared = SharedStream(src)
+        else:
+            mv_table = StateTable(self.store, tid, schema.dtypes, pk)
+            # minted rowids never collide, so the conflict scan is pure
+            # overhead there — and NO_CHECK is what lets Materialize keep
+            # the append-only property for the device agg specialization
+            mat = MaterializeExecutor(src, mv_table,
+                                      ConflictBehavior.NO_CHECK if not has_pk
+                                      else ConflictBehavior.OVERWRITE)
+            shared = SharedStream(mat)
         obj.runtime = {"reader": reader if connector == "dml" else None,
                        "state_table": mv_table, "shared": shared,
                        "port": shared.subscribe()}
@@ -390,9 +407,10 @@ class Database:
         obj = self.catalog.get(name)
         rt = obj.runtime
         snap = None
-        if not self._replaying:
+        if not self._replaying and rt["state_table"] is not None:
             # DDL-log replay: downstream recovered state already includes
-            # the snapshot — re-backfilling would double-count
+            # the snapshot — re-backfilling would double-count. Sources
+            # have no table (passive pipes): MVs start from now.
             snapshot_rows = list(rt["state_table"].iter_all())
             if snapshot_rows:
                 snap = StreamChunk.from_rows(
@@ -433,6 +451,11 @@ class Database:
         # per CREATE in the DDL log so recovery replans identically
         planner.parallelism = max(
             1, int(self.session_vars.get("streaming_parallelism") or 0))
+        # 'process' places parallel fragments in worker OS processes
+        # (runtime/remote_fragments.py) — real host concurrency; Python
+        # threads cannot provide it (GIL)
+        planner.placement = self.session_vars.get("streaming_placement",
+                                                  "local")
         self._pending_subs = []
         execu, ns = planner.plan_query(stmt.query)
         schema = ns.schema()
@@ -828,14 +851,19 @@ class Database:
         from ..utils.metrics import REGISTRY
         t0 = _time.perf_counter()
         b = self.injector.inject()
+        span = self.tracer.inject(b.epoch.curr, b.kind.value)
         # fused device jobs first: their epoch dispatch is ASYNC (no device
         # sync), so host executors below overlap with device compute
-        for job in self._fused.values():
+        for jname, job in self._fused.items():
+            span.job_start(jname)
             job.on_barrier(b)
+            span.job_end(jname)
         for name, it in list(self._iters.items()):
+            span.job_start(name)
             for msg in it:
                 if isinstance(msg, Barrier) and msg.epoch.curr == b.epoch.curr:
                     break
+            span.job_end(name)
         if b.is_checkpoint:
             self.store.commit_epoch(b.epoch.curr)
             self.epoch_committed = b.epoch.curr
@@ -846,6 +874,7 @@ class Database:
                     if isinstance(obj.runtime, dict) else None
                 if se is not None:
                     se.deliver_durable()
+        span.commit()   # barrier fully collected (checkpoint or not)
         # barrier latency + epoch progress (streaming_stats.rs analog)
         REGISTRY.histogram("barrier_latency_seconds",
                            "inject-to-collect barrier latency"
@@ -885,6 +914,11 @@ class Database:
             job = (obj.runtime or {}).get("fused_job")
             if job is not None:
                 rows = job.mv_rows_now()   # sync + pull the CURRENT device MV
+            elif obj.runtime.get("state_table") is None:
+                raise ValueError(
+                    f"source {name!r} is not directly queryable (sources "
+                    "are unmaterialized streams — create a MATERIALIZED "
+                    "VIEW over it)")
             else:
                 rows = list(obj.runtime["state_table"].iter_all())
             chunks = []

@@ -321,6 +321,43 @@ class Planner:
                                          mesh=self.device.mesh,
                                          capacity=self.device.capacity,
                                          append_only=ao)
+        if self.parallelism > 1 and group_indices and not eowc \
+                and getattr(self, "placement", "local") == "process":
+            # worker OS processes over the credit-flow exchange — real CPU
+            # parallelism (stream_manager.rs:610 actor placement analog).
+            # 2-phase: stateless partial agg in workers, stateful final agg
+            # here (its state table makes recovery identical to the local
+            # path; workers respawn with nothing to restore). Plans the
+            # 2-phase rewrite can't express fall through to local topology.
+            from ..runtime.remote_fragments import (RemoteFragmentSet,
+                                                    serializable_agg)
+            if serializable_agg(input, calls):
+                # prune to the columns the fragment reads before anything
+                # crosses the wire (exchange bytes + encode CPU are the
+                # coordinator's budget)
+                used = list(dict.fromkeys(
+                    list(group_indices)
+                    + [c.arg.index for c in calls if c.arg is not None]))
+                prune = ProjectExecutor(
+                    input, [InputRef(i, input.schema.fields[i].dtype)
+                            for i in used],
+                    [input.schema.fields[i].name for i in used])
+                prune.append_only = input.append_only
+                remap = {old: new for new, old in enumerate(used)}
+                pruned_calls = [
+                    AggCall(c.kind,
+                            InputRef(remap[c.arg.index],
+                                     c.arg.return_type)
+                            if c.arg is not None else None)
+                    for c in calls]
+                rfs = RemoteFragmentSet(
+                    prune, [remap[i] for i in group_indices], pruned_calls,
+                    self.parallelism)
+                merge = rfs.merge_executor()
+                ng = len(group_indices)
+                st = self.make_state(gdtypes + [T.BYTEA], list(range(ng)))
+                return HashAggExecutor(merge, list(range(ng)),
+                                       rfs.final_calls(), state_table=st)
         if self.parallelism > 1 and group_indices and not eowc:
             # Dispatch -> k parallel agg fragments -> Merge: the reference's
             # hash-exchange topology (`dispatch.rs:777` HashDataDispatcher,

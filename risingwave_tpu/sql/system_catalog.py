@@ -67,6 +67,13 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
     "rw_columns": (Schema.of(("relation", T.VARCHAR), ("name", T.VARCHAR),
                              ("position", T.INT64), ("type", T.VARCHAR)),
                    _rows_columns),
+    # per-barrier span rows (utils/trace.py): job='<barrier>' carries the
+    # whole-epoch state/total; phase RUNNING / OPEN marks a stall
+    "rw_barrier_trace": (
+        Schema.of(("epoch", T.INT64), ("kind", T.VARCHAR),
+                  ("job", T.VARCHAR), ("state", T.VARCHAR),
+                  ("ms", T.FLOAT64)),
+        lambda db: db.tracer.rows()),
 }
 
 

@@ -1,0 +1,161 @@
+"""Barrier trace: per-epoch span records for stall introspection.
+
+Analog of the reference's barrier tracing + await-tree surface: every
+barrier carries a `TracingContext` so one distributed trace spans an
+epoch (`src/common/src/util/tracing.rs:45`,
+`BarrierInner.tracing_context`), and MonitorService exposes per-actor
+stack trees for "where is this stuck"
+(`src/compute/src/rpc/service/monitor_service.rs:82-111`).
+
+Re-hosted: the Database's tick loop records one span tree per barrier —
+inject → per-job collect (start/end) → commit — in a memory ring
+(queryable as the `rw_barrier_trace` system table) AND as a JSONL file
+in the data directory, appended event-by-event so a HANG is diagnosable
+from OUTSIDE the wedged process (`risectl trace`): the last record with
+no `commit` event names the job that started collecting and never
+finished — exactly the introspection that would have localized the r03
+bench stall in one command.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+TRACE_FILE = "barrier_trace.jsonl"
+_MAX_FILE_BYTES = 1 << 20          # rotate: keep the tail fresh, file small
+RING = 128
+
+
+class BarrierTracer:
+    def __init__(self, data_dir: Optional[str] = None):
+        self.ring: deque = deque(maxlen=RING)
+        self.path = os.path.join(data_dir, TRACE_FILE) if data_dir else None
+        self._f = None
+        self._emitted = 0
+        if self.path is not None:
+            try:
+                self._f = open(self.path, "a")
+            except OSError:
+                self.path = None
+
+    # ---- event emission --------------------------------------------------
+    def _emit(self, ev: Dict[str, Any]) -> None:
+        if self._f is None:
+            return
+        try:
+            self._f.write(json.dumps(ev) + "\n")
+            # flush per event: a hang must leave its last collect_start
+            # durable for offline diagnosis
+            self._f.flush()
+            self._emitted += 1
+            if self._emitted % 4096 == 0 \
+                    and os.path.getsize(self.path) > _MAX_FILE_BYTES:
+                with open(self.path) as f:
+                    lines = f.readlines()
+                self._f.close()
+                with open(self.path, "w") as f:
+                    f.writelines(lines[len(lines) // 2:])
+                self._f = open(self.path, "a")
+        except OSError:
+            self._f = None             # tracing must never fail the job
+
+    def inject(self, epoch: int, kind: str) -> "BarrierSpan":
+        span = BarrierSpan(self, epoch, kind)
+        self.ring.append(span)
+        self._emit({"ev": "inject", "epoch": epoch, "kind": kind,
+                    "ts": time.time()})
+        return span
+
+    # ---- queries ---------------------------------------------------------
+    def rows(self) -> List[Tuple]:
+        """(epoch, kind, job, phase, ms) rows for rw_barrier_trace."""
+        out: List[Tuple] = []
+        for span in self.ring:
+            for job, (t0, t1) in span.jobs.items():
+                ms = (t1 - t0) * 1000 if t1 is not None else None
+                state = "done" if t1 is not None else "RUNNING"
+                out.append((span.epoch, span.kind, job, state, ms))
+            total = (span.commit_ts - span.inject_ts) * 1000 \
+                if span.commit_ts is not None else None
+            state = "committed" if span.commit_ts is not None else "OPEN"
+            out.append((span.epoch, span.kind, "<barrier>", state, total))
+        return out
+
+
+class BarrierSpan:
+    __slots__ = ("tracer", "epoch", "kind", "inject_ts", "jobs",
+                 "commit_ts")
+
+    def __init__(self, tracer: BarrierTracer, epoch: int, kind: str):
+        self.tracer = tracer
+        self.epoch = epoch
+        self.kind = kind
+        self.inject_ts = time.time()
+        self.jobs: Dict[str, List[Optional[float]]] = {}
+        self.commit_ts: Optional[float] = None
+
+    def job_start(self, name: str) -> None:
+        self.jobs[name] = [time.time(), None]
+        self.tracer._emit({"ev": "collect_start", "epoch": self.epoch,
+                           "job": name, "ts": time.time()})
+
+    def job_end(self, name: str) -> None:
+        if name in self.jobs:
+            self.jobs[name][1] = time.time()
+        self.tracer._emit({"ev": "collect_end", "epoch": self.epoch,
+                           "job": name, "ts": time.time()})
+
+    def commit(self) -> None:
+        self.commit_ts = time.time()
+        self.tracer._emit({"ev": "commit", "epoch": self.epoch,
+                           "ts": self.commit_ts})
+
+
+def diagnose(path: str, last: int = 5) -> str:
+    """Offline hang localization over a barrier_trace.jsonl (the risectl
+    `trace` surface): per-epoch summary; an epoch with no commit event is
+    flagged with the job(s) that started and never finished."""
+    epochs: Dict[int, Dict[str, Any]] = {}
+    order: List[int] = []
+    with open(path) as f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            e = ev.get("epoch")
+            if e not in epochs:
+                epochs[e] = {"kind": ev.get("kind"), "jobs": {},
+                             "inject": None, "commit": None}
+                order.append(e)
+            rec = epochs[e]
+            if ev["ev"] == "inject":
+                rec["inject"] = ev["ts"]
+                rec["kind"] = ev.get("kind")
+            elif ev["ev"] == "collect_start":
+                rec["jobs"][ev["job"]] = [ev["ts"], None]
+            elif ev["ev"] == "collect_end":
+                if ev["job"] in rec["jobs"]:
+                    rec["jobs"][ev["job"]][1] = ev["ts"]
+            elif ev["ev"] == "commit":
+                rec["commit"] = ev["ts"]
+    lines = []
+    for e in order[-last:]:
+        rec = epochs[e]
+        if rec["commit"] is not None and rec["inject"] is not None:
+            ms = (rec["commit"] - rec["inject"]) * 1000
+            lines.append(f"epoch {e} [{rec['kind']}] committed in "
+                         f"{ms:.1f} ms ({len(rec['jobs'])} jobs)")
+            continue
+        stuck = [j for j, (t0, t1) in rec["jobs"].items() if t1 is None]
+        if stuck:
+            lines.append(f"epoch {e} [{rec['kind']}] OPEN — stuck in: "
+                         + ", ".join(stuck))
+        else:
+            done = len(rec["jobs"])
+            lines.append(f"epoch {e} [{rec['kind']}] OPEN — {done} jobs "
+                         "collected, commit never ran (store/coordinator)")
+    return "\n".join(lines) if lines else "no barrier trace events"

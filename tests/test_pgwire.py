@@ -265,3 +265,66 @@ def test_describe_unknown_table_sends_error_not_disconnect(server):
     assert b"E" in tags, tags
     # connection still usable
     assert c.rows(c.query("SELECT 1 + 1")) == [("2",)]
+
+
+def test_extended_query_with_parameters(server):
+    """Parse with $n placeholders + Bind text-format values + Execute —
+    the default mode of psycopg/pgjdbc prepared statements
+    (pg_extended.rs analog)."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE pt (a BIGINT, b VARCHAR)")
+    c.query("INSERT INTO pt VALUES (1, 'x'), (2, 'y'), (3, 'z''q')")
+    c.query("FLUSH")
+
+    def send_parse(name, sql, oids=()):
+        payload = name + b"\0" + sql + b"\0" + struct.pack(">H", len(oids))
+        for o in oids:
+            payload += struct.pack(">I", o)
+        c.send(b"P", payload)
+
+    def send_bind(portal, stmt, values):
+        payload = portal + b"\0" + stmt + b"\0" + struct.pack(">H", 0)
+        payload += struct.pack(">H", len(values))
+        for v in values:
+            if v is None:
+                payload += struct.pack(">i", -1)
+            else:
+                payload += struct.pack(">I", len(v)) + v
+        payload += struct.pack(">H", 0)
+        c.send(b"B", payload)
+
+    # int param, bigint OID declared
+    send_parse(b"s1", b"SELECT b FROM pt WHERE a = $1", (20,))
+    c.send(b"D", b"Ss1\0")
+    send_bind(b"", b"s1", [b"2"])
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S", b"")
+    msgs = c.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert b"t" in tags, "ParameterDescription expected"
+    pd = next(b for t, b in msgs if t == b"t")
+    assert struct.unpack(">H", pd[:2])[0] == 1
+    assert struct.unpack(">I", pd[2:6])[0] == 20
+    assert c.rows(msgs) == [("y",)]
+
+    # string param with embedded quote, unknown OID; reuse the statement
+    send_parse(b"s2", b"SELECT a FROM pt WHERE b = $1")
+    send_bind(b"", b"s2", [b"z'q"])
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S", b"")
+    assert c.rows(c.read_until(b"Z")) == [("3",)]
+
+    # NULL parameter: a = NULL matches nothing
+    send_bind(b"", b"s1", [None])
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S", b"")
+    assert c.rows(c.read_until(b"Z")) == []
+
+    # missing parameter -> error, connection stays usable
+    send_bind(b"", b"s1", [])
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S", b"")
+    msgs = c.read_until(b"Z")
+    assert any(t == b"E" for t, _ in msgs)
+    assert c.rows(c.query("SELECT count(*) FROM pt")) == [("3",)]

@@ -1,0 +1,134 @@
+"""SQL-driven multi-process fragments: 2-phase agg across worker OS
+processes over the credit-flow exchange.
+
+Reference analogs: plan → fragments → actors on compute nodes
+(`src/meta/src/stream/stream_manager.rs:254`,
+`src/stream/src/task/stream_manager.rs:610`), the 2-phase aggregation
+rewrite (partial agg + sum0 merge), and worker-failure recovery via job
+restart (`src/meta/src/barrier/worker.rs:664`).
+"""
+import os
+import signal
+import time
+
+import pytest
+
+from risingwave_tpu.sql import Database
+
+SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+       " channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
+       " WITH (connector='nexmark', nexmark.table='bid',"
+       " nexmark.max.events='{n}', nexmark.chunk.size='{c}')")
+MV = ("CREATE MATERIALIZED VIEW q4 AS SELECT auction, count(*) AS c,"
+      " sum(price) AS s, max(price) AS m FROM bid GROUP BY auction")
+
+
+def drive(db, n, chunk):
+    for _ in range(n // (64 * chunk) + 4):
+        db.tick()
+
+
+def find_remote(db, name):
+    """Walk the MV's executor tree to its RemoteFragmentSet."""
+    obj = db.catalog.get(name)
+    stack = [obj.runtime["shared"].upstream]
+    while stack:
+        e = stack.pop()
+        r = getattr(e, "_remote", None)
+        if r is not None:
+            return r
+        for attr in ("input", "left_exec", "right_exec"):
+            c = getattr(e, attr, None)
+            if c is not None:
+                stack.append(c)
+    raise AssertionError("no RemoteFragmentSet in the plan")
+
+
+def host_oracle(n, chunk):
+    db = Database()
+    db.run(SRC.format(n=n, c=chunk))
+    db.run(MV)
+    drive(db, n, chunk)
+    return sorted(db.query("SELECT * FROM q4"))
+
+
+def test_two_process_q4_matches_single_process():
+    n, chunk = 20_000, 512
+    db = Database()
+    db.run(SRC.format(n=n, c=chunk))
+    db.run("SET streaming_parallelism = 2")
+    db.run("SET streaming_placement = 'process'")
+    db.run(MV)
+    rfs = find_remote(db, "q4")
+    assert len(rfs.workers) == 2
+    assert all(w.proc.poll() is None for w in rfs.workers), \
+        "both workers must be live OS processes"
+    drive(db, n, chunk)
+    got = sorted(db.query("SELECT * FROM q4"))
+    assert got == host_oracle(n, chunk)
+    rfs.shutdown()
+
+
+def test_worker_kill_detected_and_recovered(tmp_path):
+    """Kill one worker mid-stream: the coordinator must DETECT it (raise,
+    not hang), the uncommitted epoch must vanish, and a restarted process
+    (DDL replay, fresh stateless workers, committed source offsets) must
+    converge to the exact result."""
+    from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+    n, chunk = 40_000, 256
+    d = str(tmp_path / "data")
+    db = Database(data_dir=d)
+    db.run(SRC.format(n=n, c=chunk))
+    db.run("SET streaming_parallelism = 2")
+    db.run("SET streaming_placement = 'process'")
+    db.run(MV)
+    for _ in range(3):
+        db.tick()
+    rfs = find_remote(db, "q4")
+    rfs.workers[0].proc.kill()
+    with pytest.raises(RemoteWorkerDied):
+        for _ in range(10):
+            db.tick()
+    rfs.shutdown()
+    del db
+    db2 = Database(data_dir=d)
+    rfs2 = find_remote(db2, "q4")
+    assert all(w.proc.poll() is None for w in rfs2.workers), \
+        "recovery must respawn fresh workers"
+    drive(db2, n, chunk)
+    assert sorted(db2.query("SELECT * FROM q4")) == host_oracle(n, chunk)
+    rfs2.shutdown()
+
+
+@pytest.mark.slow
+def test_process_placement_wall_clock_overhead_bounded():
+    """Process placement moves the per-row aggregation into worker CPUs,
+    but the COORDINATOR still runs the source + dispatch + final merge in
+    Python — Amdahl's serial fraction. Until sources themselves place
+    into workers (split ownership, like the reference's per-actor source
+    splits), the honest claim is bounded overhead, not speedup: the
+    4-process run must stay within 2x of single-fragment wall clock on
+    the same workload while producing identical results. (Profiling notes:
+    the serial floor is datagen + vnode dispatch + wire encode; worker CPU
+    utilization confirms the fragments themselves do scale.)"""
+    n, chunk = 160_000, 1024
+
+    def run(parallel):
+        db = Database()
+        db.run(SRC.format(n=n, c=chunk))
+        if parallel:
+            db.run("SET streaming_parallelism = 4")
+            db.run("SET streaming_placement = 'process'")
+        db.run(MV)
+        if parallel:
+            find_remote(db, "q4")     # assert placement actually happened
+        t0 = time.perf_counter()
+        drive(db, n, chunk)
+        dt = time.perf_counter() - t0
+        rows = sorted(db.query("SELECT * FROM q4"))
+        return dt, rows
+
+    t1, rows1 = run(False)
+    tk, rowsk = run(True)
+    assert rowsk == rows1
+    assert tk < t1 * 2.0, (t1, tk)

@@ -31,6 +31,9 @@ def test_mv_equals_batch_recompute_nexmark_datagen():
            "channel VARCHAR, url VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
            " WITH (connector='nexmark', nexmark.table='bid', "
            "nexmark.max.events='2000')")
+    # sources are unmaterialized streams (source_executor.rs): batch
+    # queries go through an MV materializing the rows, not the source
+    db.run("CREATE MATERIALIZED VIEW raw AS SELECT * FROM nbid")
     db.run("CREATE MATERIALIZED VIEW agg AS SELECT auction, count(*) AS c, "
            "sum(price) AS s, max(price) AS m FROM nbid GROUP BY auction")
     db.run("FLUSH")
@@ -38,5 +41,5 @@ def test_mv_equals_batch_recompute_nexmark_datagen():
     mv = sorted(db.query("SELECT * FROM agg"))
     batch = sorted(db.query(
         "SELECT auction, count(*), sum(price), max(price) "
-        "FROM nbid GROUP BY auction"))
+        "FROM raw GROUP BY auction"))
     assert mv == batch and len(mv) > 10
