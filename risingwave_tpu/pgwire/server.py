@@ -64,27 +64,53 @@ _UNQUOTED_OIDS = {16, 20, 21, 23, 700, 701, 1700}
 
 
 def _sql_segments(sql: str):
-    """(text, is_string_literal) segments — $n inside '...' is literal."""
+    """(text, is_literal) segments — a $n inside a '...' or $$...$$
+    string, a -- comment, or a /* */ comment is literal text, never a
+    parameter placeholder (mirrors the lexer's _TOKEN_RE)."""
     out = []
     i = 0
-    while i < len(sql):
-        if sql[i] == "'":
+    n = len(sql)
+    plain_from = 0
+
+    def flush(upto):
+        if upto > plain_from:
+            out.append((sql[plain_from:upto], False))
+
+    while i < n:
+        c = sql[i]
+        if c == "'":
             j = i + 1
-            while j < len(sql):
-                if sql[j] == "'" and j + 1 < len(sql) and sql[j + 1] == "'":
+            while j < n:
+                if sql[j] == "'" and j + 1 < n and sql[j + 1] == "'":
                     j += 2
                     continue
                 if sql[j] == "'":
                     break
                 j += 1
+            flush(i)
             out.append((sql[i:j + 1], True))
-            i = j + 1
+            i = plain_from = j + 1
+        elif c == "$" and i + 1 < n and sql[i + 1] == "$":
+            end = sql.find("$$", i + 2)
+            end = n if end == -1 else end + 2
+            flush(i)
+            out.append((sql[i:end], True))
+            i = plain_from = end
+        elif c == "-" and i + 1 < n and sql[i + 1] == "-":
+            end = sql.find("\n", i)
+            end = n if end == -1 else end + 1
+            flush(i)
+            out.append((sql[i:end], True))
+            i = plain_from = end
+        elif c == "/" and i + 1 < n and sql[i + 1] == "*":
+            end = sql.find("*/", i + 2)
+            end = n if end == -1 else end + 2
+            flush(i)
+            out.append((sql[i:end], True))
+            i = plain_from = end
         else:
-            j = sql.find("'", i)
-            if j == -1:
-                j = len(sql)
-            out.append((sql[i:j], False))
-            i = j
+            i += 1
+    flush(n)
     return out
 
 
@@ -283,19 +309,43 @@ class _Conn:
             oids = list(param_oids) + [0] * (n - len(param_oids))
             self._send(b"t", struct.pack(">H", n)
                        + b"".join(struct.pack(">I", o) for o in oids))
-        probe = _substitute_params(sql or "", ["0"] * _count_params(sql or ""),
-                                   param_oids) if sql else sql
-        try:
-            stmts = parse_sql(probe or "")
-        except Exception:  # noqa: BLE001 — surfaces at Execute
-            self._send(b"n")
+        # No parameters: describe the statement as-is; a planning error is
+        # deterministic (it will fail at Execute too) and must surface as
+        # ErrorResponse, not NoData.
+        n_params = _count_params(sql or "")
+        if n_params == 0:
+            try:
+                stmts = parse_sql(sql or "")
+            except Exception:  # noqa: BLE001 — surfaces at Execute
+                self._send(b"n")
+                return
+            if len(stmts) == 1 and isinstance(stmts[0], (A.Select, A.SetOp)):
+                with self.lock:
+                    desc = self.db.describe_select(stmts[0])
+                self._row_description(desc)
+            else:
+                self._send(b"n")
             return
-        if len(stmts) == 1 and isinstance(stmts[0], (A.Select, A.SetOp)):
-            with self.lock:
-                desc = self.db.describe_select(stmts[0])
-            self._row_description(desc)
-        else:
-            self._send(b"n")
+        # Parameterized: probe with NULL first (plans against any column
+        # type, where a literal '0' would fail e.g. $1 = varchar_col),
+        # falling back to '0' for grammar positions that need a numeric
+        # literal (LIMIT $1). A probe failure is an artifact of the fill
+        # value, so only after both fills fail is NoData answered.
+        for fill in (None, "0"):
+            probe = _substitute_params(sql or "", [fill] * n_params,
+                                       param_oids)
+            try:
+                stmts = parse_sql(probe or "")
+                if len(stmts) == 1 and isinstance(stmts[0],
+                                                  (A.Select, A.SetOp)):
+                    with self.lock:
+                        desc = self.db.describe_select(stmts[0])
+                    self._row_description(desc)
+                    return
+                break              # parsed as a non-SELECT — NoData
+            except Exception:  # noqa: BLE001 — try the other fill
+                continue
+        self._send(b"n")
 
     def _bind(self, body: bytes, parse_sql_by_name) -> str:
         """Bind: substitute text-format parameter values into the prepared

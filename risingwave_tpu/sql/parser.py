@@ -850,6 +850,15 @@ class Parser:
                 raise ValueError("EXISTS subqueries not supported yet")
             if t.value == "distinct":
                 raise ValueError("misplaced DISTINCT")
+        if t.kind == "id" \
+                and t.value.lower() in ("date", "timestamp", "timestamptz",
+                                        "time") \
+                and self.peek(1).kind == "str":
+            # typed string literal: DATE '2024-01-01' == CAST(.. AS DATE)
+            ty = t.value.lower()
+            self.next()
+            s = self.expect("str").value
+            return A.CastExpr(A.Lit(s), ty)
         if t.kind == "id" and t.value.lower() == "array" \
                 and self.peek(1).kind == "op" and self.peek(1).value == "[":
             self.next()

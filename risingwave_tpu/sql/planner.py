@@ -470,6 +470,20 @@ class Planner:
             raise ValueError("generate_series(start, stop[, step])")
         bound = [b.bind(x) for x in args]
         rt = series_return_type([e.return_type for e in bound])
+        if rt.kind == TypeKind.TIMESTAMP:
+            # DATE bounds are day counts while the series runs in
+            # TIMESTAMP microseconds — cast them up front, as the
+            # reference does (`generate_series.rs` casts args to the
+            # common timestamp type before evaluation). PG requires an
+            # interval step for the timestamp form: without one, the
+            # default step of 1 would mean one row per MICROSECOND.
+            if len(bound) < 3:
+                raise ValueError("generate_series over timestamps/dates "
+                                 "requires an interval step")
+            from ..expr.functions import cast as _cast
+            bound = [_cast(e, T.TIMESTAMP)
+                     if e.return_type.kind == TypeKind.DATE else e
+                     for e in bound]
         return BoundTableFunction("generate_series", bound, rt)
 
     def _plan_changelog(self, ref: A.ChangelogTable
@@ -897,12 +911,22 @@ class Planner:
                 ps_items.append(("s", be))
                 names.append(it.alias or _default_name(e))
         n_visible = len(ps_items)
-        execu = ProjectSetExecutor(execu, ps_items, names,
-                                   carry=list(ns.stream_key))
+        carry = list(ns.stream_key)
+        execu = ProjectSetExecutor(execu, ps_items, names, carry=carry)
         cols = [ColumnEntry(None, f.name, f.dtype)
                 for f in execu.schema.fields]
         sk = list(range(n_visible, len(cols)))
-        return execu, Namespace(cols, sk, n_visible)
+        # the upstream watermark column survives either as a selected
+        # scalar InputRef or via the hidden carry columns — map it through
+        # so downstream EOWC/watermark operators keep advancing
+        wm_out = None
+        if ns.watermark_idx is not None:
+            wm_out = next((j for j, (k, it) in enumerate(ps_items)
+                           if k == "s" and isinstance(it, InputRef)
+                           and it.index == ns.watermark_idx), None)
+            if wm_out is None and ns.watermark_idx in carry:
+                wm_out = n_visible + carry.index(ns.watermark_idx)
+        return execu, Namespace(cols, sk, n_visible, watermark_idx=wm_out)
 
     def _plan_now_filter(self, execu: Executor, ns: Namespace,
                          conj: A.ExprNode) -> Executor:
