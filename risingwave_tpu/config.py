@@ -150,6 +150,10 @@ SESSION_VAR_DEFAULTS: Dict[str, Any] = {
     # only); 'process' = worker OS processes over the credit-flow exchange
     # (real CPU parallelism — the compute-node placement analog)
     "streaming_placement": "local",
+    # true: plan eligible inner joins as arrangement-sharing lookup/delta
+    # joins (ops/lookup_join.py) instead of private-state hash joins —
+    # the reference's streaming_enable_delta_join session variable
+    "streaming_enable_delta_join": False,
     "application_name": "",
     "extra_float_digits": 1,
 }

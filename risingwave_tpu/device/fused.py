@@ -758,7 +758,10 @@ class MVPairNode(Node):
         return state, None, [needed.astype(jnp.int64)], None
 
 
-_CHAINABLE = (SourceNode, MapNode, FilterNode, HopNode)
+# HopNode stays un-chained: fusing the 5x window expansion into the
+# datagen program produced XLA graphs the remote-compile helper could not
+# finish (observed wedge, round 5); as its own program it compiles fine.
+_CHAINABLE = (SourceNode, MapNode, FilterNode)
 
 
 # ---------------------------------------------------------------------------

@@ -33,9 +33,13 @@ class AggCall:
     distinct: bool = False
     filter: Optional[Expr] = None
     return_type: DataType = T.INT64
+    # ordered-set direct args (approx_percentile: (quantile, rel_error))
+    direct_args: tuple = ()
 
     def __post_init__(self):
-        if self.kind == "count":
+        if self.kind == "approx_percentile":
+            self.return_type = T.FLOAT64
+        elif self.kind == "count":
             self.return_type = T.INT64
         elif self.arg is not None:
             at = self.arg.return_type
@@ -226,6 +230,64 @@ class ApproxCountDistinctState(AggState):
         return len(self.counts)
 
 
+class ApproxPercentileState(AggState):
+    """Log-bucket histogram percentile, exact to a relative error bound
+    (`approx_percentile/local.rs:68` bucket = ceil(log_base |v|) with
+    base = (1+e)/(1-e); `global_state.rs:305` output walk: negative
+    buckets descending, zeros, positive ascending; approx value =
+    ±2·base^i/(base+1)). Retraction = bucket-count decrement."""
+    __slots__ = ("quantile", "base", "neg", "pos", "zeros", "total")
+
+    def __init__(self, quantile: float, relative_error: float):
+        if not 0.0 <= quantile <= 1.0:
+            raise ValueError("approx_percentile quantile must be in [0, 1]")
+        if not 0.0 < relative_error < 1.0:
+            raise ValueError("approx_percentile relative_error must be "
+                             "in (0, 1)")
+        self.quantile = quantile
+        self.base = (1.0 + relative_error) / (1.0 - relative_error)
+        self.neg: Dict[int, int] = {}
+        self.pos: Dict[int, int] = {}
+        self.zeros = 0
+        self.total = 0
+
+    def _bucket(self, mag: float) -> int:
+        import math
+        return math.ceil(math.log(mag, self.base))
+
+    def apply(self, sign, value):
+        v = float(value)
+        self.total += sign
+        if v == 0.0:
+            self.zeros += sign
+            return
+        side = self.neg if v < 0 else self.pos
+        b = self._bucket(abs(v))
+        c = side.get(b, 0) + sign
+        if c <= 0:
+            side.pop(b, None)
+        else:
+            side[b] = c
+
+    def output(self):
+        if self.total <= 0:
+            return None
+        want = int((self.total - 1) * self.quantile)
+        acc = 0
+        for b in sorted(self.neg, reverse=True):    # most negative first
+            acc += self.neg[b]
+            if acc > want:
+                return -2.0 * self.base ** b / (self.base + 1.0)
+        acc += self.zeros
+        if acc > want:
+            return 0.0
+        for b in sorted(self.pos):
+            acc += self.pos[b]
+            if acc > want:
+                return 2.0 * self.base ** b / (self.base + 1.0)
+        return None
+
+
 def create_agg_state(call: AggCall) -> AggState:
     k = call.kind
     if k == "count":
@@ -250,12 +312,16 @@ def create_agg_state(call: AggCall) -> AggState:
         return StringAggState()
     if k == "approx_count_distinct":
         return ApproxCountDistinctState()
+    if k == "approx_percentile":
+        q = call.direct_args[0] if call.direct_args else 0.5
+        e = call.direct_args[1] if len(call.direct_args) > 1 else 0.01
+        return ApproxPercentileState(q, e)
     raise ValueError(f"unknown aggregate {k}")
 
 
 AGG_KINDS = {"count", "sum", "sum0", "avg", "min", "max", "bool_and",
              "bool_or", "first_value", "last_value", "string_agg",
-             "approx_count_distinct"}
+             "approx_count_distinct", "approx_percentile"}
 
 # Aggregates whose device (HBM slot) implementation is exact under retraction.
 DEVICE_RETRACTABLE = {"count", "sum", "avg"}

@@ -19,6 +19,7 @@ from .misc import (ChangelogExecutor, DynamicFilterExecutor, NowExecutor,
                    SortExecutor)
 from .project_set import (BoundTableFunction, ProjectSetExecutor,
                           TableFunctionScanExecutor)
+from .asof_join import AsOfJoinExecutor
 from .temporal_join import TemporalJoinExecutor
 
 __all__ = [

@@ -91,11 +91,10 @@ class SharedStream:
 
     def unsubscribe(self, port: "SharedStreamPort") -> None:
         """Detach a consumer (DROP of a downstream MV/sink) — its buffer
-        must stop accumulating messages."""
-        try:
-            self._buffers.remove(port.buf)
-        except ValueError:
-            pass
+        must stop accumulating messages. Identity-based removal: buffers
+        are usually empty lists, and list.remove's equality match would
+        detach some OTHER consumer's empty buffer."""
+        self._buffers = [b for b in self._buffers if b is not port.buf]
 
     def _pump(self) -> bool:
         if self._iter is None:

@@ -50,6 +50,9 @@ class FuncCall(ExprNode):
     distinct: bool = False
     over: Optional["WindowSpec"] = None
     filter: Optional[ExprNode] = None   # FILTER (WHERE ...) on aggregates
+    # WITHIN GROUP (ORDER BY e) — ordered-set aggregates
+    # (approx_percentile); the direct args stay in `args`
+    within_group: Optional[ExprNode] = None
 
 
 @dataclass

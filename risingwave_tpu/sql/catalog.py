@@ -25,6 +25,7 @@ class CatalogObject:
     watermark_delay_usecs: int = 0
     n_visible: Optional[int] = None   # hidden stream-key cols sit past this
     parallelism: Optional[int] = None  # ALTER ... SET PARALLELISM override
+    index_on: Optional[str] = None     # indexes: the indexed table's name
     # runtime attachments (set by Database)
     runtime: Any = None
 

@@ -69,6 +69,24 @@ class _Backfill(Executor):
         yield from self.port.execute()
 
 
+def _walk_executors(root) -> Iterator[Any]:
+    """Walk an executor tree through the common child attributes."""
+    stack = [root]
+    seen = set()
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        yield e
+        for attr in ("input", "left_exec", "right_exec", "port", "inputs"):
+            v = getattr(e, attr, None)
+            if isinstance(v, list):
+                stack.extend(v)
+            elif v is not None:
+                stack.append(v)
+
+
 class Database:
     def __init__(self, store: Optional[StateStore] = None,
                  data_dir: Optional[str] = None,
@@ -225,7 +243,7 @@ class Database:
         for stmt, text in parse_sql_with_text(sql):
             result = self._execute(stmt)
             if isinstance(stmt, (A.CreateTable, A.CreateMaterializedView,
-                                 A.CreateSink, A.DropObject,
+                                 A.CreateSink, A.DropObject, A.CreateIndex,
                                  A.AlterParallelism, A.CreateFunction)) \
                     or (isinstance(stmt, A.SetVar) and stmt.system):
                 if isinstance(stmt, A.CreateMaterializedView):
@@ -237,6 +255,10 @@ class Database:
                     pl = self.session_vars.get("streaming_placement")
                     if pl and pl != "local":
                         self._log_ddl(f"SET streaming_placement TO {pl}")
+                    dj = bool(self.session_vars.get(
+                        "streaming_enable_delta_join"))
+                    self._log_ddl("SET streaming_enable_delta_join TO "
+                                  + ("true" if dj else "false"))
                 self._log_ddl(text)
             out.append(result)
         return out
@@ -256,6 +278,8 @@ class Database:
             return self._create_function(stmt)
         if isinstance(stmt, A.CreateSink):
             return self._create_sink(stmt)
+        if isinstance(stmt, A.CreateIndex):
+            return self._create_index(stmt)
         if isinstance(stmt, A.DropObject):
             return self._drop(stmt)
         if isinstance(stmt, A.Insert):
@@ -440,7 +464,63 @@ class Database:
         bs = (lambda: BarrierSource(inj)) if inj is not None \
             else self._barrier_source
         return Planner(subscribe, barrier_source=bs,
-                       watermark_of=self._watermark_of, **kw)
+                       watermark_of=self._watermark_of,
+                       state_table_of=self._state_table_of, **kw)
+
+    def _state_table_of(self, name: str, keycols=None):
+        """The object's arrangement whose pk prefix covers `keycols` —
+        its own state table, or any index on it (create_index.rs)."""
+        obj = self.catalog.objects.get(name)
+        if obj is None or not isinstance(obj.runtime, dict):
+            return None
+        if keycols is None:
+            return obj.runtime.get("state_table")
+        cands = [obj] + [o for o in self.catalog.objects.values()
+                         if getattr(o, "index_on", None) == name]
+        k = len(keycols)
+        for o in cands:
+            st = (o.runtime or {}).get("state_table") \
+                if isinstance(o.runtime, dict) else None
+            if st is not None \
+                    and sorted(st.pk_indices[:k]) == sorted(keycols):
+                return st
+        return None
+
+    def _create_index(self, stmt: A.CreateIndex) -> str:
+        """CREATE INDEX i ON t (cols): an auto-maintained arrangement of
+        the table with pk = (index cols, table pk) — exactly how the
+        reference models indexes (an index IS a materialized view with a
+        reordered pk, `frontend/src/handler/create_index.rs`); lookup/
+        delta joins probe it when the join key matches its pk prefix."""
+        src = self.catalog.get(stmt.table)
+        if src.kind not in ("table", "mv"):
+            raise ValueError("CREATE INDEX requires a table or "
+                             "materialized view")
+        name_to_pos = {f.name: i for i, f in enumerate(src.schema.fields)}
+        try:
+            idx_cols = [name_to_pos[c] for c in stmt.columns]
+        except KeyError as e:
+            raise ValueError(f"index column {e.args[0]!r} does not exist")
+        pk = idx_cols + [i for i in src.pk if i not in idx_cols]
+        self._pending_subs = []
+        execu, schema, _ = self._subscribe(stmt.table)
+        tid = self.catalog.alloc_table_id()
+        # distribute by the INDEX columns: all rows of one key land in one
+        # vnode, so a prefix probe reads a single vnode range (the
+        # reference distributes arrangements by their join/index key)
+        table = StateTable(self.store, tid, schema.dtypes, pk,
+                           dist_key_indices=idx_cols)
+        mat = MaterializeExecutor(execu, table, ConflictBehavior.NO_CHECK)
+        shared = SharedStream(mat)
+        obj = CatalogObject(stmt.name, "index", schema, pk, tid)
+        obj.runtime = {"state_table": table, "shared": shared,
+                       "port": shared.subscribe(), "reader": None,
+                       "upstream_subs": self._pending_subs}
+        obj.index_on = stmt.table
+        self._pending_subs = []
+        self.catalog.create(obj)
+        self._iters[stmt.name] = obj.runtime["port"].execute()
+        return "CREATE_INDEX"
 
     def _create_mv(self, stmt: A.CreateMaterializedView) -> str:
         planner = self._make_planner(self._subscribe,
@@ -456,6 +536,8 @@ class Database:
         # threads cannot provide it (GIL)
         planner.placement = self.session_vars.get("streaming_placement",
                                                   "local")
+        planner.delta_join = bool(self.session_vars.get(
+            "streaming_enable_delta_join"))
         self._pending_subs = []
         execu, ns = planner.plan_query(stmt.query)
         schema = ns.schema()
@@ -730,6 +812,14 @@ class Database:
             yield msg
 
     def _drop(self, stmt: A.DropObject) -> str:
+        if stmt.name in self.catalog.objects:
+            dep = self._dependent_of(stmt.name)
+            if dep is not None:
+                # the reference refuses to drop relations with dependent
+                # streaming jobs (catalog ensure_*_not_referenced)
+                raise ValueError(
+                    f"cannot drop {stmt.name!r}: streaming job {dep!r} "
+                    "depends on it (drop that first)")
         try:
             obj = self.catalog.drop(stmt.name)
         except KeyError:
@@ -742,6 +832,35 @@ class Database:
         for shared, port in (obj.runtime or {}).get("upstream_subs", []):
             shared.unsubscribe(port)
         return "DROP"
+
+    def _dependent_of(self, name: str) -> Optional[str]:
+        """A streaming job that reads `name`'s arrangement, if any: an
+        index ON it, or an MV whose lookup join probes its state table."""
+        target = self.catalog.objects[name]
+        st = (target.runtime or {}).get("state_table") \
+            if isinstance(target.runtime, dict) else None
+        tables = {id(st)} if st is not None else set()
+        # an index's own table is probed under the indexed table's NAME
+        for o in self.catalog.objects.values():
+            if getattr(o, "index_on", None) == name \
+                    and isinstance(o.runtime, dict):
+                ist = o.runtime.get("state_table")
+                if ist is not None:
+                    tables.add(id(ist))
+                return o.name        # index depends on its base directly
+        from ..ops.lookup_join import LookupJoinExecutor
+        for o in self.catalog.objects.values():
+            if o.name == name or not isinstance(o.runtime, dict):
+                continue
+            shared = o.runtime.get("shared")
+            if shared is None:
+                continue
+            for e in _walk_executors(shared.upstream):
+                if isinstance(e, LookupJoinExecutor) \
+                        and (id(e.larr.table) in tables
+                             or id(e.rarr.table) in tables):
+                    return o.name
+        return None
 
     # ------------------------------------------------------------------
     # DML

@@ -569,7 +569,24 @@ class Parser:
                 left = A.Join(left, right, "cross", None)
                 continue
             kind = None
-            if self.accept_kw("join"):
+            if self.peek().kind == "id" \
+                    and self.peek().value.lower() == "asof":
+                # ASOF JOIN / ASOF INNER JOIN / ASOF LEFT [OUTER] JOIN
+                # (`parser.rs:5012` Keyword::ASOF)
+                self.next()
+                if self.accept_kw("join"):
+                    kind = "asof_inner"
+                elif self.accept_kw("inner"):
+                    self.expect_kw("join")
+                    kind = "asof_inner"
+                elif self.accept_kw("left"):
+                    self.accept_kw("outer")
+                    self.expect_kw("join")
+                    kind = "asof_left"
+                else:
+                    raise ValueError("expected JOIN, INNER JOIN or LEFT "
+                                     "JOIN after ASOF")
+            elif self.accept_kw("join"):
                 kind = "inner"
             elif self.accept_kw("inner"):
                 self.expect_kw("join")
@@ -661,7 +678,9 @@ class Parser:
     def _alias(self) -> Optional[str]:
         if self.accept_kw("as"):
             return self.ident()
-        if self.peek().kind == "id":
+        # ASOF introduces a join (t ASOF JOIN u ...), never an implicit
+        # alias — `AS asof` still works
+        if self.peek().kind == "id" and self.peek().value.lower() != "asof":
             return self.ident()
         return None
 
@@ -882,6 +901,17 @@ class Parser:
                 while self.accept("op", ","):
                     args.append(self.parse_expr())
             self.expect("op", ")")
+            within = None
+            if self.peek().kind == "id" and self.peek().value == "within" \
+                    and self.peek(1).kind == "kw" \
+                    and self.peek(1).value == "group":
+                self.next()
+                self.next()
+                self.expect("op", "(")
+                self.expect_kw("order")
+                self.expect_kw("by")
+                within = self.parse_expr()
+                self.expect("op", ")")
             filt = None
             if self.peek().kind == "id" and self.peek().value == "filter" \
                     and self.peek(1).kind == "op" \
@@ -894,7 +924,8 @@ class Parser:
             over = None
             if self.accept_kw("over"):
                 over = self._window_spec()
-            return A.FuncCall(name, args, distinct, over, filt)
+            return A.FuncCall(name, args, distinct, over, filt,
+                              within_group=within)
         if self.accept("op", "."):
             col = self.ident()
             return A.Col(col, table=name)

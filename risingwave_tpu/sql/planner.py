@@ -269,8 +269,14 @@ class Planner:
     def __init__(self, subscribe: Callable[[str], Tuple[Executor, Schema]],
                  make_state: Optional[Callable[[Sequence[DataType],
                                                 Sequence[int]], Any]] = None,
-                 device=None, barrier_source=None, watermark_of=None):
+                 device=None, barrier_source=None, watermark_of=None,
+                 state_table_of=None):
         self.subscribe = subscribe
+        # name -> StateTable | None: the object's arrangement, for
+        # lookup/delta joins (ops/lookup_join.py)
+        self.state_table_of = state_table_of
+        # SET streaming_enable_delta_join (stamped by Database per CREATE)
+        self.delta_join = False
         # state-table factory: (dtypes, pk) -> StateTable | None. Called in
         # a DETERMINISTIC order per statement so table ids line up when the
         # DDL log replays on recovery.
@@ -512,6 +518,9 @@ class Planner:
         rexec, rns = self._plan_table(ref.right)
         ns = lns.concat(rns)
         conjuncts = _split_and(ref.on)
+        if ref.kind in ("asof_inner", "asof_left"):
+            return self._plan_asof_join(ref, lexec, lns, rexec, rns, ns,
+                                        conjuncts)
         if ref.kind == "cross":
             # comma-join: steal equi conjuncts from the WHERE clause (the
             # reference's cross-join elimination / predicate-pushdown-into-
@@ -547,6 +556,14 @@ class Planner:
             for r in residual[1:]:
                 node = A.BinOp("and", node, r)
             cond = Binder(ns).bind(node)
+        if self.delta_join and ref.kind == "inner" \
+                and self.state_table_of is not None \
+                and isinstance(ref.left, A.NamedTable) \
+                and isinstance(ref.right, A.NamedTable):
+            lookup = self._try_lookup_join(ref, lexec, rexec, lkeys, rkeys,
+                                           cond)
+            if lookup is not None:
+                return lookup, ns
         ldtypes = [c.dtype for c in lns.cols]
         rdtypes = [c.dtype for c in rns.cols]
         # both dispatch paths share one state-table layout (row + degree,
@@ -567,6 +584,71 @@ class Planner:
                 condition=cond,
                 left_state=left_state, right_state=right_state)
         return execu, ns
+
+    def _try_lookup_join(self, ref: A.Join, lexec, rexec, lkeys, rkeys,
+                         cond) -> Optional[Executor]:
+        """Arrangement-sharing lookup/delta join when both sides' join
+        keys are pk prefixes of their state tables (the reference's
+        delta-join rule requires exactly this index property,
+        `stream_delta_join.rs`); None -> fall back to hash join."""
+        from ..ops.lookup_join import LookupJoinExecutor
+        lt = self.state_table_of(ref.left.name, lkeys)
+        rt = self.state_table_of(ref.right.name, rkeys)
+        if lt is None or rt is None:
+            return None                 # keys not indexed -> hash join
+        return LookupJoinExecutor(lexec, rexec, lkeys, rkeys, lt, rt,
+                                  condition=cond)
+
+    def _plan_asof_join(self, ref: A.Join, lexec, lns, rexec, rns, ns,
+                        conjuncts) -> Tuple[Executor, Namespace]:
+        """ASOF [LEFT] JOIN: equi keys + exactly ONE inequality conjunct
+        (`stream_asof_join.rs` / `asof_join.rs` AsOfDesc)."""
+        from ..ops.asof_join import AsOfJoinExecutor
+        nl = len(lns.cols)
+        lkeys: List[int] = []
+        rkeys: List[int] = []
+        ineq: Optional[Tuple[int, int, str]] = None   # (l, r, op as l-op-r)
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+        for c in conjuncts:
+            pair = _equi_pair(c, ns, nl)
+            if pair is not None:
+                lkeys.append(pair[0])
+                rkeys.append(pair[1] - nl)
+                continue
+            if isinstance(c, A.BinOp) and c.op in flip \
+                    and isinstance(c.left, A.Col) \
+                    and isinstance(c.right, A.Col):
+                li = ns.resolve(c.left.name, c.left.table)
+                ri = ns.resolve(c.right.name, c.right.table)
+                op = c.op
+                if ri < nl <= li:
+                    li, ri, op = ri, li, flip[op]
+                if li < nl <= ri:
+                    if ineq is not None:
+                        raise ValueError("ASOF JOIN requires exactly one "
+                                         "inequality condition")
+                    ineq = (li, ri - nl, op)
+                    continue
+            raise ValueError("unsupported ASOF JOIN condition (equi "
+                             "conjuncts + one column inequality only)")
+        if not lkeys:
+            raise ValueError("ASOF JOIN requires at least one "
+                             "equi-condition")
+        if ineq is None:
+            raise ValueError("ASOF JOIN requires an inequality condition")
+        ldtypes = [c.dtype for c in lns.cols]
+        rdtypes = [c.dtype for c in rns.cols]
+        left_state = self.make_state(ldtypes, list(range(len(ldtypes))))
+        right_state = self.make_state(rdtypes, list(range(len(rdtypes))))
+        execu = AsOfJoinExecutor(
+            lexec, rexec, lkeys, rkeys, ineq[0], ineq[1], ineq[2],
+            left_outer=ref.kind == "asof_left",
+            left_pk=lns.stream_key, right_pk=rns.stream_key,
+            left_state=left_state, right_state=right_state)
+        # exactly (left: =1 | inner: <=1) output row per left row: the
+        # LEFT stream key alone identifies output rows
+        out_ns = Namespace(ns.cols, list(lns.stream_key), None)
+        return execu, out_ns
 
     def _plan_temporal_join(self, ref: A.Join) -> Tuple[Executor, Namespace]:
         """stream JOIN t FOR SYSTEM_TIME AS OF PROCTIME() ON ...
@@ -1026,6 +1108,25 @@ class Planner:
         pre_names = [f"g{i}" for i in range(len(group_exprs))]
         calls: List[AggCall] = []
         for i, a in enumerate(aggs):
+            direct: Tuple = ()
+            if a.name == "approx_percentile":
+                # ordered-set: approx_percentile(q[, rel_err]) WITHIN
+                # GROUP (ORDER BY v) — direct args must be literals
+                # (`binder/expr/function/aggregate.rs:183`)
+                if a.within_group is None or not 1 <= len(a.args) <= 2:
+                    raise ValueError(
+                        "approx_percentile(quantile[, relative_error]) "
+                        "WITHIN GROUP (ORDER BY col)")
+                dvals = []
+                for x in a.args:
+                    lit = b.bind(x)
+                    if not isinstance(lit, Literal) or lit.value is None:
+                        raise ValueError("approx_percentile direct "
+                                         "arguments must be constants")
+                    dvals.append(float(lit.value))
+                direct = tuple(dvals)
+                a = A.FuncCall(a.name, [a.within_group], a.distinct,
+                               a.over, a.filter)
             if a.args:
                 arg = b.bind(a.args[0])
                 idx = len(pre_exprs)
@@ -1042,7 +1143,7 @@ class Planner:
                 pre_names.append(f"f{i}")
                 filt_ref = InputRef(fi, T.BOOLEAN)
             calls.append(AggCall(a.name, call_arg, distinct=a.distinct,
-                                 filter=filt_ref))
+                                 filter=filt_ref, direct_args=direct))
         if not pre_exprs:
             # count(*)-only: chunks must keep their cardinality, and a
             # zero-column chunk cannot (`DataChunk` derives capacity from
@@ -1267,7 +1368,8 @@ def _clone_with(node: A.ExprNode, f) -> A.ExprNode:
         return A.UnaryOp(node.op, f(node.operand))
     if isinstance(node, A.FuncCall):
         return A.FuncCall(node.name, [f(a) for a in node.args],
-                          node.distinct, node.over, node.filter)
+                          node.distinct, node.over, node.filter,
+                          within_group=node.within_group)
     if isinstance(node, A.CaseExpr):
         return A.CaseExpr(f(node.operand) if node.operand else None,
                           [(f(c), f(r)) for c, r in node.branches],

@@ -1,0 +1,115 @@
+"""approx_percentile: ordered-set syntax, log-bucket accuracy bound,
+retraction, negative/zero values, grouping, recovery.
+
+Reference: `src/stream/src/executor/approx_percentile/` (bucket =
+ceil(log_base |v|), base = (1+e)/(1-e), output walk neg desc -> zeros ->
+pos asc, value = ±2·base^i/(base+1));
+`binder/expr/function/aggregate.rs:183` (direct-arg validation).
+"""
+import pytest
+
+from risingwave_tpu.expr.agg import AggCall, ApproxPercentileState, \
+    create_agg_state
+from risingwave_tpu.sql import Database
+
+
+def ticks(db, n=3):
+    for _ in range(n):
+        db.tick()
+
+
+class TestState:
+    def test_accuracy_bound(self):
+        st = ApproxPercentileState(0.5, 0.01)
+        for v in range(1, 1001):
+            st.apply(1, v)
+        assert abs(st.output() - 500) / 500 <= 0.02
+
+    def test_retraction(self):
+        st = ApproxPercentileState(0.5, 0.01)
+        for v in range(1, 101):
+            st.apply(1, v)
+        for v in range(51, 101):
+            st.apply(-1, v)
+        assert abs(st.output() - 25) / 25 <= 0.03
+        for v in range(1, 51):
+            st.apply(-1, v)
+        assert st.output() is None
+
+    def test_negatives_zeros_and_extremes(self):
+        st = ApproxPercentileState(0.5, 0.01)
+        for v in (-100, -10, 0, 0, 10, 100):
+            st.apply(1, v)
+        assert st.output() == 0.0
+        lo = ApproxPercentileState(0.0, 0.01)
+        hi = ApproxPercentileState(1.0, 0.01)
+        for v in (-100, -10, 0, 10, 100):
+            lo.apply(1, v)
+            hi.apply(1, v)
+        assert abs(lo.output() + 100) / 100 <= 0.02
+        assert abs(hi.output() - 100) / 100 <= 0.02
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            ApproxPercentileState(1.5, 0.01)
+        with pytest.raises(ValueError):
+            ApproxPercentileState(0.5, 0.0)
+
+    def test_factory_defaults(self):
+        st = create_agg_state(AggCall("approx_percentile",
+                                      direct_args=(0.9, 0.05)))
+        assert st.quantile == 0.9
+
+
+class TestSql:
+    def test_grouped_with_retraction(self):
+        db = Database()
+        db.run("CREATE TABLE t (k BIGINT, v DOUBLE PRECISION)")
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT k,"
+               " approx_percentile(0.5, 0.01) WITHIN GROUP (ORDER BY v)"
+               " AS p FROM t GROUP BY k")
+        db.run("INSERT INTO t VALUES "
+               + ", ".join(f"(1, {v})" for v in range(1, 101)) + ", "
+               + ", ".join(f"(2, {v})" for v in range(1, 11)))
+        ticks(db)
+        rows = dict(db.query("SELECT * FROM m"))
+        assert abs(rows[1] - 50) / 50 <= 0.03
+        assert abs(rows[2] - 5) / 5 <= 0.03
+        db.run("DELETE FROM t WHERE k = 1 AND v > 50")
+        ticks(db)
+        rows = dict(db.query("SELECT * FROM m"))
+        assert abs(rows[1] - 25) / 25 <= 0.05
+
+    def test_requires_within_group(self):
+        db = Database()
+        db.run("CREATE TABLE t (v BIGINT)")
+        with pytest.raises(ValueError, match="WITHIN GROUP"):
+            db.run("CREATE MATERIALIZED VIEW m AS SELECT"
+                   " approx_percentile(0.5, 0.01) FROM t")
+
+    def test_direct_args_must_be_constant(self):
+        db = Database()
+        db.run("CREATE TABLE t (v DOUBLE PRECISION)")
+        with pytest.raises(ValueError, match="constant"):
+            db.run("CREATE MATERIALIZED VIEW m AS SELECT"
+                   " approx_percentile(v, 0.01) WITHIN GROUP (ORDER BY v)"
+                   " FROM t")
+
+    def test_recovery(self, tmp_path):
+        d = str(tmp_path / "db")
+        db = Database(data_dir=d)
+        db.run("CREATE TABLE t (v DOUBLE PRECISION)")
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT"
+               " approx_percentile(0.5, 0.01) WITHIN GROUP (ORDER BY v)"
+               " AS p FROM t")
+        db.run("INSERT INTO t VALUES "
+               + ", ".join(f"({v})" for v in range(1, 101)))
+        ticks(db)
+        before = db.query("SELECT * FROM m")
+        del db
+        db2 = Database(data_dir=d)
+        ticks(db2)
+        assert db2.query("SELECT * FROM m") == before
+        db2.run("DELETE FROM t WHERE v > 50")
+        ticks(db2)
+        assert abs(db2.query("SELECT * FROM m")[0][0] - 25) / 25 <= 0.05

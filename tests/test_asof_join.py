@@ -1,0 +1,141 @@
+"""ASOF join: SQL surface, best-match selection, displacement on better
+matches, left-outer NULL padding, recovery.
+
+Reference: `src/stream/src/executor/asof_join.rs` (match = closest right
+row satisfying the single inequality, per equi key; a newly arrived
+better match displaces the emitted pair), `parser.rs:5012` (ASOF / ASOF
+LEFT JOIN syntax).
+"""
+from risingwave_tpu.sql import Database
+
+
+def nsort(rows):
+    return sorted(rows, key=lambda r: tuple((v is None, v) for v in r))
+
+
+def ticks(db, n=3):
+    for _ in range(n):
+        db.tick()
+
+
+def mk(sql_mv):
+    db = Database()
+    db.run("CREATE TABLE trades (tk VARCHAR, tt BIGINT, qty BIGINT)")
+    db.run("CREATE TABLE quotes (qk VARCHAR, qt BIGINT, px BIGINT)")
+    db.run(sql_mv)
+    return db
+
+
+ASOF_INNER = ("CREATE MATERIALIZED VIEW m AS SELECT tk, tt, qty, qt, px "
+              "FROM trades ASOF JOIN quotes "
+              "ON tk = qk AND tt >= qt")
+ASOF_LEFT = ("CREATE MATERIALIZED VIEW m AS SELECT tk, tt, qty, qt, px "
+             "FROM trades ASOF LEFT JOIN quotes "
+             "ON tk = qk AND tt >= qt")
+
+
+class TestAsOfInner:
+    def test_picks_latest_quote_at_or_before(self):
+        db = mk(ASOF_INNER)
+        db.run("INSERT INTO quotes VALUES ('a', 10, 100), ('a', 20, 200),"
+               " ('a', 30, 300)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 1)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, 20, 200)]
+
+    def test_no_match_emits_nothing(self):
+        db = mk(ASOF_INNER)
+        db.run("INSERT INTO quotes VALUES ('a', 50, 500)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 1), ('b', 99, 2)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == []
+
+    def test_better_quote_displaces_match(self):
+        db = mk(ASOF_INNER)
+        db.run("INSERT INTO trades VALUES ('a', 25, 1)")
+        db.run("INSERT INTO quotes VALUES ('a', 10, 100)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, 10, 100)]
+        # closer quote arrives -> the emitted pair is displaced
+        db.run("INSERT INTO quotes VALUES ('a', 20, 200)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, 20, 200)]
+        # deleting it falls back to the previous best
+        db.run("DELETE FROM quotes WHERE qt = 20")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, 10, 100)]
+
+    def test_trade_delete_retracts(self):
+        db = mk(ASOF_INNER)
+        db.run("INSERT INTO quotes VALUES ('a', 10, 100)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 1)")
+        ticks(db)
+        db.run("DELETE FROM trades WHERE tt = 25")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == []
+
+    def test_strict_inequality(self):
+        db = mk("CREATE MATERIALIZED VIEW m AS SELECT tk, tt, qt "
+                "FROM trades ASOF JOIN quotes ON tk = qk AND tt > qt")
+        db.run("INSERT INTO quotes VALUES ('a', 25, 1), ('a', 10, 2)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 9)")
+        ticks(db)
+        # tt > qt excludes the equal quote; best below is 10
+        assert db.query("SELECT * FROM m") == [("a", 25, 10)]
+
+    def test_forward_direction(self):
+        db = mk("CREATE MATERIALIZED VIEW m AS SELECT tk, tt, qt "
+                "FROM trades ASOF JOIN quotes ON tk = qk AND tt <= qt")
+        db.run("INSERT INTO quotes VALUES ('a', 40, 1), ('a', 30, 2),"
+               " ('a', 10, 3)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 9)")
+        ticks(db)
+        # smallest quote time >= 25
+        assert db.query("SELECT * FROM m") == [("a", 25, 30)]
+
+
+class TestAsOfLeft:
+    def test_null_padding_then_match(self):
+        db = mk(ASOF_LEFT)
+        db.run("INSERT INTO trades VALUES ('a', 25, 1)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, None, None)]
+        db.run("INSERT INTO quotes VALUES ('a', 20, 200)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, 20, 200)]
+        db.run("DELETE FROM quotes WHERE qt = 20")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, None, None)]
+
+    def test_multiple_keys_and_trades(self):
+        db = mk(ASOF_LEFT)
+        db.run("INSERT INTO quotes VALUES ('a', 10, 100), ('b', 5, 50)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 1), ('b', 3, 2),"
+               " ('c', 7, 3)")
+        ticks(db)
+        assert nsort(db.query("SELECT * FROM m")) == nsort([
+            ("a", 25, 1, 10, 100),
+            ("b", 3, 2, None, None),
+            ("c", 7, 3, None, None)])
+
+
+class TestAsOfRecovery:
+    def test_state_survives_restart(self, tmp_path):
+        d = str(tmp_path / "db")
+        db = Database(data_dir=d)
+        db.run("CREATE TABLE trades (tk VARCHAR, tt BIGINT, qty BIGINT)")
+        db.run("CREATE TABLE quotes (qk VARCHAR, qt BIGINT, px BIGINT)")
+        db.run(ASOF_INNER.replace("MATERIALIZED VIEW m",
+                                  "MATERIALIZED VIEW m"))
+        db.run("INSERT INTO quotes VALUES ('a', 10, 100)")
+        db.run("INSERT INTO trades VALUES ('a', 25, 1)")
+        ticks(db)
+        assert db.query("SELECT * FROM m") == [("a", 25, 1, 10, 100)]
+        del db
+        db2 = Database(data_dir=d)
+        ticks(db2)
+        assert db2.query("SELECT * FROM m") == [("a", 25, 1, 10, 100)]
+        # post-recovery the join keeps maintaining
+        db2.run("INSERT INTO quotes VALUES ('a', 20, 200)")
+        ticks(db2)
+        assert db2.query("SELECT * FROM m") == [("a", 25, 1, 20, 200)]

@@ -1,0 +1,157 @@
+"""Lookup (delta) join: arrangement sharing, delta-join algebra parity
+with the hash join, stateless recovery.
+
+Reference: `src/stream/src/executor/lookup.rs`,
+`src/frontend/src/optimizer/plan_node/stream_delta_join.rs`.
+"""
+from risingwave_tpu.sql import Database
+
+
+def ticks(db, n=3):
+    for _ in range(n):
+        db.tick()
+
+
+def mk(delta: bool):
+    db = Database()
+    if delta:
+        db.run("SET streaming_enable_delta_join TO true")
+    db.run("CREATE TABLE users (uid BIGINT PRIMARY KEY, name VARCHAR)")
+    db.run("CREATE TABLE orders (oid BIGINT PRIMARY KEY, uid BIGINT,"
+           " amt BIGINT)")
+    # the fk side needs an arrangement keyed by the join key — an index,
+    # exactly the reference delta-join rule's requirement
+    db.run("CREATE INDEX orders_by_uid ON orders (uid)")
+    return db
+
+
+JOIN_MV = ("CREATE MATERIALIZED VIEW j AS SELECT orders.oid, users.name,"
+           " orders.amt FROM orders JOIN users ON orders.oid = oid")
+
+
+class TestLookupJoin:
+    def _drive(self, db):
+        db.run("INSERT INTO users VALUES (1, 'ann'), (2, 'bo')")
+        db.run("INSERT INTO orders VALUES (10, 1, 100), (11, 2, 200),"
+               " (12, 3, 300)")
+        ticks(db)
+
+    def test_planned_as_lookup_join(self):
+        db = mk(True)
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+               "FROM orders o JOIN users u ON o.uid = u.uid")
+        names = [type(e).__name__ for e in _executors(db, "j")]
+        assert "LookupJoinExecutor" in names, names
+
+    def test_parity_with_hash_join(self):
+        for delta in (False, True):
+            db = mk(delta)
+            db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+                   "FROM orders o JOIN users u ON o.uid = u.uid")
+            self._drive(db)
+            rows = sorted(db.query("SELECT * FROM j"))
+            assert rows == [(100, "ann"), (200, "bo")], (delta, rows)
+
+    def test_updates_both_sides(self):
+        db = mk(True)
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+               "FROM orders o JOIN users u ON o.uid = u.uid")
+        self._drive(db)
+        # late user arrives: existing order joins up
+        db.run("INSERT INTO users VALUES (3, 'cy')")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM j")) == \
+            [(100, "ann"), (200, "bo"), (300, "cy")]
+        # delete retracts pairs
+        db.run("DELETE FROM users WHERE uid = 1")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM j")) == \
+            [(200, "bo"), (300, "cy")]
+        db.run("DELETE FROM orders WHERE oid = 11")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM j")) == [(300, "cy")]
+
+    def test_same_epoch_both_sides_no_double_count(self):
+        """Rows for the same key arriving on BOTH sides within one epoch
+        must produce each pair exactly once (the dA json B_old vs
+        A_new json dB split)."""
+        db = mk(True)
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+               "FROM orders o JOIN users u ON o.uid = u.uid")
+        # single epoch: both the user and their order
+        db.run("INSERT INTO users VALUES (7, 'zed')")
+        db.run("INSERT INTO orders VALUES (70, 7, 700)")
+        ticks(db)
+        assert db.query("SELECT * FROM j") == [(700, "zed")]
+
+    def test_non_indexed_key_falls_back_to_hash_join(self):
+        db = mk(True)
+        # join key amt is not a pk prefix of orders -> hash join fallback
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+               "FROM orders o JOIN users u ON o.amt = u.uid")
+        names = [type(e).__name__ for e in _executors(db, "j")]
+        assert "HashJoinExecutor" in names, names
+
+    def test_recovery_is_stateless(self, tmp_path):
+        d = str(tmp_path / "db")
+        db = Database(data_dir=d)
+        db.run("SET streaming_enable_delta_join TO true")
+        db.run("CREATE TABLE users (uid BIGINT PRIMARY KEY, name VARCHAR)")
+        db.run("CREATE TABLE orders (oid BIGINT PRIMARY KEY, uid BIGINT,"
+               " amt BIGINT)")
+        db.run("CREATE INDEX orders_by_uid ON orders (uid)")
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+               "FROM orders o JOIN users u ON o.uid = u.uid")
+        db.run("INSERT INTO users VALUES (1, 'ann')")
+        db.run("INSERT INTO orders VALUES (10, 1, 100)")
+        ticks(db)
+        del db
+        db2 = Database(data_dir=d)
+        ticks(db2)
+        assert db2.query("SELECT * FROM j") == [(100, "ann")]
+        db2.run("INSERT INTO orders VALUES (11, 1, 111)")
+        ticks(db2)
+        assert sorted(db2.query("SELECT * FROM j")) == \
+            [(100, "ann"), (111, "ann")]
+
+
+class TestDropGuards:
+    def test_drop_probed_index_refused_then_allowed(self):
+        import pytest
+        db = mk(True)
+        db.run("CREATE MATERIALIZED VIEW j AS SELECT o.amt, u.name "
+               "FROM orders o JOIN users u ON o.uid = u.uid")
+        with pytest.raises(ValueError, match="depends on it"):
+            db.run("DROP INDEX orders_by_uid")
+        with pytest.raises(ValueError, match="depends on it"):
+            db.run("DROP TABLE users")        # probed directly by pk
+        db.run("DROP MATERIALIZED VIEW j")
+        db.run("DROP INDEX orders_by_uid")
+        ticks(db)                             # no livelock after the drop
+        db.run("INSERT INTO users VALUES (1, 'ann')")
+        ticks(db)
+        assert db.query("SELECT name FROM users") == [("ann",)]
+
+
+def _executors(db, name):
+    """Walk the MV's executor tree."""
+    obj = db.catalog.get(name)
+    shared = obj.runtime.get("shared")
+    root = shared.upstream if shared is not None else None
+    out = []
+    stack = [root] if root is not None else []
+    seen = set()
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        out.append(e)
+        for attr in ("input", "left_exec", "right_exec", "port",
+                     "barrier_source", "inputs"):
+            v = getattr(e, attr, None)
+            if isinstance(v, list):
+                stack.extend(v)
+            elif v is not None:
+                stack.append(v)
+    return out
