@@ -117,6 +117,26 @@ def _person_count_before(event_ids: np.ndarray) -> np.ndarray:
     return full * PERSON_PROPORTION + (rem > 0)
 
 
+def _mulhi_bound(r: np.ndarray, m: np.ndarray) -> np.ndarray:
+    """Uniform u64 `r` -> [0, m): high 64 bits of r*m (Lemire reduce).
+    Mirrors `device/nexmark_gen.py::_mulhi_bound` EXACTLY — the device
+    generator avoids 64-bit vector division (XLA-compile-pathological),
+    and host/device surrogate streams must stay bit-identical."""
+    mask = np.uint64(0xFFFFFFFF)
+    r = r.astype(np.uint64)
+    m = m.astype(np.uint64)
+    a0, a1 = r & mask, r >> np.uint64(32)
+    b0, b1 = m & mask, m >> np.uint64(32)
+    m00 = a0 * b0
+    m01 = a0 * b1
+    m10 = a1 * b0
+    m11 = a1 * b1
+    sh = np.uint64(32)
+    carry = (m00 >> sh) + (m01 & mask) + (m10 & mask)
+    return (m11 + (m01 >> sh) + (m10 >> sh)
+            + (carry >> sh)).astype(np.int64)
+
+
 def _auction_count_before(event_ids: np.ndarray) -> np.ndarray:
     full, rem = np.divmod(event_ids, TOTAL_PROPORTION)
     return full * AUCTION_PROPORTION + np.clip(rem - PERSON_PROPORTION, 0,
@@ -178,8 +198,8 @@ class NexmarkGenerator:
         r2 = self._rand(ids, 11)
         seller_ord = np.where(
             hot,
-            n_person - 1 - (r2 % hot_span.astype(np.uint64)).astype(np.int64),
-            (r2 % n_person.astype(np.uint64)).astype(np.int64))
+            n_person - 1 - _mulhi_bound(r2, hot_span),
+            _mulhi_bound(r2, n_person))
         seller = (FIRST_PERSON_ID + seller_ord).astype(np.int64)
         category = (FIRST_CATEGORY_ID
                     + (self._rand(ids, 12) % np.uint64(5)).astype(np.int64))
@@ -214,8 +234,8 @@ class NexmarkGenerator:
         hot_span = np.maximum(n_auction // HOT_AUCTION_RATIO, 1)
         auction_ord = np.where(
             hot_a,
-            n_auction - 1 - (r2 % hot_span.astype(np.uint64)).astype(np.int64),
-            (r2 % n_auction.astype(np.uint64)).astype(np.int64))
+            n_auction - 1 - _mulhi_bound(r2, hot_span),
+            _mulhi_bound(r2, n_auction))
         auction = (FIRST_AUCTION_ID + auction_ord).astype(np.int64)
         r3 = self._rand(event_ids, 22)
         hot_b = (r3 % np.uint64(100)) < np.uint64(90)
@@ -223,8 +243,8 @@ class NexmarkGenerator:
         bspan = np.maximum(n_person // HOT_BIDDER_RATIO, 1)
         bidder_ord = np.where(
             hot_b,
-            n_person - 1 - (r4 % bspan.astype(np.uint64)).astype(np.int64),
-            (r4 % n_person.astype(np.uint64)).astype(np.int64))
+            n_person - 1 - _mulhi_bound(r4, bspan),
+            _mulhi_bound(r4, n_person))
         bidder = (FIRST_PERSON_ID + bidder_ord).astype(np.int64)
         price = 100 + (self._rand(event_ids, 24) % np.uint64(10_000)).astype(np.int64)
         cols = [Column(T.INT64, auction), Column(T.INT64, bidder),

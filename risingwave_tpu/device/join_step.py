@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sorted_state import EMPTY_KEY, sanitize_keys
+from .sorted_state import EMPTY_KEY, running_sum, sanitize_keys, search_method
 
 
 class JoinSide(NamedTuple):
@@ -67,7 +67,7 @@ def batch_reduce_rows(jk, pk, signs, mask, vals):
     signs, vals = out[0], list(out[1:])
     same = jnp.concatenate([jnp.zeros((1,), bool),
                             (jk[1:] == jk[:-1]) & (pk[1:] == pk[:-1])])
-    seg = jnp.cumsum(~same) - 1
+    seg = running_sum(~same) - 1
     usign = jax.ops.segment_sum(signs.astype(jnp.int32), seg, num_segments=b)
     ujk = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(jk)
     upk = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(pk)
@@ -119,13 +119,13 @@ def probe(side: JoinSide, qjk, qmask, m: int):
     """All matches of each probe key: (probe_row[m], state_idx[m], mask[m],
     needed_pairs). Ragged -> static via cumsum + searchsorted expansion."""
     qjk = jnp.where(qmask, qjk, EMPTY_KEY)
-    lo = jnp.searchsorted(side.jk, qjk, side="left", method="sort")
-    hi = jnp.searchsorted(side.jk, qjk, side="right", method="sort")
+    lo = jnp.searchsorted(side.jk, qjk, side="left", method=search_method())
+    hi = jnp.searchsorted(side.jk, qjk, side="right", method=search_method())
     cnt = jnp.where(qmask & (qjk != EMPTY_KEY), hi - lo, 0)
-    off = jnp.cumsum(cnt)
+    off = running_sum(cnt)
     total = off[-1]
     t = jnp.arange(m)
-    row = jnp.searchsorted(off, t, side="right", method="sort")
+    row = jnp.searchsorted(off, t, side="right", method=search_method())
     row_c = jnp.clip(row, 0, qjk.shape[0] - 1)
     prev = jnp.where(row_c > 0, off[row_c - 1], 0)
     sidx = lo[row_c] + (t - prev)

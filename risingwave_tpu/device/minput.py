@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .sorted_state import EMPTY_KEY
+from .sorted_state import EMPTY_KEY, running_sum, search_method
 
 _LOW63 = np.int64(0x7FFFFFFFFFFFFFFF)
 
@@ -83,7 +83,7 @@ def ms_batch_reduce(k1, k2, delta, mask):
     (k1, k2), (delta,) = sort_cols([k1, k2], [delta])
     same = jnp.concatenate([jnp.zeros((1,), bool),
                             (k1[1:] == k1[:-1]) & (k2[1:] == k2[:-1])])
-    seg = jnp.cumsum(~same) - 1
+    seg = running_sum(~same) - 1
     ud = jax.ops.segment_sum(delta, seg, num_segments=b)
     u1 = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(k1)
     u2 = jnp.full((b,), EMPTY_KEY, jnp.int64).at[seg].set(k2)
@@ -121,8 +121,8 @@ def ms_group_minmax(ms: SortedMultiset, groups):
     """Per queried group: (found, min value, max value). Groups absent from
     the multiset return found=False (gate on it). k1 is itself sorted
     because the pairs are lexicographic."""
-    lo = jnp.searchsorted(ms.k1, groups, side="left", method="sort")
-    hi = jnp.searchsorted(ms.k1, groups, side="right", method="sort")
+    lo = jnp.searchsorted(ms.k1, groups, side="left", method=search_method())
+    hi = jnp.searchsorted(ms.k1, groups, side="right", method=search_method())
     found = (hi > lo) & (groups != EMPTY_KEY)
     lo_c = jnp.minimum(lo, ms.capacity - 1)
     hi_c = jnp.clip(hi - 1, 0, ms.capacity - 1)

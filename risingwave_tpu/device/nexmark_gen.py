@@ -61,6 +61,27 @@ def _mod(r, k: int):
     return (r % _U(k)).astype(jnp.int64)
 
 
+def _mulhi_bound(r, m):
+    """Uniform u64 `r` -> [0, m) via the high 64 bits of r*m (Lemire's
+    multiply-shift). 64-bit division-by-vector is pathological for XLA
+    backends (measured ~7s of LLVM time PER division on CPU; TPU lowers
+    64-bit div to wide-arithmetic emulation) — four 32x32 multiplies and
+    shifts compile instantly. The host generator uses the identical
+    formula (`connectors/nexmark.py`) so surrogate streams stay
+    bit-identical."""
+    mask = _U(0xFFFFFFFF)
+    a0, a1 = r & mask, r >> 32
+    b = m.astype(jnp.uint64)
+    b0, b1 = b & mask, b >> 32
+    m00 = a0 * b0
+    m01 = a0 * b1
+    m10 = a1 * b0
+    m11 = a1 * b1
+    carry = (m00 >> 32) + (m01 & mask) + (m10 & mask)
+    return (m11 + (m01 >> 32) + (m10 >> 32)
+            + (carry >> 32)).astype(jnp.int64)
+
+
 def event_kinds(event_ids):
     """0=person, 1=auction, 2=bid (host `_event_kinds`)."""
     m = event_ids % TOTAL_PROPORTION
@@ -88,9 +109,8 @@ def _hot_pick(rand_hot, rand_pick, n_entities, hot_ratio: int, hot_mod: int):
     hot = _mod(rand_hot, hot_mod) != 0 if hot_mod == 10 \
         else _mod(rand_hot, 100) < 90
     span = jnp.maximum(n_entities // hot_ratio, 1)
-    ord_hot = n_entities - 1 - (rand_pick % span.astype(jnp.uint64)
-                                ).astype(jnp.int64)
-    ord_cold = (rand_pick % n_entities.astype(jnp.uint64)).astype(jnp.int64)
+    ord_hot = n_entities - 1 - _mulhi_bound(rand_pick, span)
+    ord_cold = _mulhi_bound(rand_pick, n_entities)
     return jnp.where(hot, ord_hot, ord_cold)
 
 

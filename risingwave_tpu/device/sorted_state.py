@@ -18,7 +18,7 @@ old state to 2x and re-runs (one recompile per capacity bucket).
 from __future__ import annotations
 
 import enum
-from typing import NamedTuple, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -124,7 +124,7 @@ def batch_reduce(keys: jax.Array, mask: jax.Array,
     arrival, vals = sorted_cols[0], list(sorted_cols[1:])
     boundary = jnp.concatenate(
         [jnp.ones((1,), bool), keys[1:] != keys[:-1]])
-    seg = jnp.cumsum(boundary) - 1                      # segment id per row
+    seg = running_sum(boundary) - 1
     ukeys = jnp.full((b,), EMPTY_KEY, dtype=jnp.int64).at[seg].set(keys)
     out = []
     for v, k in zip(vals, kinds):
@@ -152,17 +152,50 @@ def batch_reduce(keys: jax.Array, mask: jax.Array,
     return ukeys, tuple(out), ucount
 
 
+_CHEAP_COMPILE: Optional[bool] = None
+
+
+def cheap_compile() -> bool:
+    """Backend-keyed kernel policy. On CPU, XLA's compile time for
+    sorts grows ~18s PER OPERAND at bench shapes, cumsum costs ~50s and
+    searchsorted(method='sort') ~45s — while gathers/scans compile in
+    ~1s with equal CPU runtime, so the CPU (test-suite) build prefers
+    compile-cheap forms. On TPU the variadic sort / co-sorted
+    searchsorted are the RUNTIME-optimal forms (gather/scatter are the
+    chip's weakest primitives; its sort networks the strongest — r04
+    measurements) and compile acceptably, so they stay."""
+    global _CHEAP_COMPILE
+    if _CHEAP_COMPILE is None:
+        _CHEAP_COMPILE = jax.default_backend() == "cpu"
+    return _CHEAP_COMPILE
+
+
+def search_method() -> str:
+    return "scan" if cheap_compile() else "sort"
+
+
+def running_sum(x: jax.Array) -> jax.Array:
+    """Inclusive prefix sum of an int mask/count vector."""
+    if cheap_compile():
+        return jax.lax.associative_scan(jnp.add, x.astype(jnp.int64))
+    return jnp.cumsum(x.astype(jnp.int64))
+
+
 def sort_cols(keys: Sequence[jax.Array], cols: Sequence[jax.Array]
               ) -> Tuple[Tuple[jax.Array, ...], Tuple[jax.Array, ...]]:
-    """Stable variadic sort: all payload columns ride ONE fused bitonic
-    pass (`lax.sort` num_keys=len(keys)) — measured ~6x faster on TPU than
-    argsort + per-column gathers, and ~60x faster than searchsorted rank
-    merges + scatters (TPU scatters with arbitrary indices are the worst
-    primitive on the chip; its sorting networks are the best)."""
+    """Stable sort of payload columns by key columns: one variadic
+    `lax.sort` on TPU (fastest runtime); rank-sort + gathers on CPU
+    beyond 2 payloads (fastest compile — see cheap_compile)."""
     nk = len(keys)
-    out = jax.lax.sort(list(keys) + list(cols), num_keys=nk,
-                       is_stable=True)
-    return tuple(out[:nk]), tuple(out[nk:])
+    if len(cols) <= 2 or not cheap_compile():
+        out = jax.lax.sort(list(keys) + list(cols), num_keys=nk,
+                           is_stable=True)
+        return tuple(out[:nk]), tuple(out[nk:])
+    n = keys[0].shape[0]
+    rank = jnp.arange(n, dtype=jnp.int32)
+    out = jax.lax.sort(list(keys) + [rank], num_keys=nk, is_stable=True)
+    idx = out[nk]
+    return tuple(out[:nk]), tuple(c[idx] for c in cols)
 
 
 def compact_rows(alive: jax.Array, keys: Sequence[jax.Array],
@@ -177,8 +210,13 @@ def compact_rows(alive: jax.Array, keys: Sequence[jax.Array],
         + jnp.arange(n, dtype=jnp.int32)
     masked = [jnp.where(alive, a, f) for a, f in
               zip(list(keys) + list(cols), fills)]
-    out = jax.lax.sort([rank] + masked, num_keys=1, is_stable=False)
-    return tuple(a[:out_len] for a in out[1:])
+    if len(masked) <= 3 or not cheap_compile():
+        out = jax.lax.sort([rank] + masked, num_keys=1, is_stable=False)
+        return tuple(a[:out_len] for a in out[1:])
+    _, idx = jax.lax.sort([rank, jnp.arange(n, dtype=jnp.int32)],
+                          num_keys=1, is_stable=False)
+    idx = idx[:out_len]
+    return tuple(a[idx] for a in masked)
 
 
 def merge(state: SortedState, dkeys: jax.Array,
@@ -223,7 +261,7 @@ def lookup(state: SortedState, qkeys: jax.Array
            ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
     """Binary-search gather. Returns (found[B], vals at match — neutral-ish
     garbage where not found; gate on `found`)."""
-    idx = jnp.searchsorted(state.keys, qkeys, method="sort")
+    idx = jnp.searchsorted(state.keys, qkeys, method=search_method())
     idx = jnp.minimum(idx, state.capacity - 1)
     found = (state.keys[idx] == qkeys) & (qkeys != EMPTY_KEY)
     return found, tuple(v[idx] for v in state.vals)

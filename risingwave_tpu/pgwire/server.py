@@ -62,6 +62,50 @@ def _text(v: Any, kind: Optional[TypeKind] = None) -> Optional[bytes]:
 # OIDs whose text values are numeric/bool literals — substituted unquoted
 _UNQUOTED_OIDS = {16, 20, 21, 23, 700, 701, 1700}
 
+_PG_EPOCH_USECS = 946_684_800_000_000      # 2000-01-01 relative to 1970
+_PG_EPOCH_DAYS = 10_957
+
+
+def _decode_binary_param(raw: bytes, oid: int) -> Any:
+    """Binary-format Bind value -> Python value (`pg_extended.rs` binary
+    param decoding). Timestamps/dates arrive relative to 2000-01-01."""
+    if oid == 16:
+        return raw != b"\x00"
+    if oid in (21, 23, 20):
+        return int.from_bytes(raw, "big", signed=True)
+    if oid == 700:
+        return struct.unpack(">f", raw)[0]
+    if oid == 701:
+        return struct.unpack(">d", raw)[0]
+    if oid == 1114:          # timestamp: usecs since 2000-01-01
+        usecs = int.from_bytes(raw, "big", signed=True) + _PG_EPOCH_USECS
+        return _text(usecs, TypeKind.TIMESTAMP).decode()
+    if oid == 1082:          # date: days since 2000-01-01
+        days = int.from_bytes(raw, "big", signed=True) + _PG_EPOCH_DAYS
+        from datetime import date, timedelta
+        return (date(1970, 1, 1) + timedelta(days=days)).isoformat()
+    if oid in (25, 1043, 0):
+        return raw.decode("utf-8")
+    raise ValueError(f"binary parameter format for OID {oid} is not "
+                     "supported")
+
+
+def _typed_text_param(s: str, oid: int) -> Any:
+    """Text-format Bind value -> Python value for AST substitution; the
+    binder's implicit casts coerce strings, so unknown OIDs stay str."""
+    import re
+    if oid in (21, 23, 20):
+        return int(s)
+    if oid in (700, 701):
+        return float(s)
+    if oid == 16:
+        return s.strip().lower() in ("t", "true", "1", "on")
+    if oid == 0 and re.fullmatch(r"-?\d+", s):
+        return int(s)
+    if oid == 0 and re.fullmatch(r"-?\d+\.\d+([eE][+-]?\d+)?", s):
+        return float(s)
+    return s
+
 
 def _sql_segments(sql: str):
     """(text, is_literal) segments — a $n inside a '...' or $$...$$
@@ -158,7 +202,7 @@ class _Conn:
         self.db = db
         self.lock = lock
         self._buf = b""
-        self._portal_sql: Optional[str] = None
+        self._portals: dict = {}
 
     # ---- raw IO ---------------------------------------------------------
     def _recv(self, n: int) -> bytes:
@@ -347,38 +391,101 @@ class _Conn:
                 continue
         self._send(b"n")
 
-    def _bind(self, body: bytes, parse_sql_by_name) -> str:
-        """Bind: substitute text-format parameter values into the prepared
-        statement's SQL (`pg_extended.rs` bind analog)."""
-        _portal, rest = body.split(b"\0", 1)
+    def _bind(self, body: bytes, parse_sql_by_name) -> Tuple[bytes, dict]:
+        """Bind: build a PORTAL from a prepared statement + parameter
+        values (`pg_extended.rs`). The statement was parsed ONCE at
+        Parse; binding substitutes literal nodes into the cached tree —
+        no re-lex/re-parse per Execute. Text- and binary-format values
+        accepted (ints, floats, bool, text, date/timestamp binaries)."""
+        portal_name, rest = body.split(b"\0", 1)
         stmt_name, rest = rest.split(b"\0", 1)
         if stmt_name not in parse_sql_by_name:
             raise KeyError("prepared statement does not exist")
-        sql, oids = parse_sql_by_name[stmt_name]
+        prep = parse_sql_by_name[stmt_name]
+        sql, oids = prep["sql"], prep["oids"]
         (nfmt,) = struct.unpack(">H", rest[:2])
         fmts = struct.unpack(f">{nfmt}H", rest[2:2 + 2 * nfmt])
         pos = 2 + 2 * nfmt
         (nvals,) = struct.unpack(">H", rest[pos:pos + 2])
         pos += 2
-        values = []
+        text_vals: List[Optional[str]] = []
+        typed_vals: List[Any] = []
         for i in range(nvals):
             (ln,) = struct.unpack(">i", rest[pos:pos + 4])
             pos += 4
+            fmt = fmts[i] if i < len(fmts) else (fmts[0] if fmts else 0)
+            oid = oids[i] if i < len(oids) else 0
             if ln < 0:
-                values.append(None)
+                text_vals.append(None)
+                typed_vals.append(None)
                 continue
             raw = rest[pos:pos + ln]
             pos += ln
-            fmt = fmts[i] if i < len(fmts) else (fmts[0] if fmts else 0)
             if fmt == 1:
-                raise ValueError("binary-format parameters are not "
-                                 "supported (send text format)")
-            values.append(raw.decode("utf-8"))
-        need = _count_params(sql)
+                v = _decode_binary_param(raw, oid)
+                typed_vals.append(v)
+                text_vals.append(_text(v).decode()
+                                 if v is not None else None)
+            else:
+                s = raw.decode("utf-8")
+                text_vals.append(s)
+                typed_vals.append(_typed_text_param(s, oid))
+        need = prep["n_params"]
         if nvals < need:
             raise ValueError(f"bind supplies {nvals} parameters, "
                              f"statement needs {need}")
-        return _substitute_params(sql, values, oids)
+        from ..sql import ast as A
+        stmts = None
+        if prep["stmts"] is not None:
+            lits = [A.Lit(v) for v in typed_vals]
+            stmts = [A.bind_params(st, lits) for st in prep["stmts"]]
+        portal = {
+            "stmts": stmts,
+            # DDL still runs through the text path (the DDL log records
+            # statement text); bound text is kept for it
+            "sql": _substitute_params(sql, text_vals, oids),
+            "rows": None, "desc": None, "pos": 0, "done": False,
+        }
+        return portal_name, portal
+
+    def _execute_portal(self, portal: dict, max_rows: int) -> None:
+        """Run (or resume) a portal; honors the Execute row limit with
+        PortalSuspended so clients can fetch incrementally
+        (`pg_protocol.rs` portal execution)."""
+        from ..sql import ast as A
+        if portal["rows"] is None:
+            stmts = portal["stmts"]
+            if stmts is None or len(stmts) != 1 \
+                    or not isinstance(stmts[0],
+                                      (A.Select, A.SetOp, A.Insert,
+                                       A.Delete, A.Update)):
+                # DDL / multi-statement / unparsed: text path, no limits
+                if not self._run_one(portal["sql"], suppress_desc=True):
+                    self._send(b"I")
+                portal["done"] = True
+                return
+            stmt = stmts[0]
+            with self.lock:
+                if isinstance(stmt, (A.Select, A.SetOp)):
+                    portal["rows"] = self.db._run_batch_select(stmt)
+                    portal["desc"] = getattr(self.db, "last_description",
+                                             [])
+                else:
+                    result = self.db._execute(stmt)
+                    self._send(b"C", self._tag(result, 0).encode() + b"\0")
+                    portal["done"] = True
+                    return
+        rows, pos = portal["rows"], portal["pos"]
+        kinds = [d.kind for _, d in portal["desc"]]
+        end = len(rows) if max_rows <= 0 else min(len(rows),
+                                                  pos + max_rows)
+        self._data_rows(rows[pos:end], kinds)
+        portal["pos"] = end
+        if end < len(rows):
+            self._send(b"s")                       # PortalSuspended
+        else:
+            self._send(b"C", f"SELECT {len(rows)}".encode() + b"\0")
+            portal["done"] = True
 
     # ---- protocol loop --------------------------------------------------
     def serve(self) -> None:
@@ -410,11 +517,26 @@ class _Conn:
                 sql, rest = rest.split(b"\0", 1)
                 (nparams,) = struct.unpack(">H", rest[:2])
                 oids = struct.unpack(f">{nparams}I", rest[2:2 + 4 * nparams])
-                parse_sql_by_name[name] = (sql.decode("utf-8"), oids)
+                sql = sql.decode("utf-8")
+                # parse ONCE here; Bind/Execute reuse the trees
+                from ..sql import ast as A
+                from ..sql.parser import parse_sql
+                stmts = None
+                n_params = _count_params(sql)
+                try:
+                    stmts = parse_sql(sql)
+                    n_params = max([n_params]
+                                   + [A.max_param(s) for s in stmts])
+                except Exception:  # noqa: BLE001 — surfaces at Execute
+                    pass           # text fallback keeps pre-parse behavior
+                parse_sql_by_name[name] = {
+                    "sql": sql, "oids": oids, "stmts": stmts,
+                    "n_params": n_params}
                 self._send(b"1")
             elif tag == b"B":                            # Bind
                 try:
-                    self._portal_sql = self._bind(body, parse_sql_by_name)
+                    pname, portal = self._bind(body, parse_sql_by_name)
+                    self._portals[pname] = portal
                     self._send(b"2")
                 except Exception as e:  # noqa: BLE001
                     self._error(f"{type(e).__name__}: {e}", "08P01")
@@ -426,22 +548,30 @@ class _Conn:
                         if name not in parse_sql_by_name:
                             raise KeyError("prepared statement does not "
                                            "exist")
-                        sql, oids = parse_sql_by_name[name]
-                        self._describe_sql(sql, statement=True,
-                                           param_oids=oids)
+                        prep = parse_sql_by_name[name]
+                        self._describe_sql(prep["sql"], statement=True,
+                                           param_oids=prep["oids"])
                     else:
-                        self._describe_sql(self._portal_sql, statement=False)
+                        portal = self._portals.get(name)
+                        self._describe_sql(
+                            portal["sql"] if portal else None,
+                            statement=False)
                 except Exception as e:  # noqa: BLE001 — e.g. unknown table
                     self._error(f"{type(e).__name__}: {e}", "42P01")
                     skip_until_sync = True
             elif tag == b"E":                            # Execute
+                name, rest = body.split(b"\0", 1)
+                (max_rows,) = struct.unpack(">I", rest[:4])
+                portal = self._portals.get(name)
                 try:
-                    if self._portal_sql is None:
+                    if portal is None:
                         self._error("portal does not exist", "34000")
                         skip_until_sync = True
-                    elif not self._run_one(self._portal_sql,
-                                           suppress_desc=True):
-                        self._send(b"I")
+                    elif portal["done"]:
+                        # PG: a completed portal yields no further rows
+                        self._send(b"C", b"SELECT 0\0")
+                    else:
+                        self._execute_portal(portal, max_rows)
                 except Exception as e:  # noqa: BLE001
                     self._error(f"{type(e).__name__}: {e}")
                     skip_until_sync = True
@@ -450,7 +580,7 @@ class _Conn:
                 if kind == b"S":
                     parse_sql_by_name.pop(name, None)
                 else:
-                    self._portal_sql = None
+                    self._portals.pop(name, None)
                 self._send(b"3")
             elif tag == b"H":                            # Flush
                 pass

@@ -56,6 +56,61 @@ class FuncCall(ExprNode):
 
 
 @dataclass
+class Param(ExprNode):
+    """$n placeholder in a prepared statement (1-based). Replaced with a
+    Lit at Bind time (`pg_extended.rs` bound-statement analog); binding
+    one directly is an error."""
+    index: int
+
+
+def max_param(node: Any) -> int:
+    """Highest $n index anywhere in a statement tree (0 = none)."""
+    import dataclasses
+    best = 0
+    stack = [node]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, Param):
+            best = max(best, x.index)
+        elif dataclasses.is_dataclass(x) and not isinstance(x, type):
+            stack.extend(getattr(x, f.name)
+                         for f in dataclasses.fields(x))
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+    return best
+
+
+def bind_params(node: Any, lits: "List[Lit]") -> Any:
+    """Deep-substitute every Param with its bound literal — the
+    plan-once half of Parse/Bind: the statement tree parsed at Parse is
+    reused for every Bind/Execute, no re-lex/re-parse."""
+    import dataclasses
+
+    def sub(x):
+        if isinstance(x, Param):
+            if x.index - 1 >= len(lits):
+                raise ValueError(f"no value for placeholder ${x.index}")
+            return lits[x.index - 1]
+        if dataclasses.is_dataclass(x) and not isinstance(x, type):
+            kw = {}
+            for f in dataclasses.fields(x):
+                v = getattr(x, f.name)
+                nv = sub(v)
+                if nv is not v:
+                    kw[f.name] = nv
+            return dataclasses.replace(x, **kw) if kw else x
+        if isinstance(x, list):
+            out = [sub(v) for v in x]
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        if isinstance(x, tuple):
+            out = tuple(sub(v) for v in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        return x
+
+    return sub(node)
+
+
+@dataclass
 class ArrayLit(ExprNode):
     """ARRAY[e1, e2, ...] — consumed by UNNEST (no array columns yet)."""
     items: List[ExprNode]
@@ -65,6 +120,10 @@ class ArrayLit(ExprNode):
 class WindowSpec:
     partition_by: List[ExprNode]
     order_by: List[Tuple[ExprNode, bool]]   # (expr, desc)
+    # (mode, start, end): mode 'rows'|'range'; bounds are
+    # ('unbounded',) | ('current',) | ('preceding', expr) |
+    # ('following', expr); None = no explicit frame (default)
+    frame: Optional[Tuple] = None
 
 
 @dataclass

@@ -19,6 +19,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<dollar>\$\$.*?\$\$)
+  | (?P<param>\$\d+)
   | (?P<qid>"(?:[^"]|"")*")
   | (?P<id>[A-Za-z_][A-Za-z0-9_$]*)
   | (?P<op><>|!=|<=|>=|\|\||::|[-+*/%(),.;=<>\[\]])
@@ -75,6 +76,8 @@ def tokenize(sql: str) -> List[Token]:
             out.append(Token("str", text[1:-1].replace("''", "'"), start))
         elif kind == "dollar":
             out.append(Token("str", text[2:-2], start))
+        elif kind == "param":
+            out.append(Token("param", text[1:], start))
         else:
             out.append(Token(kind, text, start))
     out.append(Token("eof", "", len(sql)))
@@ -824,6 +827,9 @@ class Parser:
         if t.kind == "str":
             self.next()
             return A.Lit(t.value)
+        if t.kind == "param":
+            self.next()
+            return A.Param(int(t.value))
         if t.kind == "op" and t.value == "(":
             self.next()
             if self.peek().kind == "kw" and self.peek().value == "select":
@@ -945,12 +951,37 @@ class Parser:
             order.append(self._order_item())
             while self.accept("op", ","):
                 order.append(self._order_item())
-        # frame clauses parsed & ignored (default frame used)
+        frame = None
         if self.accept_kw("rows") or self.accept_kw("range"):
-            while not (self.peek().kind == "op" and self.peek().value == ")"):
-                self.next()
+            mode = self.toks[self.i - 1].value
+            if self.accept_kw("between"):
+                start = self._frame_bound()
+                self.expect_kw("and")
+                end = self._frame_bound()
+            else:
+                start = self._frame_bound()
+                end = ("current",)
+            frame = (mode, start, end)
         self.expect("op", ")")
-        return A.WindowSpec(partition, order)
+        return A.WindowSpec(partition, order, frame)
+
+    def _frame_bound(self) -> Tuple:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ("unbounded", "preceding")
+            if self.accept_kw("following"):
+                return ("unbounded", "following")
+            raise ValueError("expected PRECEDING or FOLLOWING after "
+                             "UNBOUNDED")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ("current",)
+        e = self.parse_expr()
+        if self.accept_kw("preceding"):
+            return ("preceding", e)
+        if self.accept_kw("following"):
+            return ("following", e)
+        raise ValueError("expected PRECEDING or FOLLOWING in frame bound")
 
     def _case(self) -> A.CaseExpr:
         self.expect_kw("case")

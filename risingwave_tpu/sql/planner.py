@@ -14,7 +14,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core import dtypes as T
-from ..core.dtypes import DataType, TypeKind, parse_interval
+from ..core.dtypes import DataType, Interval, TypeKind, parse_interval
 from ..core.schema import Field, Schema
 from ..expr import (AGG_KINDS, AggCall, Case, Coalesce, Expr, InputRef,
                     Literal, build_func, cast)
@@ -130,6 +130,9 @@ class Binder:
         self.ns = ns
 
     def bind(self, node: A.ExprNode) -> Expr:
+        if isinstance(node, A.Param):
+            raise ValueError(f"there is no parameter ${node.index} "
+                             "(unbound prepared-statement placeholder)")
         if isinstance(node, A.Lit):
             return _lit(node.value, node.type_hint)
         if isinstance(node, A.Col):
@@ -1207,6 +1210,48 @@ class Planner:
                 out = FilterExecutor(out, Binder(post_ns).bind(node))
         return out, post_ns, new_items
 
+    def _frame_offset(self, bound: Tuple, b: "Binder", is_start: bool,
+                      order_kind=None) -> Optional[int]:
+        """Frame bound -> signed offset (None = unbounded, 0 = current).
+        PRECEDING is negative, FOLLOWING positive. Interval offsets scale
+        to the ORDER BY column's unit: microseconds for TIMESTAMP, days
+        for DATE (whose runtime values are day counts)."""
+        if bound[0] == "unbounded":
+            # PG: frame start cannot be UNBOUNDED FOLLOWING, frame end
+            # cannot be UNBOUNDED PRECEDING
+            if is_start and bound[1] == "following":
+                raise ValueError("frame start cannot be UNBOUNDED "
+                                 "FOLLOWING")
+            if not is_start and bound[1] == "preceding":
+                raise ValueError("frame end cannot be UNBOUNDED PRECEDING")
+            return None
+        if bound[0] == "current":
+            return 0
+        e = b.bind(bound[1])
+        if not isinstance(e, Literal) or e.value is None:
+            raise ValueError("frame offsets must be constants")
+        v = e.value
+        if isinstance(v, Interval):
+            if v.months:
+                raise ValueError("month intervals are not valid frame "
+                                 "offsets")
+            if order_kind == TypeKind.DATE:
+                if v.usecs:
+                    raise ValueError("sub-day interval frame offsets are "
+                                     "not valid over a DATE order column")
+                v = v.days
+            else:
+                v = v.days * 86_400_000_000 + v.usecs
+        if order_kind is None or isinstance(v, int):
+            # ROWS offsets are row counts — integers only (PG errors on
+            # fractional ROWS offsets rather than truncating)
+            if float(v) != int(v):
+                raise ValueError("ROWS frame offsets must be integers")
+            v = int(v)
+        else:
+            v = float(v) if not isinstance(v, (int, float)) else v
+        return -v if bound[0] == "preceding" else v
+
     def _plan_over_window(self, execu: Executor, ns: Namespace,
                           items: List[A.SelectItem]):
         specs = [i for i in items
@@ -1218,6 +1263,30 @@ class Planner:
         b = Binder(ns)
         partition = [_as_input_ref(b.bind(p)) for p in first.partition_by]
         order = [(_as_input_ref(b.bind(e)), d) for e, d in first.order_by]
+        frame, mode = (None, 0), "rows"
+        if first.frame is not None:
+            mode = first.frame[0]
+            ok = None
+            if mode == "range" and order:
+                ok = ns.cols[order[0][0]].dtype.kind
+                has_offset = any(bd[0] in ("preceding", "following")
+                                 for bd in (first.frame[1], first.frame[2]))
+                if has_offset and ok not in (
+                        TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
+                        TypeKind.FLOAT32, TypeKind.FLOAT64,
+                        TypeKind.DECIMAL, TypeKind.TIMESTAMP,
+                        TypeKind.TIMESTAMPTZ, TypeKind.DATE,
+                        TypeKind.TIME):
+                    # PG rejects offset RANGE frames over non-orderable-
+                    # by-offset columns at plan time
+                    raise ValueError(
+                        "RANGE with offset requires a numeric or "
+                        "datetime ORDER BY column")
+            frame = (self._frame_offset(first.frame[1], b, True, ok),
+                     self._frame_offset(first.frame[2], b, False, ok))
+            if frame[0] is not None and frame[1] is not None \
+                    and frame[0] > frame[1]:
+                raise ValueError("frame start cannot be past frame end")
         calls = []
         for s in specs:
             f: A.FuncCall = s.expr
@@ -1225,7 +1294,13 @@ class Planner:
                 raise ValueError("FILTER on window functions is not "
                                  "supported")
             arg = b.bind(f.args[0]) if f.args else None
-            calls.append(WindowFuncCall(f.name, arg))
+            if f.name in ("sum", "count", "min", "max", "avg",
+                          "first_value", "last_value"):
+                calls.append(WindowFuncCall(f.name, arg, frame=frame,
+                                            frame_mode=mode))
+            else:
+                # rank family / lag / lead ignore the frame clause (PG)
+                calls.append(WindowFuncCall(f.name, arg))
         st = self.make_state([c.dtype for c in ns.cols],
                              list(range(len(ns.cols))))
         execu = OverWindowExecutor(execu, partition, order, calls,

@@ -1,0 +1,93 @@
+"""Arrow interop seam (arrow_impl.rs ToArrow/FromArrow analog) +
+zero-copy guarantees.
+"""
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from risingwave_tpu.core import dtypes as T
+from risingwave_tpu.core.arrow import (column_from_arrow, column_to_arrow,
+                                       datachunk_from_arrow,
+                                       datachunk_to_arrow,
+                                       streamchunk_from_arrow,
+                                       streamchunk_to_arrow, to_jax)
+from risingwave_tpu.core.chunk import Column, DataChunk, Op, StreamChunk
+
+
+def roundtrip(dtype, items):
+    col = Column.from_list(dtype, items)
+    arr = column_to_arrow(col)
+    back = column_from_arrow(arr, dtype)
+    assert [back.get(i) for i in range(len(back))] == \
+        [col.get(i) for i in range(len(col))]
+    return arr
+
+
+class TestColumnRoundtrip:
+    def test_fixed_width(self):
+        roundtrip(T.INT64, [1, None, -5, 2**62])
+        roundtrip(T.INT32, [1, 2, None])
+        roundtrip(T.FLOAT64, [1.5, None, -0.25])
+        roundtrip(T.BOOLEAN, [True, False, None])
+
+    def test_temporal(self):
+        arr = roundtrip(T.TIMESTAMP, [1704067200000000, None])
+        assert pa.types.is_timestamp(arr.type)
+        arr = roundtrip(T.DATE, [19723, None])
+        assert pa.types.is_date32(arr.type)
+
+    def test_strings_and_bytes(self):
+        roundtrip(T.VARCHAR, ["a", None, "日本", ""])
+        roundtrip(T.BYTEA, [b"\x00\x01", None])
+
+    def test_decimal(self):
+        arr = roundtrip(T.DECIMAL, [Decimal("1.5"), None, Decimal("-7")])
+        assert pa.types.is_decimal(arr.type)
+
+    def test_interval(self):
+        from risingwave_tpu.core.dtypes import Interval
+        roundtrip(T.INTERVAL, [Interval(1, 2, 3_000_000), None])
+
+
+class TestZeroCopy:
+    def test_int64_value_buffer_is_shared(self):
+        vals = np.arange(1024, dtype=np.int64)
+        col = Column(T.INT64, vals, np.ones(1024, bool))
+        arr = column_to_arrow(col)
+        assert arr.buffers()[1].address == vals.ctypes.data
+        back = column_from_arrow(arr, T.INT64)
+        assert back.values.ctypes.data == vals.ctypes.data
+
+    def test_to_jax_device_seam(self):
+        import jax.numpy as jnp
+        col = Column(T.INT64, np.arange(16, dtype=np.int64),
+                     np.ones(16, bool))
+        x = to_jax(col)
+        assert isinstance(x, jnp.ndarray) and int(x.sum()) == 120
+        nullable = Column.from_list(T.INT64, [1, None])
+        with pytest.raises(ValueError, match="NULL"):
+            to_jax(nullable)
+
+
+class TestChunks:
+    def test_datachunk_roundtrip(self):
+        dts = [T.INT64, T.VARCHAR]
+        ch = DataChunk.from_rows(dts, [(1, "a"), (2, None), (None, "c")])
+        batch = datachunk_to_arrow(ch, names=["k", "s"])
+        assert batch.schema.names == ["k", "s"]
+        back = datachunk_from_arrow(batch, dts)
+        assert [tuple(back.columns[j].get(i) for j in range(2))
+                for i in range(3)] == [(1, "a"), (2, None), (None, "c")]
+
+    def test_streamchunk_roundtrip_preserves_ops(self):
+        dts = [T.INT64, T.INT64]
+        ch = StreamChunk.from_rows(dts, [
+            (Op.INSERT, (1, 10)), (Op.DELETE, (2, 20)),
+            (Op.UPDATE_DELETE, (3, 30)), (Op.UPDATE_INSERT, (3, 31))])
+        batch = streamchunk_to_arrow(ch)
+        back = streamchunk_from_arrow(batch, dts)
+        assert list(back.ops) == list(ch.ops)
+        assert back.columns[1].get(3) == 31

@@ -132,7 +132,7 @@ def test_join_pair_capacity_growth_replay():
              "    FROM TUMBLE(bid, date_time, INTERVAL '10' SECOND)"
              "    GROUP BY bid.auction, window_start) CB GROUP BY CB.ws"
              ") MB ON AB.ws = MB.wsc AND AB.num >= MB.maxn")
-    dev = Database(device=DeviceConfig(capacity=64))
+    dev = Database(device=DeviceConfig(capacity=16))
     dev.run(BID_SRC.format(n=N, c=CHUNK))
     dev.run(q7ish)
     job = dev._fused.get("j")

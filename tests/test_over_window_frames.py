@@ -1,0 +1,172 @@
+"""OverWindow frames + incremental range cache.
+
+Reference: `src/expr/core/src/window_function/` (RowsFrame/RangeFrame),
+`src/stream/src/executor/over_window/over_partition.rs` (range cache:
+only affected ranges recompute), `frame_finder.rs` (affected-range
+computation per frame shape).
+"""
+from risingwave_tpu.sql import Database
+from risingwave_tpu.utils.metrics import REGISTRY
+
+
+def ticks(db, n=3):
+    for _ in range(n):
+        db.tick()
+
+
+def mk():
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT, ts BIGINT, v BIGINT)")
+    return db
+
+
+class TestRowsFrames:
+    def test_moving_sum(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT k, ts, v,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts"
+               " ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS s FROM t")
+        db.run("INSERT INTO t VALUES (1, 1, 10), (1, 2, 20), (1, 3, 30),"
+               " (1, 4, 40)")
+        ticks(db)
+        rows = sorted(db.query("SELECT ts, s FROM m"))
+        assert rows == [(1, 10), (2, 30), (3, 60), (4, 90)]
+
+    def test_centered_count(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " count(*) OVER (PARTITION BY k ORDER BY ts"
+               " ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING) AS c FROM t")
+        db.run("INSERT INTO t VALUES (1, 1, 0), (1, 2, 0), (1, 3, 0)")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1, 2), (2, 3), (3, 2)]
+
+    def test_retraction_updates_frames(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts"
+               " ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM t")
+        db.run("INSERT INTO t VALUES (1, 1, 10), (1, 2, 20), (1, 3, 30)")
+        ticks(db)
+        db.run("DELETE FROM t WHERE ts = 2")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1, 10), (3, 40)]
+
+
+class TestRangeFrames:
+    def test_range_sum(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts"
+               " RANGE BETWEEN 10 PRECEDING AND CURRENT ROW) AS s FROM t")
+        # ts gaps: the value window differs from a 2-row window
+        db.run("INSERT INTO t VALUES (1, 0, 1), (1, 5, 2), (1, 11, 4),"
+               " (1, 40, 8)")
+        ticks(db)
+        rows = sorted(db.query("SELECT * FROM m"))
+        # frames: [−10,0]->1; [−5,5]->3; [1,11]->6; [30,40]->8
+        assert rows == [(0, 1), (5, 3), (11, 6), (40, 8)]
+
+    def test_range_mid_insert(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts"
+               " RANGE BETWEEN 10 PRECEDING AND CURRENT ROW) AS s FROM t")
+        db.run("INSERT INTO t VALUES (1, 0, 1), (1, 20, 4)")
+        ticks(db)
+        db.run("INSERT INTO t VALUES (1, 12, 2)")   # lands inside 20's frame
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(0, 1), (12, 2), (20, 6)]
+
+    def test_range_delete_updates_followers(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts"
+               " RANGE BETWEEN 10 PRECEDING AND CURRENT ROW) AS s FROM t")
+        db.run("INSERT INTO t VALUES (1, 0, 1), (1, 5, 2), (1, 8, 4)")
+        ticks(db)
+        db.run("DELETE FROM t WHERE ts = 5")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM m")) == [(0, 1), (8, 5)]
+
+
+class TestFrameEdgeCases:
+    def test_fractional_range_offset(self):
+        db = Database()
+        db.run("CREATE TABLE t (k BIGINT, x DOUBLE PRECISION,"
+               " v DOUBLE PRECISION)")
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT x, sum(v) OVER"
+               " (PARTITION BY k ORDER BY x RANGE BETWEEN 0.5 PRECEDING"
+               " AND CURRENT ROW) AS s FROM t")
+        db.run("INSERT INTO t VALUES (1, 1.0, 1), (1, 1.4, 2)")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1.0, 1.0), (1.4, 3.0)]
+
+    def test_fractional_rows_offset_rejected(self):
+        import pytest
+        db = mk()
+        with pytest.raises(ValueError, match="integers"):
+            db.run("CREATE MATERIALIZED VIEW m AS SELECT sum(v) OVER"
+                   " (ORDER BY ts ROWS BETWEEN 1.5 PRECEDING AND"
+                   " CURRENT ROW) AS s FROM t")
+
+    def test_range_offset_requires_orderable_column(self):
+        import pytest
+        db = Database()
+        db.run("CREATE TABLE t (name VARCHAR, v BIGINT)")
+        with pytest.raises(ValueError, match="numeric or datetime"):
+            db.run("CREATE MATERIALIZED VIEW m AS SELECT sum(v) OVER"
+                   " (ORDER BY name RANGE BETWEEN 1 PRECEDING AND"
+                   " CURRENT ROW) AS s FROM t")
+
+    def test_first_last_value_do_not_skip_nulls(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " last_value(v) OVER (PARTITION BY k ORDER BY ts"
+               " ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS lv,"
+               " first_value(v) OVER (PARTITION BY k ORDER BY ts"
+               " ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS fv"
+               " FROM t")
+        db.run("INSERT INTO t VALUES (1, 1, 10), (1, 2, NULL), (1, 3, 30)")
+        ticks(db)
+        rows = sorted(db.query("SELECT * FROM m"))
+        # lv at ts=2 is the NULL itself; fv at ts=3 is the NULL
+        assert rows == [(1, 10, 10), (2, None, 10), (3, 30, None)]
+
+
+class TestIncrementalRecompute:
+    def test_tail_append_touches_o_delta_rows(self):
+        """Appending at the order tail of a big partition must NOT
+        recompute the partition (over_partition.rs range cache)."""
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts) AS s,"
+               " row_number() OVER (PARTITION BY k ORDER BY ts) AS rn"
+               " FROM t")
+        n = 5000
+        db.run("INSERT INTO t VALUES "
+               + ", ".join(f"(1, {i}, 1)" for i in range(n)))
+        ticks(db)
+        ctr = REGISTRY.counter("over_window_recomputed_rows", "")
+        before = ctr.labels().value
+        db.run("INSERT INTO t VALUES (1, 999999, 1)")   # tail append
+        ticks(db)
+        delta = ctr.labels().value - before
+        assert delta <= 4, f"tail append recomputed {delta} rows"
+        rows = dict(db.query("SELECT ts, s FROM m"))
+        assert rows[999999] == n + 1
+
+    def test_mid_insert_stays_correct(self):
+        db = mk()
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+               " sum(v) OVER (PARTITION BY k ORDER BY ts) AS s FROM t")
+        db.run("INSERT INTO t VALUES (1, 1, 1), (1, 3, 1), (1, 5, 1)")
+        ticks(db)
+        db.run("INSERT INTO t VALUES (1, 2, 10)")
+        ticks(db)
+        assert sorted(db.query("SELECT * FROM m")) == \
+            [(1, 1), (2, 11), (3, 12), (5, 13)]

@@ -328,3 +328,98 @@ def test_extended_query_with_parameters(server):
     msgs = c.read_until(b"Z")
     assert any(t == b"E" for t, _ in msgs)
     assert c.rows(c.query("SELECT count(*) FROM pt")) == [("3",)]
+
+
+def _bind_payload(portal=b"", stmt=b"", fmts=(), values=(), rfmts=()):
+    out = portal + b"\0" + stmt + b"\0"
+    out += struct.pack(">H", len(fmts))
+    for f in fmts:
+        out += struct.pack(">H", f)
+    out += struct.pack(">H", len(values))
+    for v in values:
+        if v is None:
+            out += struct.pack(">i", -1)
+        else:
+            out += struct.pack(">I", len(v)) + v
+    out += struct.pack(">H", len(rfmts))
+    for f in rfmts:
+        out += struct.pack(">H", f)
+    return out
+
+
+def test_prepared_statement_plan_once_execute_many(server):
+    """Parse once, Bind/Execute many times with different parameters —
+    no re-parse per Execute (pg_extended.rs plan-once contract)."""
+    import risingwave_tpu.sql.parser as P
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE pt (k BIGINT, v BIGINT)")
+    c.query("INSERT INTO pt VALUES (1, 10), (2, 20), (3, 30)")
+    c.send(b"P", b"ps\0SELECT v FROM pt WHERE k = $1\0"
+           + struct.pack(">HI", 1, 20))
+    c.send(b"S")
+    c.read_until(b"Z")
+    calls = {"n": 0}
+    orig = P.parse_sql
+
+    def counting(sql):
+        calls["n"] += 1
+        return orig(sql)
+    P.parse_sql = counting
+    try:
+        for k, want in ((b"1", "10"), (b"2", "20"), (b"3", "30")):
+            c.send(b"B", _bind_payload(stmt=b"ps", values=(k,)))
+            c.send(b"E", b"\0" + struct.pack(">I", 0))
+            c.send(b"S")
+            msgs = c.read_until(b"Z")
+            assert c.rows(msgs) == [(want,)], (k, c.rows(msgs))
+    finally:
+        P.parse_sql = orig
+    assert calls["n"] == 0, f"{calls['n']} re-parses during Execute"
+
+
+def test_binary_parameters(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE bt (k BIGINT, f DOUBLE PRECISION)")
+    c.query("INSERT INTO bt VALUES (7, 1.5), (8, 2.5)")
+    # int8 binary + float8 binary
+    c.send(b"P", b"bs\0SELECT f FROM bt WHERE k = $1 AND f < $2\0"
+           + struct.pack(">HII", 2, 20, 701))
+    c.send(b"B", _bind_payload(stmt=b"bs", fmts=(1, 1),
+                               values=(struct.pack(">q", 7),
+                                       struct.pack(">d", 99.0))))
+    c.send(b"E", b"\0" + struct.pack(">I", 0))
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    assert c.rows(msgs) == [("1.5",)], c.rows(msgs)
+
+
+def test_portal_row_limit_and_suspend(server):
+    """Execute with max_rows fetches incrementally: PortalSuspended
+    between fetches, CommandComplete at exhaustion."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE rt (k BIGINT)")
+    c.query("INSERT INTO rt VALUES (1), (2), (3), (4), (5)")
+    c.send(b"P", b"rs\0SELECT k FROM rt ORDER BY k\0" + struct.pack(">H", 0))
+    c.send(b"B", _bind_payload(stmt=b"rs"))
+    c.send(b"E", b"\0" + struct.pack(">I", 2))   # fetch 2
+    c.send(b"H")
+    got, tags = [], []
+    while True:
+        t, b = c.read_msg()
+        tags.append(t)
+        if t == b"D":
+            got.append(c.rows([(t, b)])[0][0])
+        if t in (b"s", b"C"):
+            break
+    assert got == ["1", "2"] and tags[-1] == b"s", (got, tags)
+    c.send(b"E", b"\0" + struct.pack(">I", 2))   # next 2
+    c.send(b"E", b"\0" + struct.pack(">I", 0))   # rest
+    c.send(b"S")
+    msgs = c.read_until(b"Z")
+    vals = [r[0] for r in c.rows(msgs)]
+    assert vals == ["3", "4", "5"], vals
+    assert any(t == b"s" for t, _ in msgs)       # second fetch suspended
+    assert any(t == b"C" for t, _ in msgs)       # final completed
