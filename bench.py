@@ -472,6 +472,14 @@ def _stage_child(name, args, out_path):
     """Subprocess entry: run one stage, dump its dict to out_path.
     Write-then-rename so the parent can never read a half-written file."""
     try:
+        # Kernel policy per workload (device/sorted_state.cheap_compile):
+        # the fused ceiling and the join-dense q5/q7/q8 programs measure
+        # FASTER with the compile-cheap kernel forms on the tunnel
+        # (fused: 1.64B vs 984M ev/s, compile 30s vs 229s); q4's
+        # 1M-capacity agg measures faster with the variadic-sort forms
+        # (1.17M vs 350k ev/s warm). Must be set before jax imports.
+        if name in ("fused", "qx_device"):
+            os.environ["RW_TPU_CHEAP_COMPILE"] = "1"
         result = _STAGES[name](*args)
         payload = {"ok": True, "result": result}
     except BaseException as e:  # report, don't propagate — parent decides

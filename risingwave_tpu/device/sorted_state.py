@@ -166,7 +166,12 @@ def cheap_compile() -> bool:
     measurements) and compile acceptably, so they stay."""
     global _CHEAP_COMPILE
     if _CHEAP_COMPILE is None:
-        _CHEAP_COMPILE = jax.default_backend() == "cpu"
+        import os
+        env = os.environ.get("RW_TPU_CHEAP_COMPILE")
+        if env is not None:
+            _CHEAP_COMPILE = env not in ("", "0", "false")
+        else:
+            _CHEAP_COMPILE = jax.default_backend() == "cpu"
     return _CHEAP_COMPILE
 
 

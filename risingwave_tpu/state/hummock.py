@@ -84,6 +84,80 @@ class BlockCache:
         return len(self._blocks)
 
 
+class Xor8:
+    """Xor filter with 8-bit fingerprints (`src/storage/src/hummock/
+    sstable/xor_filter.rs`; Graf & Lemire construction): ~0.39% false
+    positives at 9.84 bits/key. A run-level filter lets point reads skip
+    runs that cannot contain the key — without it every negative lookup
+    pays a block read per run."""
+
+    __slots__ = ("seed", "seg", "fp")
+
+    def __init__(self, seed: int, seg: int, fp: bytes):
+        self.seed = seed
+        self.seg = seg
+        self.fp = fp
+
+    @staticmethod
+    def _h(key: bytes, seed: int) -> int:
+        import hashlib
+        return int.from_bytes(
+            hashlib.blake2b(key, digest_size=8,
+                            salt=seed.to_bytes(8, "little")).digest(),
+            "little")
+
+    @staticmethod
+    def _positions(h: int, seg: int):
+        fp = (h ^ (h >> 32)) & 0xFF
+        p0 = (h & 0xFFFFF) % seg
+        p1 = seg + ((h >> 20) & 0xFFFFF) % seg
+        p2 = 2 * seg + ((h >> 40) & 0xFFFFF) % seg
+        return fp, p0, p1, p2
+
+    @classmethod
+    def build(cls, keys: List[bytes]) -> Optional["Xor8"]:
+        n = len(keys)
+        if n == 0:
+            return cls(0, 1, bytes(3))
+        seg = (int(1.23 * n) + 32 + 2) // 3
+        for seed in range(8):            # retries are vanishingly rare
+            hs = [cls._h(k, seed) for k in keys]
+            m = 3 * seg
+            count = [0] * m
+            hxor = [0] * m
+            for h in hs:
+                _, p0, p1, p2 = cls._positions(h, seg)
+                for p in (p0, p1, p2):
+                    count[p] += 1
+                    hxor[p] ^= h
+            stack = []
+            queue = [p for p in range(m) if count[p] == 1]
+            while queue:
+                p = queue.pop()
+                if count[p] != 1:
+                    continue
+                h = hxor[p]
+                stack.append((p, h))
+                _, p0, p1, p2 = cls._positions(h, seg)
+                for q in (p0, p1, p2):
+                    count[q] -= 1
+                    hxor[q] ^= h
+                    if count[q] == 1:
+                        queue.append(q)
+            if len(stack) == n:
+                fp = bytearray(m)
+                for p, h in reversed(stack):
+                    f, p0, p1, p2 = cls._positions(h, seg)
+                    fp[p] = f ^ fp[p0] ^ fp[p1] ^ fp[p2] ^ fp[p]
+                return cls(seed, seg, bytes(fp))
+        return None                      # give up: reader treats as absent
+
+    def may_contain(self, key: bytes) -> bool:
+        h = self._h(key, self.seed)
+        f, p0, p1, p2 = self._positions(h, self.seg)
+        return (self.fp[p0] ^ self.fp[p1] ^ self.fp[p2]) == f
+
+
 class _RunWriter:
     """Streaming block writer: add() in key order, finish() atomically."""
 
@@ -94,10 +168,12 @@ class _RunWriter:
         self._buf: List[Tuple[bytes, Optional[Tuple]]] = []
         self._off = 0
         self.count = 0
+        self._keys: List[bytes] = []     # for the run-level xor filter
 
     def add(self, key: bytes, row: Optional[Tuple]) -> None:
         self._buf.append((key, row))
-        self.count += 1
+        self._keys.append(key)           # tombstones included: a filter
+        self.count += 1                  # miss must mean "not in this run"
         if len(self._buf) >= BLOCK_ROWS:
             self._flush_block()
 
@@ -112,7 +188,10 @@ class _RunWriter:
 
     def finish(self) -> None:
         self._flush_block()
-        idx_blob = pickle.dumps((self._index, self.count), protocol=4)
+        xf = Xor8.build(self._keys)
+        filt = (xf.seed, xf.seg, xf.fp) if xf is not None else None
+        idx_blob = pickle.dumps((self._index, self.count, filt),
+                                protocol=4)
         self._f.write(idx_blob)
         self._f.write(struct.pack(">Q", self._off))
         self._f.flush()
@@ -143,7 +222,13 @@ class RunReader:
         end = self._f.tell()
         (idx_off,) = struct.unpack(">Q", self._f.read(8))
         self._f.seek(idx_off)
-        self.index, self.count = pickle.loads(self._f.read(end - idx_off))
+        footer = pickle.loads(self._f.read(end - idx_off))
+        if len(footer) == 3:             # filter-bearing format
+            self.index, self.count, filt = footer
+            self.filter = Xor8(*filt) if filt is not None else None
+        else:                            # pre-filter files stay readable
+            self.index, self.count = footer
+            self.filter = None
         self._first_keys = [e[0] for e in self.index]
 
     def close(self) -> None:
@@ -164,6 +249,12 @@ class RunReader:
 
     def get(self, key: bytes):
         """Value, None (tombstone), or _MISS."""
+        if self.filter is not None and not self.filter.may_contain(key):
+            from ..utils.metrics import REGISTRY
+            REGISTRY.counter("state_filter_negative_skips",
+                             "point reads skipped by run xor filters"
+                             ).inc()
+            return _MISS
         i = bisect.bisect_right(self._first_keys, key) - 1
         if i < 0:
             return _MISS
