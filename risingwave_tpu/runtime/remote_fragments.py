@@ -60,6 +60,21 @@ class _WorkerHandle:
         self.addr = addr
 
 
+def _spawn_worker(plan: Dict) -> _WorkerHandle:
+    """Spawn one worker process and complete the ADDR handshake."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "risingwave_tpu.runtime.worker",
+         json.dumps(plan)],
+        stdout=subprocess.PIPE, text=True)
+    line = proc.stdout.readline().split()
+    if not line or line[0] != "ADDR":
+        proc.kill()
+        raise RemoteWorkerDied(
+            f"worker pid={proc.pid} died during startup "
+            f"(hello: {line!r})")
+    return _WorkerHandle(proc, (line[1], int(line[2])))
+
+
 class RemoteFragmentSet:
     """k worker processes running one HashAgg fragment each, plus the
     coordinator-side exchange plumbing. Produces (merge_executor, pumps)
@@ -91,14 +106,7 @@ class RemoteFragmentSet:
                 },
             })
         for p in plans:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "risingwave_tpu.runtime.worker",
-                 json.dumps(p)],
-                stdout=subprocess.PIPE, text=True)
-            line = proc.stdout.readline().split()
-            assert line and line[0] == "ADDR", f"bad worker hello: {line}"
-            self.workers.append(_WorkerHandle(proc, (line[1],
-                                                     int(line[2]))))
+            self.workers.append(_spawn_worker(p))
         # result side: one drain thread per worker feeding a ThreadedChannel
         # the barrier-aligned Merge can poll
         self.dispatch = DispatchExecutor(input, net_channels, kind="hash",
@@ -116,6 +124,9 @@ class RemoteFragmentSet:
         self.out_schema = out_schema
         self.group_indices = list(group_indices)
         self.calls = list(calls)
+        self._start_drains()
+
+    def _start_drains(self) -> None:
         self.channels: List[ThreadedChannel] = []
         self._drains: List[threading.Thread] = []
         for w in self.workers:
@@ -181,3 +192,153 @@ class RemoteFragmentSet:
             out.append(AggCall(self._FINAL_KIND[c.kind],
                                InputRef(ng + i, dt)))
         return out
+
+
+class RemoteStatefulSet:
+    """Generalized worker placement: hash-dispatch each input by its key
+    columns so every worker OWNS a disjoint key space, run a FULL
+    stateful fragment (retractable agg, hash join) in each worker, and
+    barrier-align-merge the workers' change streams — no second phase.
+    This is the reference's actor model (`stream_manager.rs:254`
+    placement: every fragment type runs on compute nodes); the 2-phase
+    RemoteFragmentSet above remains the cheaper plan for append-only
+    composable aggregates.
+
+    Recovery contract: worker state is process-local and ephemeral; a
+    death surfaces as RemoteWorkerDied and the job rebuilds from the DDL
+    log + committed source offsets, exactly like the 2-phase path."""
+
+    def __init__(self, inputs, key_indices_list, fragment: Dict, k: int,
+                 suppress_first_epoch: bool = False):
+        self.server = ExchangeServer()
+        n_in = len(inputs)
+        assert n_in in (1, 2) and len(key_indices_list) == n_in
+        # channel ids: input 0 -> 0..k-1, input 1 -> k..2k-1
+        chans = [[self.server.register(i * k + j,
+                                       inputs[i].schema.dtypes)
+                  for j in range(k)] for i in range(n_in)]
+        self.dispatchers = [
+            DispatchExecutor(inputs[i], chans[i], kind="hash",
+                             key_indices=list(key_indices_list[i]))
+            for i in range(n_in)]
+        plans = []
+        for j in range(k):
+            p = {
+                "coord": [self.server.addr[0], self.server.addr[1]],
+                "in_channel": j,
+                "in_schema": [[f.name, f.dtype.kind.value]
+                              for f in inputs[0].schema.fields],
+                "append_only": inputs[0].append_only,
+                "fragment": fragment,
+            }
+            if suppress_first_epoch:
+                p["suppress_first_epoch"] = True
+            if n_in == 2:
+                p["in_channel_r"] = k + j
+                p["in_schema_r"] = [[f.name, f.dtype.kind.value]
+                                    for f in inputs[1].schema.fields]
+                p["append_only_r"] = inputs[1].append_only
+            plans.append(p)
+        self.workers: List[_WorkerHandle] = []
+        for p in plans:
+            self.workers.append(_spawn_worker(p))
+        # output schema via a local stub twin
+        from .worker import build_fragment
+
+        class _Stub(Executor):
+            def __init__(self, schema, ao):
+                super().__init__(schema)
+                self.append_only = ao
+
+        stubs = [_Stub(e.schema, e.append_only) for e in inputs]
+        self.out_schema = build_fragment(
+            plans[0], stubs[0], stubs[1] if n_in == 2 else None).schema
+        self._start_drains()
+
+    _drain = RemoteFragmentSet._drain
+    _start_drains = RemoteFragmentSet._start_drains
+    check_alive = RemoteFragmentSet.check_alive
+    shutdown = RemoteFragmentSet.shutdown
+    __del__ = RemoteFragmentSet.__del__
+
+    def merge_executor(self) -> MergeExecutor:
+        merge = MergeExecutor(self.channels, self.out_schema,
+                              pumps=self.dispatchers)
+        merge.health_check = self.check_alive
+        merge._remote = self
+        return merge
+
+
+class TeeStateExecutor(Executor):
+    """Pass-through that shadows a stream's live rows into a coordinator
+    state table (committed at checkpoint barriers). The shadow is what
+    re-seeds respawned stateful workers — the coordinator-side stand-in
+    for the reference's shared-storage (Hummock) join state."""
+
+    def __init__(self, input: Executor, state_table, pad: int = 0):
+        super().__init__(input.schema, "TeeState")
+        self.append_only = input.append_only
+        self.input = input
+        self.state_table = state_table
+        self.pad = (0,) * pad     # trailing filler columns (join degree)
+
+    def execute(self):
+        from ..core.chunk import StreamChunk
+        from ..ops.message import Barrier
+        for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                for op, row in msg.compact().op_rows():
+                    if op.is_insert:
+                        self.state_table.insert(tuple(row) + self.pad)
+                    else:
+                        self.state_table.delete(tuple(row) + self.pad)
+            elif isinstance(msg, Barrier) and msg.is_checkpoint:
+                self.state_table.commit(msg.epoch.curr)
+            yield msg
+
+
+class _SeedPrepend(Executor):
+    """Emit recovered shadow rows as one leading insert batch, then the
+    live stream. Workers ingest the seeds as state (their outputs are
+    suppressed until the first barrier — worker.py)."""
+
+    def __init__(self, input: Executor, rows):
+        super().__init__(input.schema, "SeedPrepend")
+        self.append_only = input.append_only
+        self.input = input
+        self.rows = list(rows)
+
+    def execute(self):
+        from ..core.chunk import Op, StreamChunk
+        for i in range(0, len(self.rows), 4096):
+            yield StreamChunk.from_rows(
+                self.schema.dtypes,
+                [(Op.INSERT, tuple(r)) for r in self.rows[i:i + 4096]])
+        self.rows = []      # consumed once; don't pin the copy for the
+        yield from self.input.execute()   # lifetime of the job
+
+
+def make_remote_join(lexec: Executor, rexec: Executor, lkeys, rkeys,
+                     join_type, k: int, left_state, right_state
+                     ) -> "RemoteStatefulSet":
+    """Hash join across k worker processes: both inputs hash-dispatch on
+    the join key, each worker owns its key space and runs the FULL
+    stateful HashJoinExecutor; the coordinator shadows both sides and
+    seeds fresh workers on recovery."""
+    # shadow tables reuse the join-state layout (row + degree column);
+    # the tee pads the degree, seeds strip it
+    lseed = [tuple(r)[:-1] for r in left_state.iter_all()] \
+        if left_state is not None else []
+    rseed = [tuple(r)[:-1] for r in right_state.iter_all()] \
+        if right_state is not None else []
+    seeding = bool(lseed or rseed)
+    lt = TeeStateExecutor(lexec, left_state, pad=1) \
+        if left_state is not None else lexec
+    rt = TeeStateExecutor(rexec, right_state, pad=1) \
+        if right_state is not None else rexec
+    lin = _SeedPrepend(lt, lseed) if seeding else lt
+    rin = _SeedPrepend(rt, rseed) if seeding else rt
+    fragment = {"kind": "hash_join", "left_keys": list(lkeys),
+                "right_keys": list(rkeys), "join_type": join_type.value}
+    return RemoteStatefulSet([lin, rin], [list(lkeys), list(rkeys)],
+                             fragment, k, suppress_first_epoch=seeding)

@@ -40,8 +40,16 @@ def _schema(cols: List[List[str]]) -> Schema:
     return Schema([Field(n, type_from_name(t)) for n, t in cols])
 
 
-def build_fragment(plan: Dict[str, Any], upstream) -> Any:
+def build_fragment(plan: Dict[str, Any], upstream, upstream2=None) -> Any:
     frag = plan["fragment"]
+    if frag["kind"] == "hash_join":
+        # full stateful join over this worker's hash-owned key space
+        # (`stream_manager.rs:610` — every fragment type places on
+        # compute nodes, joins included)
+        from ..ops import HashJoinExecutor, JoinType
+        return HashJoinExecutor(
+            upstream, upstream2, frag["left_keys"], frag["right_keys"],
+            JoinType(frag["join_type"]))
     in_schema = upstream.schema
     calls = []
     for kind, arg in frag["calls"]:
@@ -57,6 +65,9 @@ def build_fragment(plan: Dict[str, Any], upstream) -> Any:
                                            frag["group_indices"], calls)
     if frag["kind"] != "hash_agg":
         raise ValueError(f"unknown fragment kind {frag['kind']!r}")
+    # owned-group FULL agg: the hash dispatch gives this worker exclusive
+    # ownership of its groups, so its change stream IS final — exact
+    # under retraction (multiset min/max states live here)
     gd = [in_schema.fields[i].dtype for i in frag["group_indices"]]
     from ..core import dtypes as T
     st = StateTable(MemoryStateStore(), 1, gd + [T.BYTEA],
@@ -71,12 +82,29 @@ def main(argv: List[str]) -> int:
     upstream = RemoteInput((host, port), plan["in_channel"],
                            _schema(plan["in_schema"]),
                            append_only=plan.get("append_only", False))
-    execu = build_fragment(plan, upstream)
+    upstream2 = None
+    if "in_channel_r" in plan:          # two-input fragments (joins)
+        upstream2 = RemoteInput((host, port), plan["in_channel_r"],
+                                _schema(plan["in_schema_r"]),
+                                append_only=plan.get("append_only_r",
+                                                     False))
+    execu = build_fragment(plan, upstream, upstream2)
     server = ExchangeServer()
     out = server.register(0, execu.schema.dtypes)
     print(f"ADDR {server.addr[0]} {server.addr[1]}", flush=True)
+    # Recovery seeding: the coordinator replays shadowed state rows as
+    # the first epoch; they rebuild this worker's fragment state but
+    # their OUTPUTS are already in the downstream MV's recovered
+    # snapshot, so everything before the first barrier is swallowed.
+    suppress = plan.get("suppress_first_epoch", False)
     try:
         for msg in execu.execute():
+            if suppress:
+                from ..ops.message import Barrier as _B
+                if isinstance(msg, _B):
+                    suppress = False
+                else:
+                    continue
             out.send(msg)
     except (ConnectionError, OSError):
         return 2          # coordinator gone: exit quietly, nothing to save

@@ -581,6 +581,20 @@ class Planner:
                 lexec, rexec, lkeys, rkeys, condition=cond,
                 left_state=left_state, right_state=right_state,
                 mesh=self.device.mesh, capacity=self.device.capacity)
+        elif self.parallelism > 1 \
+                and getattr(self, "placement", "local") == "process" \
+                and cond is None \
+                and ref.kind in ("inner", "left", "right", "full"):
+            # hash-partitioned join across worker OS processes: workers
+            # own their key space and keep the full join state; the
+            # coordinator shadows both sides and re-seeds respawned
+            # workers (runtime/remote_fragments.py RemoteStatefulSet)
+            from ..runtime.remote_fragments import make_remote_join
+            rfs = make_remote_join(lexec, rexec, lkeys, rkeys,
+                                   _JOIN_KIND[ref.kind],
+                                   self.parallelism,
+                                   left_state, right_state)
+            return rfs.merge_executor(), ns
         else:
             execu = HashJoinExecutor(
                 lexec, rexec, lkeys, rkeys, _JOIN_KIND[ref.kind],
