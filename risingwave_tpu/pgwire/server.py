@@ -310,6 +310,7 @@ class _Conn:
                 if isinstance(stmt, (A.CreateTable,
                                      A.CreateMaterializedView,
                                      A.CreateSink, A.DropObject,
+                                     A.CreateIndex, A.CreateFunction,
                                      A.AlterParallelism)) \
                         or (isinstance(stmt, A.SetVar) and stmt.system):
                     # per-statement text, like Database.run — logging the
