@@ -211,16 +211,205 @@ def _aliased_subqueries(t: Optional[A.TableRef],
         _aliased_subqueries(t.right, out, right_nullable)
 
 
-def optimize(q: A.Select, log: Optional[List[str]] = None) -> A.Select:
-    """Apply the rewrite rules to `q` (recursively to FROM subqueries).
-    Mutates subquery internals (the AST is planner-owned) and returns q."""
+# ---------------------------------------------------------------------------
+# rule framework (`src/frontend/src/optimizer/` OptimizationStage analog:
+# named rules applied to fixpoint in ordered passes, every application
+# logged for EXPLAIN; cost input = catalog row counts via RuleContext)
+# ---------------------------------------------------------------------------
+
+
+class RuleContext:
+    def __init__(self, log: List[str], stats=None):
+        self.log = log
+        self._stats = stats
+
+    def rows(self, table: Optional[str]) -> Optional[int]:
+        """Current row count of a named relation (None = unknown) — the
+        cost model's cardinality source (the reference reads catalog
+        statistics the same way)."""
+        if self._stats is None or table is None:
+            return None
+        try:
+            return self._stats(table)
+        except Exception:  # noqa: BLE001 — stats are advisory
+            return None
+
+
+class Rule:
+    """One rewrite: apply() mutates `q` in place and returns True when it
+    changed something (the driver iterates to fixpoint)."""
+    name = "?"
+
+    def apply(self, q: A.Select, ctx: RuleContext) -> bool:
+        raise NotImplementedError
+
+
+class ConstantFolding(Rule):
+    name = "constant_folding"
+
+    def apply(self, q, ctx):
+        # change detection via the log: every real fold records a line
+        # (fold_expr clones unconditionally, so identity can't be used)
+        n0 = len(ctx.log)
+        if q.where is not None:
+            q.where = fold_expr(q.where, ctx.log)
+            if isinstance(q.where, A.Lit) and q.where.value is True:
+                q.where = None
+                ctx.log.append("drop_where_true")
+        if q.having is not None:
+            q.having = fold_expr(q.having, ctx.log)
+        q.items = [replace(it, expr=fold_expr(it.expr, ctx.log))
+                   if isinstance(it.expr, A.ExprNode) else it
+                   for it in q.items]
+        return len(ctx.log) > n0
+
+
+class PredicatePushdown(Rule):
+    """WHERE conjuncts over one aliased FROM-subquery move inside it
+    (below its aggregation when group-key-only) — predicate_push_down.rs
+    analog."""
+    name = "predicate_pushdown"
+
+    def apply(self, q, ctx):
+        subs: Dict[str, A.SubqueryTable] = {}
+        _aliased_subqueries(q.from_, subs)
+        if not subs or q.where is None:
+            return False
+        keep: List[Any] = []
+        changed = False
+        for pred in _conjuncts(q.where):
+            tabs: set = set()
+            if _col_tables(pred, tabs) and len(tabs) == 1 \
+                    and next(iter(tabs)) in subs \
+                    and _push_into_subquery(subs[next(iter(tabs))],
+                                            pred, ctx.log):
+                changed = True
+                continue
+            keep.append(pred)
+        q.where = _conjoin(keep)
+        return changed
+
+
+def _rel_alias(t: Any) -> Optional[str]:
+    if isinstance(t, A.NamedTable):
+        return t.alias or t.name
+    if isinstance(t, A.SubqueryTable):
+        return t.alias
+    return None
+
+
+def _rel_name(t: Any) -> Optional[str]:
+    return t.name if isinstance(t, A.NamedTable) else None
+
+
+class JoinReorder(Rule):
+    """Greedy cost-based reordering of pure INNER-join chains: flatten
+    the tree, then rebuild left-deep starting from the smallest relation
+    and repeatedly joining the smallest CONNECTED one (a predicate must
+    link it — no cross products introduced). The cost input is current
+    catalog row counts; unknown sizes sort last. The reference's
+    reorder rule works over its logical join graph the same way
+    (`optimizer/rule/`, join ordering)."""
+    name = "join_reorder"
+
+    def apply(self, q, ctx):
+        t = q.from_
+        if not isinstance(t, A.Join) or t.kind != "inner":
+            return False
+        rels: List[Any] = []
+        preds: List[Any] = []
+
+        def flatten(x) -> bool:
+            if isinstance(x, A.Join) and x.kind == "inner" \
+                    and x.on is not None:
+                if not (flatten(x.left) and flatten(x.right)):
+                    return False
+                preds.extend(_conjuncts(x.on))
+                return True
+            if isinstance(x, (A.NamedTable, A.SubqueryTable)) \
+                    and _rel_alias(x):
+                rels.append(x)
+                return True
+            return False
+
+        if not flatten(t) or len(rels) < 3:
+            return False
+        # SELECT * follows the join-tree column order — reordering would
+        # silently reshape the output schema
+        if any(isinstance(it.expr, A.Star) for it in q.items):
+            return False
+        aliases = [_rel_alias(r) for r in rels]
+        if len(set(aliases)) != len(aliases):
+            return False
+        # predicate -> set of aliases it references
+        pinfo = []
+        for p in preds:
+            tabs: set = set()
+            if not _col_tables(p, tabs) or not tabs <= set(aliases):
+                return False        # unresolvable column -> keep shape
+            equi = (isinstance(p, A.BinOp) and p.op == "="
+                    and isinstance(p.left, A.Col)
+                    and isinstance(p.right, A.Col) and len(tabs) == 2)
+            pinfo.append((p, tabs, equi))
+        sizes = {a: ctx.rows(_rel_name(r))
+                 for a, r in zip(aliases, rels)}
+        if all(v is None for v in sizes.values()):
+            return False            # no cost signal: keep the user's order
+        big = 1 << 60
+
+        def size(a):
+            return sizes[a] if sizes[a] is not None else big
+
+        by_alias = dict(zip(aliases, rels))
+        order = [min(aliases, key=size)]
+        remaining = [a for a in aliases if a != order[0]]
+        placed_preds: List[List[Any]] = []
+        used = [False] * len(pinfo)
+        while remaining:
+            have = set(order)
+            # connectivity = an EQUI predicate links the candidate to the
+            # placed set; residual conjuncts alone would build a join the
+            # planner rejects ("requires at least one equi-condition")
+            connected = [a for a in remaining
+                         if any(equi and a in tabs and tabs - {a} <= have
+                                for p, tabs, equi in pinfo)]
+            if not connected:
+                return False        # would need a cross product
+            nxt = min(connected, key=size)
+            order.append(nxt)
+            remaining.remove(nxt)
+            have.add(nxt)
+            batch = []
+            for i, (p, tabs, _e) in enumerate(pinfo):
+                if not used[i] and tabs <= have:
+                    used[i] = True
+                    batch.append(p)
+            placed_preds.append(batch)
+        if order == aliases:
+            return False            # already optimal
+        tree: Any = by_alias[order[0]]
+        for a, batch in zip(order[1:], placed_preds):
+            tree = A.Join(tree, by_alias[a], "inner", _conjoin(batch))
+        q.from_ = tree
+        ctx.log.append(f"join_reorder({'⋈'.join(order)})")
+        return True
+
+
+RULES: List[Rule] = [ConstantFolding(), PredicatePushdown(), JoinReorder()]
+_MAX_PASSES = 4
+
+
+def optimize(q: A.Select, log: Optional[List[str]] = None,
+             stats=None) -> A.Select:
+    """Run the rule set to fixpoint over `q` (recursively over FROM
+    subqueries, inside-out like the reference's stage pipeline)."""
     if log is None:
         log = []
     q.applied_rules = log   # type: ignore[attr-defined]
-    # recurse into FROM subqueries first (inside-out like the reference)
+
     def rec_tables(t: Optional[A.TableRef]) -> None:
         if isinstance(t, A.SubqueryTable):
-            optimize(t.query, log)
+            optimize(t.query, log, stats)
         elif isinstance(t, A.Join):
             rec_tables(t.left)
             rec_tables(t.right)
@@ -228,29 +417,9 @@ def optimize(q: A.Select, log: Optional[List[str]] = None) -> A.Select:
             rec_tables(t.inner)
     rec_tables(q.from_)
 
-    if q.where is not None:
-        q.where = fold_expr(q.where, log)
-        if isinstance(q.where, A.Lit) and q.where.value is True:
-            q.where = None
-            log.append("drop_where_true")
-    if q.having is not None:
-        q.having = fold_expr(q.having, log)
-    q.items = [replace(it, expr=fold_expr(it.expr, log))
-               if isinstance(it.expr, A.ExprNode) else it
-               for it in q.items]
-
-    # predicate pushdown into aliased subqueries in FROM (incl. join sides)
-    subs = {}
-    _aliased_subqueries(q.from_, subs)
-    if subs and q.where is not None:
-        keep: List[Any] = []
-        for pred in _conjuncts(q.where):
-            tabs: set = set()
-            if _col_tables(pred, tabs) and len(tabs) == 1 \
-                    and next(iter(tabs)) in subs \
-                    and _push_into_subquery(subs[next(iter(tabs))],
-                                            pred, log):
-                continue
-            keep.append(pred)
-        q.where = _conjoin(keep)
+    ctx = RuleContext(log, stats)
+    for _ in range(_MAX_PASSES):
+        applied = [r.apply(q, ctx) for r in RULES]   # no short-circuit
+        if not any(applied):
+            break
     return q

@@ -842,7 +842,12 @@ class Planner:
         # recursion below sees already-optimized nodes
         if not hasattr(q, "applied_rules"):
             from .optimizer import optimize
-            optimize(q)
+            stats = None
+            if self.state_table_of is not None:
+                def stats(name, _sto=self.state_table_of):
+                    st = _sto(name)
+                    return len(st) if st is not None else None
+            optimize(q, stats=stats)
         if q.from_ is None:
             raise ValueError("SELECT without FROM is a batch-only statement")
         # WHERE conjuncts are visible to FROM planning so comma-joins can
