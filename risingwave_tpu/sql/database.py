@@ -53,19 +53,40 @@ DDL_LOG_PK = (0,)
 
 
 class _Backfill(Executor):
-    """Yield a snapshot chunk, then the live change stream
-    (`arrangement_backfill.rs` analog, trivially: snapshot is consistent
-    because DDL runs between barriers)."""
+    """Yield the upstream snapshot in bounded chunks, then the live
+    change stream (`arrangement_backfill.rs` analog — snapshot is
+    consistent because DDL runs between barriers). Progress (rows
+    emitted / total) is tracked per executor and surfaced through
+    `rw_ddl_progress` (the meta `barrier/progress.rs` reporting)."""
 
-    def __init__(self, snapshot: Optional[StreamChunk], port: Executor):
+    CHUNK = 1024
+
+    def __init__(self, snapshot: Optional[StreamChunk], port: Executor,
+                 upstream_name: str = ""):
         super().__init__(port.schema, "Backfill")
         self.append_only = port.append_only
         self.snapshot = snapshot
         self.port = port
+        self.upstream_name = upstream_name
+        self.total = snapshot.capacity if snapshot is not None else 0
+        self.emitted = 0
+        self.done = self.total == 0
+
+    @property
+    def progress(self) -> float:
+        return 1.0 if self.done else self.emitted / max(1, self.total)
 
     def execute(self) -> Iterator[Message]:
         if self.snapshot is not None and self.snapshot.capacity:
-            yield self.snapshot
+            cols = self.snapshot.columns
+            n = self.snapshot.capacity
+            for lo in range(0, n, self.CHUNK):
+                hi = min(n, lo + self.CHUNK)
+                idx = np.arange(lo, hi)
+                yield StreamChunk(self.snapshot.ops[lo:hi],
+                                  [c.take(idx) for c in cols])
+                self.emitted = hi
+        self.done = True
         yield from self.port.execute()
 
 
@@ -442,7 +463,7 @@ class Database:
                     [(Op.INSERT, r) for r in snapshot_rows])
         port = rt["shared"].subscribe()
         self._pending_subs.append((rt["shared"], port))
-        return _Backfill(snap, port), obj.schema, obj.pk
+        return _Backfill(snap, port, name), obj.schema, obj.pk
 
     def _make_state(self, dtypes, pk):
         return StateTable(self.store, self.catalog.alloc_table_id(),
@@ -969,6 +990,7 @@ class Database:
         import time as _time
         from ..utils.metrics import REGISTRY
         t0 = _time.perf_counter()
+        self._heartbeat_workers()
         b = self.injector.inject()
         span = self.tracer.inject(b.epoch.curr, b.kind.value)
         # fused device jobs first: their epoch dispatch is ASYNC (no device
@@ -1003,6 +1025,34 @@ class Database:
                        ).set(self.epoch_committed)
         REGISTRY.gauge("streaming_jobs", "running dataflows"
                        ).set(len(self._iters))
+
+    def _heartbeat_workers(self) -> None:
+        """Proactive worker liveness sweep, once per barrier tick (the
+        meta heartbeat/expire analog, `src/meta/src/manager/cluster.rs`):
+        a worker that dies while its job is QUIESCENT surfaces at the
+        next tick instead of whenever traffic next touches its stream."""
+        from ..runtime.remote_fragments import RemoteWorkerDied
+        from ..utils.metrics import REGISTRY
+        for obj in self.catalog.objects.values():
+            rt = obj.runtime if isinstance(obj.runtime, dict) else None
+            shared = rt.get("shared") if rt else None
+            if shared is None:
+                continue
+            for e in _walk_executors(shared.upstream):
+                r = getattr(e, "_remote", None)
+                if r is None:
+                    continue
+                for w in r.workers:
+                    if w.proc.poll() is not None:
+                        REGISTRY.counter(
+                            "worker_heartbeat_failures",
+                            "dead workers caught by the heartbeat sweep"
+                            ).inc()
+                        raise RemoteWorkerDied(
+                            f"worker pid={w.proc.pid} of job "
+                            f"{obj.name!r} exited rc="
+                            f"{w.proc.returncode} (heartbeat sweep; "
+                            "restart the job — DDL replay rebuilds it)")
 
     def metrics(self) -> str:
         """Prometheus text exposition (MonitorService analog)."""

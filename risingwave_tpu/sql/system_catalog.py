@@ -74,7 +74,29 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("job", T.VARCHAR), ("state", T.VARCHAR),
                   ("ms", T.FLOAT64)),
         lambda db: db.tracer.rows()),
+    # backfill progress per streaming job (`barrier/progress.rs` /
+    # rw_ddl_progress analog): rows emitted / snapshot total per upstream
+    "rw_ddl_progress": (
+        Schema.of(("job", T.VARCHAR), ("upstream", T.VARCHAR),
+                  ("emitted", T.INT64), ("total", T.INT64),
+                  ("progress", T.VARCHAR)),
+        lambda db: _ddl_progress(db)),
 }
+
+
+def _ddl_progress(db) -> List[Tuple]:
+    from .database import _Backfill, _walk_executors
+    out = []
+    for obj in db.catalog.objects.values():
+        rt = obj.runtime if isinstance(obj.runtime, dict) else None
+        shared = rt.get("shared") if rt else None
+        if shared is None:
+            continue
+        for e in _walk_executors(shared.upstream):
+            if isinstance(e, _Backfill) and e.total:
+                out.append((obj.name, e.upstream_name, e.emitted,
+                            e.total, f"{e.progress * 100:.1f}%"))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -213,3 +213,23 @@ class TestRemoteJoin:
         assert sorted(db2.query("SELECT * FROM rj")) == \
             [(10, 100), (11, 100), (20, 200)]
         find_remote(db2, "rj").shutdown()
+
+
+def test_heartbeat_detects_quiescent_worker_death():
+    """A worker dying while the job is idle (no traffic in flight) must
+    surface at the NEXT tick via the heartbeat sweep, not hang until
+    traffic next touches the stream (meta heartbeat/expire analog)."""
+    from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+    db = Database()
+    db.run(SRC.format(n=2048, c=32))       # drains almost immediately
+    db.run("SET streaming_parallelism = 2")
+    db.run("SET streaming_placement = 'process'")
+    db.run(MV)
+    drive(db, 2048, 32)                    # source exhausted: quiescent
+    rfs = find_remote(db, "q4")
+    rfs.workers[1].proc.kill()
+    rfs.workers[1].proc.wait()
+    with pytest.raises(RemoteWorkerDied, match="heartbeat"):
+        for _ in range(3):
+            db.tick()
+    rfs.shutdown()

@@ -91,3 +91,20 @@ def test_nexmark_source_column_subset():
     with pytest.raises(ValueError, match="no columns"):
         db.run("CREATE SOURCE bad (nope INT) WITH (connector='nexmark', "
                "nexmark.table='bid')")
+
+
+def test_rw_ddl_progress_reports_backfill():
+    from risingwave_tpu.sql import Database
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+    db.run("INSERT INTO t VALUES " +
+           ", ".join(f"({i}, {i})" for i in range(3000)))
+    for _ in range(3):
+        db.tick()
+    db.run("CREATE MATERIALIZED VIEW m AS SELECT k, v FROM t"
+           " WHERE v >= 0")
+    for _ in range(3):
+        db.tick()
+    rows = db.query("SELECT * FROM rw_ddl_progress")
+    assert rows == [("m", "t", 3000, 3000, "100.0%")]
+    assert db.query("SELECT count(*) FROM m") == [(3000,)]
