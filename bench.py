@@ -49,18 +49,17 @@ EPOCHS = 50
 ROWS = 262_144
 N_AUCTIONS = 10_000
 # SQL-path scales (events are 1:3:46 person:auction:bid out of 50).
-# Each entry is (full, fallback): the full scale gets TWO budgeted
-# attempts — a killed first attempt still wrote persistent-cache entries
-# for every program that finished compiling, so the retry starts warmer —
-# then one attempt at the fallback scale.
-Q4_SQL_EVENTS = (8_388_608, 2_097_152)
+# Every retry stays at the SAME scale: a killed attempt's finished
+# compiles persist in the cache, so same-scale retries converge, while a
+# different scale would re-trace (the programs embed the event bound).
+Q4_SQL_EVENTS = (8_388_608,)
 # qx runs at the scale/capacity pairing that is measured to complete on
 # the tunnel: larger capacities make each epoch's sorts so heavy that a
 # single pass outruns any stage budget, and larger scales grow capacity
 # mid-run (each growth replays every epoch since the last checkpoint).
 # The honest note: qx device throughput is growth-replay-bound at this
 # configuration; q4 is the device path's headline.
-QX_SQL_EVENTS = (1_048_576, 524_288)
+QX_SQL_EVENTS = (1_048_576,)
 QX_CAPACITY = 1 << 16
 HOST_SQL_EVENTS = 131_072                # host path is per-row Python
 HOST_QX_EVENTS = 16_384                  # hop expansion is 5x rows on host
@@ -419,11 +418,13 @@ def _qx_db(on, n_events, capacity):
 
 def stage_qx_device(n_events):
     """Workload 3: q5/q7/q8 through SQL on the device path + oracles.
-    Warmup pass then measured pass, as in stage_q4_device."""
+    SINGLE pass (unlike q4): qx throughput is growth-replay-bound, so a
+    separate warmup pass would double a stage that already brushes its
+    budget without changing the steady-state story; compiled programs
+    persist in the cache across attempts either way."""
     t0 = time.perf_counter()
-    _qx_db(True, n_events, QX_CAPACITY)
-    warmup_s = time.perf_counter() - t0
     eps, qx = _qx_db(True, n_events, QX_CAPACITY)
+    warmup_s = round(time.perf_counter() - t0, 1)
     c = nexmark_host_columns(n_events)
     bid, auc, per = c["bid"], c["auction"], c["person"]
     t0 = time.perf_counter()
@@ -452,7 +453,9 @@ def stage_qx_device(n_events):
         "mv_verified": True,
         "note": "three reference-SQL MVs concurrently over shared "
                 "sources; device_eps counts each source event once; "
-                "oracles computed independently in numpy",
+                "single pass (warmup_s = its wall incl. cache loads; "
+                "throughput is capacity-growth-replay-bound at this "
+                "scale); oracles computed independently in numpy",
     }}
 
 
@@ -625,17 +628,22 @@ def main():
         # finish each stage in well under 120s.
         if not h.run_stage("fused", (EPOCHS, ROWS), 300):
             h.run_stage("fused", (EPOCHS, ROWS), 150, " — retry (warmer)")
+        # retries stay at the SAME scale: the traced programs embed the
+        # event bound (SourceNode max_events / pack-plan ranges), so a
+        # smaller fallback scale would start cold while same-scale
+        # attempts resume from every cache entry the killed attempt wrote
         if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 600):
-            if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 300,
+            if not h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 400,
                                " — retry (warmer)"):
-                h.run_stage("q4_device", (Q4_SQL_EVENTS[1],), 150,
-                            " — retrying smaller")
+                h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 300,
+                            " — retry (warmer still)")
         h.run_stage("q4_host", (HOST_SQL_EVENTS,), 60)
-        if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 700):
-            if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 350,
+        # warmup + measured pass + three numpy oracles ≈ 650-850s warm
+        if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 1200):
+            if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 900,
                                " — retry (warmer)"):
-                h.run_stage("qx_device", (QX_SQL_EVENTS[1],), 200,
-                            " — retrying smaller")
+                h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 700,
+                            " — retry (warmer still)")
         h.run_stage("qx_host", (HOST_QX_EVENTS,), 60)
     h.emit()
 

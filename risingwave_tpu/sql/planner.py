@@ -1277,35 +1277,45 @@ class Planner:
                  if isinstance(i.expr, A.FuncCall) and i.expr.over is not None]
         first = specs[0].expr.over
         for s in specs[1:]:
-            if s.expr.over != first:
-                raise ValueError("multiple distinct OVER() specs unsupported")
+            o = s.expr.over
+            # frames are per-CALL (the executor computes each call's
+            # frame independently); only partition/order must agree
+            if o.partition_by != first.partition_by \
+                    or o.order_by != first.order_by:
+                raise ValueError("multiple distinct OVER() "
+                                 "partition/order specs unsupported")
         b = Binder(ns)
         partition = [_as_input_ref(b.bind(p)) for p in first.partition_by]
         order = [(_as_input_ref(b.bind(e)), d) for e, d in first.order_by]
-        frame, mode = (None, 0), "rows"
-        if first.frame is not None:
-            mode = first.frame[0]
-            ok = None
-            if mode == "range" and order:
-                ok = ns.cols[order[0][0]].dtype.kind
-                has_offset = any(bd[0] in ("preceding", "following")
-                                 for bd in (first.frame[1], first.frame[2]))
-                if has_offset and ok not in (
-                        TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
-                        TypeKind.FLOAT32, TypeKind.FLOAT64,
-                        TypeKind.DECIMAL, TypeKind.TIMESTAMP,
-                        TypeKind.TIMESTAMPTZ, TypeKind.DATE,
-                        TypeKind.TIME):
-                    # PG rejects offset RANGE frames over non-orderable-
-                    # by-offset columns at plan time
-                    raise ValueError(
-                        "RANGE with offset requires a numeric or "
-                        "datetime ORDER BY column")
-            frame = (self._frame_offset(first.frame[1], b, True, ok),
-                     self._frame_offset(first.frame[2], b, False, ok))
-            if frame[0] is not None and frame[1] is not None \
-                    and frame[0] > frame[1]:
-                raise ValueError("frame start cannot be past frame end")
+        def bind_frame(spec):
+            """Per-CALL frame: each OVER() clause carries its own."""
+            frame, mode = (None, 0), "rows"
+            if spec.frame is not None:
+                mode = spec.frame[0]
+                ok = None
+                if mode == "range" and order:
+                    ok = ns.cols[order[0][0]].dtype.kind
+                    has_offset = any(
+                        bd[0] in ("preceding", "following")
+                        for bd in (spec.frame[1], spec.frame[2]))
+                    if has_offset and ok not in (
+                            TypeKind.INT16, TypeKind.INT32, TypeKind.INT64,
+                            TypeKind.FLOAT32, TypeKind.FLOAT64,
+                            TypeKind.DECIMAL, TypeKind.TIMESTAMP,
+                            TypeKind.TIMESTAMPTZ, TypeKind.DATE,
+                            TypeKind.TIME):
+                        # PG rejects offset RANGE frames over non-
+                        # orderable-by-offset columns at plan time
+                        raise ValueError(
+                            "RANGE with offset requires a numeric or "
+                            "datetime ORDER BY column")
+                frame = (self._frame_offset(spec.frame[1], b, True, ok),
+                         self._frame_offset(spec.frame[2], b, False, ok))
+                if frame[0] is not None and frame[1] is not None \
+                        and frame[0] > frame[1]:
+                    raise ValueError("frame start cannot be past frame "
+                                     "end")
+            return frame, mode
         calls = []
         for s in specs:
             f: A.FuncCall = s.expr
@@ -1315,6 +1325,7 @@ class Planner:
             arg = b.bind(f.args[0]) if f.args else None
             if f.name in ("sum", "count", "min", "max", "avg",
                           "first_value", "last_value"):
+                frame, mode = bind_frame(f.over)
                 calls.append(WindowFuncCall(f.name, arg, frame=frame,
                                             frame_mode=mode))
             else:
