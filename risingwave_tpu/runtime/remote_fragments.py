@@ -35,23 +35,35 @@ class RemoteWorkerDied(RuntimeError):
     pass
 
 
-def serializable_agg(input: "Executor", calls) -> bool:
-    """Remote placement = 2-phase aggregation, so it needs (a) an
-    append-only input (stateless partials can't retract), (b) plain
-    column-arg calls whose partials COMPOSE (no DISTINCT/FILTER, no avg —
-    an avg of avgs is wrong). Everything else stays on the local path."""
+def _plain_column_calls(calls, kinds) -> bool:
+    """Shared eligibility core: plain column-arg aggregates of the given
+    kinds, no DISTINCT/FILTER/ordered-set shapes (those expressions
+    don't serialize to the plan wire)."""
     from ..expr.expression import InputRef
-    if not input.append_only:
-        return False
     for c in calls:
-        if c.distinct or c.filter is not None:
+        if c.distinct or c.filter is not None \
+                or getattr(c, "direct_args", ()):
             return False
         if c.arg is not None and not isinstance(c.arg, InputRef):
             return False
-        if c.kind not in ("count", "sum", "min", "max",
-                          "bool_and", "bool_or"):
+        if c.kind not in kinds:
             return False
     return True
+
+
+def _serialize_calls(calls):
+    """Plan wire encoding of agg calls: [kind, arg column index]."""
+    return [[c.kind, c.arg.index if c.arg is not None else None]
+            for c in calls]
+
+
+def serializable_agg(input: "Executor", calls) -> bool:
+    """Remote placement = 2-phase aggregation, so it needs (a) an
+    append-only input (stateless partials can't retract), (b) plain
+    column-arg calls whose partials COMPOSE (no avg — an avg of avgs
+    is wrong). Everything else stays on the stateful or local path."""
+    return input.append_only and _plain_column_calls(
+        calls, ("count", "sum", "min", "max", "bool_and", "bool_or"))
 
 
 class _WorkerHandle:
@@ -100,9 +112,7 @@ class RemoteFragmentSet:
                 "fragment": {
                     "kind": "partial_hash_agg",
                     "group_indices": list(group_indices),
-                    "calls": [[c.kind,
-                               c.arg.index if c.arg is not None else None]
-                              for c in calls],
+                    "calls": _serialize_calls(calls),
                 },
             })
         for p in plans:
@@ -342,3 +352,36 @@ def make_remote_join(lexec: Executor, rexec: Executor, lkeys, rkeys,
                 "right_keys": list(rkeys), "join_type": join_type.value}
     return RemoteStatefulSet([lin, rin], [list(lkeys), list(rkeys)],
                              fragment, k, suppress_first_epoch=seeding)
+
+
+def remotable_calls(calls) -> bool:
+    """Owned-group remote agg covers plain column aggregates — exact
+    under retraction because each WORKER keeps the full stateful agg
+    (multiset min/max), so avg is fine too."""
+    return _plain_column_calls(
+        calls, ("count", "sum", "min", "max", "avg",
+                "bool_and", "bool_or"))
+
+
+def make_remote_agg(input: Executor, group_indices, calls, k: int,
+                    shadow_table) -> "RemoteStatefulSet":
+    """Retractable aggregation across k worker processes: the input
+    (which must carry a unique row identity — the planner appends the
+    upstream stream key) hash-dispatches on the group key; each worker
+    owns its groups and runs the FULL stateful HashAggExecutor (multiset
+    min/max — exact under retraction). The coordinator shadows the LIVE
+    input rows and re-seeds respawned workers with them: agg state is a
+    pure function of the live input multiset, so replaying the shadow
+    (outputs suppressed) rebuilds it exactly."""
+    seed = [tuple(r) for r in shadow_table.iter_all()] \
+        if shadow_table is not None else []
+    seeding = bool(seed)
+    src = TeeStateExecutor(input, shadow_table) \
+        if shadow_table is not None else input
+    if seeding:
+        src = _SeedPrepend(src, seed)
+    fragment = {"kind": "hash_agg",
+                "group_indices": list(group_indices),
+                "calls": _serialize_calls(calls)}
+    return RemoteStatefulSet([src], [list(group_indices)], fragment, k,
+                             suppress_first_epoch=seeding)

@@ -300,7 +300,8 @@ class Planner:
 
     def _make_hash_agg(self, input: Executor, group_indices: List[int],
                        calls: List[AggCall], gdtypes: List[DataType],
-                       eowc: bool = False, wc: Optional[int] = None
+                       eowc: bool = False, wc: Optional[int] = None,
+                       carry_cols: Optional[List[int]] = None
                        ) -> Executor:
         """Device-vs-host HashAgg dispatch. State-table allocation order is
         deterministic PER DISPATCH POLICY (host: one pickled-state table;
@@ -367,6 +368,20 @@ class Planner:
                 st = self.make_state(gdtypes + [T.BYTEA], list(range(ng)))
                 return HashAggExecutor(merge, list(range(ng)),
                                        rfs.final_calls(), state_table=st)
+            from ..runtime.remote_fragments import (make_remote_agg,
+                                                    remotable_calls)
+            if carry_cols and remotable_calls(calls):
+                # retractable/owned-group placement: workers keep the
+                # FULL stateful agg for their hash-owned groups; the
+                # coordinator shadows the live input rows and re-seeds
+                # respawned workers — agg state is a pure function of
+                # the live input multiset. Shadow pk = the carried
+                # stream-key columns (the unique row identity).
+                dts = input.schema.dtypes
+                shadow = self.make_state(dts, list(carry_cols))
+                rfs = make_remote_agg(input, group_indices, calls,
+                                      self.parallelism, shadow)
+                return rfs.merge_executor()
         if self.parallelism > 1 and group_indices and not eowc:
             # Dispatch -> k parallel agg fragments -> Merge: the reference's
             # hash-exchange topology (`dispatch.rs:777` HashDataDispatcher,
@@ -1172,6 +1187,19 @@ class Planner:
             # its columns) — project a constant
             pre_exprs = [Literal(1, T.INT32)]
             pre_names = ["_one"]
+        # under process placement, carry the upstream stream key through
+        # the pre-agg projection: remote stateful agg fragments need a
+        # unique row identity for the coordinator's input shadow
+        carry_cols: Optional[List[int]] = None
+        if getattr(self, "placement", "local") == "process" \
+                and self.parallelism > 1 and group_exprs \
+                and not getattr(q, "emit_on_window_close", False) \
+                and ns.stream_key:
+            carry_cols = []
+            for sk in ns.stream_key:
+                carry_cols.append(len(pre_exprs))
+                pre_exprs.append(InputRef(sk, ns.cols[sk].dtype))
+                pre_names.append(f"_rk{sk}")
         proj = ProjectExecutor(execu, pre_exprs, pre_names)
         eowc = getattr(q, "emit_on_window_close", False)
         wc = None
@@ -1181,7 +1209,7 @@ class Planner:
             gdtypes = [e.return_type for e in group_exprs]
             agg: Executor = self._make_hash_agg(
                 proj, list(range(len(group_exprs))), calls, gdtypes,
-                eowc=eowc, wc=wc)
+                eowc=eowc, wc=wc, carry_cols=carry_cols)
         else:
             st = self.make_state([T.INT64, T.BYTEA], [0])
             agg = SimpleAggExecutor(proj, calls, state_table=st)

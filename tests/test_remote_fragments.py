@@ -233,3 +233,64 @@ def test_heartbeat_detects_quiescent_worker_death():
         for _ in range(3):
             db.tick()
     rfs.shutdown()
+
+
+class TestRemoteRetractableAgg:
+    """Owned-group stateful aggs across worker processes: multiset
+    min/max exact under retraction, shadow-seeded recovery (the VERDICT
+    r04 item: retractable aggs ship their state across worker death)."""
+
+    AGG_MV = ("CREATE MATERIALIZED VIEW ra AS SELECT k, count(*) AS c,"
+              " min(v) AS lo, max(v) AS hi FROM t GROUP BY k")
+
+    def _mk(self, d=None):
+        db = Database(data_dir=d) if d else Database()
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        db.run(self.AGG_MV)
+        return db
+
+    def test_retraction_exactness(self):
+        db = self._mk()
+        rfs = find_remote(db, "ra")
+        assert len(rfs.workers) == 2
+        db.run("INSERT INTO t VALUES (1, 10), (1, 5), (2, 7), (2, 9)")
+        for _ in range(4):
+            db.tick()
+        assert sorted(db.query("SELECT * FROM ra")) == \
+            [(1, 2, 5, 10), (2, 2, 7, 9)]
+        db.run("DELETE FROM t WHERE v = 5")
+        for _ in range(4):
+            db.tick()
+        # the multiset state retracts the old min exactly
+        assert sorted(db.query("SELECT * FROM ra")) == \
+            [(1, 1, 10, 10), (2, 2, 7, 9)]
+        rfs.shutdown()
+
+    def test_worker_kill_reseeds_agg_state(self, tmp_path):
+        from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+        d = str(tmp_path / "data")
+        db = self._mk(d)
+        db.run("INSERT INTO t VALUES (1, 10), (1, 5), (2, 7)")
+        for _ in range(4):
+            db.tick()
+        rfs = find_remote(db, "ra")
+        rfs.workers[0].proc.kill()
+        with pytest.raises(RemoteWorkerDied):
+            for _ in range(10):
+                db.tick()
+        rfs.shutdown()
+        del db
+        db2 = Database(data_dir=d)
+        for _ in range(3):
+            db2.tick()
+        assert sorted(db2.query("SELECT * FROM ra")) == \
+            [(1, 2, 5, 10), (2, 1, 7, 7)]
+        # retraction against RESEEDED worker state: min(5) must retract
+        db2.run("DELETE FROM t WHERE v = 5")
+        for _ in range(4):
+            db2.tick()
+        assert sorted(db2.query("SELECT * FROM ra")) == \
+            [(1, 1, 10, 10), (2, 1, 7, 7)]
+        find_remote(db2, "ra").shutdown()
