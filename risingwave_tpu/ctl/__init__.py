@@ -14,6 +14,9 @@ Commands:
     dump NAME [--limit N]     rows of an object's state table
     compact                   merge every table's runs into one base
     metrics                   Prometheus exposition after recovery
+    backup --dest DIR         self-contained snapshot copy (restore =
+                              open the copy as a data directory)
+    history                   retained manifest versions (time travel)
     trace [--last N]          per-barrier span summary; flags OPEN
                               (stalled) epochs with the stuck job —
                               works on a LIVE or wedged data dir
@@ -163,6 +166,30 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_backup(args) -> int:
+    """Copy the committed snapshot (manifest + referenced runs + device
+    marker) into a self-contained directory; restore = open it as a data
+    directory (`src/meta/src/backup_restore/` analog)."""
+    store = _store(args.data_dir)
+    n = store.backup(args.dest)
+    print(f"backed up {n} run files + manifest -> {args.dest}")
+    print("restore: open it as a data_dir "
+          f"(Database(data_dir='{args.dest}'))")
+    return 0
+
+
+def cmd_history(args) -> int:
+    """Retained manifest versions (time-travel window)."""
+    store = _store(args.data_dir)
+    for m in store.history_versions():
+        n_runs = sum(len(r) for r in m["tables"].values())
+        print(f"epoch {m['committed_epoch']}: {len(m['tables'])} tables, "
+              f"{n_runs} runs")
+    if not store.history_versions():
+        print("no retained versions")
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m risingwave_tpu.ctl",
@@ -183,5 +210,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--last", type=int, default=5)
     sp.set_defaults(fn=cmd_trace)
+    sp = sub.add_parser("backup")
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--dest", required=True)
+    sp.set_defaults(fn=cmd_backup)
+    sp = sub.add_parser("history")
+    sp.add_argument("--data-dir", required=True)
+    sp.set_defaults(fn=cmd_history)
     args = p.parse_args(argv)
     return args.fn(args)

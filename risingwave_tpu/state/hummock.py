@@ -44,6 +44,8 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 from .store import StateStore
 
 MANIFEST = "MANIFEST.json"
+MANIFEST_HISTORY = "MANIFEST.history.json"
+HISTORY_VERSIONS = 8       # retained manifest versions (time travel)
 COMPACT_THRESHOLD = 8
 MAX_OPEN_READERS = 128  # cap on simultaneously open run fds (LRU-evicted)
 BLOCK_ROWS = 256           # entries per block (block.rs targets ~64KB)
@@ -334,7 +336,9 @@ class SpillStateStore(StateStore):
         self.committed_epoch = 0
         self.cache = BlockCache(cache_blocks)
         self._readers: Dict[str, RunReader] = {}
+        self._history: List[Dict[str, Any]] = []
         self._recover()
+        self._sweep()
 
     @classmethod
     def _acquire_dir_lock(cls, directory: str) -> None:
@@ -396,7 +400,8 @@ class SpillStateStore(StateStore):
         self._write_manifest()
         # old runs are deleted only after the manifest that no longer
         # references them is durable (crash between compact and manifest
-        # write must leave the previous version fully readable)
+        # write must leave the previous version fully readable); files a
+        # RETAINED version still references are spared until it ages out
         self._gc(garbage)
         self.committed_epoch = max(self.committed_epoch, epoch)
 
@@ -407,20 +412,35 @@ class SpillStateStore(StateStore):
                      reverse=True)
         return [self._deltas[(e, table_id)] for e in eps]
 
+    def _reader(self, name: str) -> RunReader:
+        """Open (or touch) one run reader, LRU-capping open fds."""
+        r = self._readers.pop(name, None)
+        if r is None:
+            r = RunReader(name, self._run_path(name), self.cache)
+        self._readers[name] = r
+        while len(self._readers) > MAX_OPEN_READERS:
+            old = next(iter(self._readers))
+            if old == name:
+                break
+            self._readers.pop(old).close()
+        return r
+
     def _run_readers(self, table_id: int) -> List[RunReader]:
         """This table's runs, newest first. Open handles are LRU-capped:
         each reader keeps one fd for its lifetime, and a long-lived process
         with many live runs would otherwise creep toward the ulimit."""
         out = []
+        live = set()
         for name in reversed(self._manifest["tables"].get(str(table_id), [])):
             r = self._readers.pop(name, None)   # re-insert = mark recent
             if r is None:
                 r = RunReader(name, self._run_path(name), self.cache)
             self._readers[name] = r
             out.append(r)
+            live.add(name)
         while len(self._readers) > MAX_OPEN_READERS:
             old = next(iter(self._readers))
-            if self._readers[old] in out:       # everything live this call
+            if old in live:                     # everything live this call
                 break
             self._readers.pop(old).close()
         return out
@@ -482,6 +502,100 @@ class SpillStateStore(StateStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.dir, MANIFEST))
+        # retained version history (time travel, `src/meta/src/hummock/
+        # manager/time_travel.rs` analog): the last HISTORY_VERSIONS
+        # manifests stay readable, and _gc spares any run they reference
+        self._history.append(json.loads(json.dumps(self._manifest)))
+        aged = self._history[:-HISTORY_VERSIONS]
+        del self._history[:-HISTORY_VERSIONS]
+        htmp = os.path.join(self.dir, MANIFEST_HISTORY + ".tmp")
+        with open(htmp, "w") as f:
+            json.dump(self._history, f)
+            f.flush()
+            os.fsync(f.fileno())     # a torn history file would silently
+        os.replace(htmp, os.path.join(self.dir, MANIFEST_HISTORY))
+        # age-out: runs referenced ONLY by versions that just left the
+        # window die now (incremental — no directory scan per commit)
+        dropped = set()
+        for m in aged:
+            for runs in m["tables"].values():
+                dropped.update(runs)
+        dropped -= self._retained()
+        if dropped:
+            self._gc(sorted(dropped), spare_retained=False)
+
+    def _retained(self) -> set:
+        """Runs referenced by the CURRENT manifest or any retained
+        version. The current manifest is included explicitly: a freshly
+        restored backup (or a pre-history directory) has an empty
+        history, and sweeping by history alone would delete the live
+        data itself."""
+        out = set()
+        for runs in self._manifest["tables"].values():
+            out.update(runs)
+        for m in self._history:
+            for runs in m["tables"].values():
+                out.update(runs)
+        return out
+
+    # ---- backup / time travel ------------------------------------------
+    def backup(self, dest_dir: str) -> int:
+        """Copy the current manifest + every referenced run into
+        `dest_dir` (hardlinks when the filesystem allows). The backup is
+        a self-contained data directory: opening it restores
+        (`src/meta/src/backup_restore/` analog). Returns files copied."""
+        import shutil
+        os.makedirs(os.path.join(dest_dir, "runs"), exist_ok=True)
+        n = 0
+        for runs in self._manifest["tables"].values():
+            for name in runs:
+                src = self._run_path(name)
+                dst = os.path.join(dest_dir, "runs", name)
+                if not os.path.exists(dst):
+                    try:
+                        os.link(src, dst)
+                    except OSError:
+                        shutil.copy2(src, dst)
+                    n += 1
+        with open(os.path.join(dest_dir, MANIFEST), "w") as f:
+            json.dump(self._manifest, f)
+        # the device-policy marker rides along so Database opens the
+        # backup under the policy that shaped its state-table layouts
+        marker = os.path.join(self.dir, "device_mode.json")
+        if os.path.exists(marker):
+            shutil.copy2(marker, os.path.join(dest_dir,
+                                              "device_mode.json"))
+        return n
+
+    def history_versions(self) -> List[Dict]:
+        """Retained manifest versions, oldest first (read-only copies)."""
+        return [dict(m) for m in self._history]
+
+    def manifest_at(self, epoch: int) -> Optional[Dict]:
+        """Newest RETAINED manifest with committed_epoch <= epoch."""
+        best = None
+        for m in self._history:          # oldest -> newest: latest wins,
+            if m["committed_epoch"] <= epoch:   # ties included (two DDL
+                if best is None or m["committed_epoch"] \
+                        >= best["committed_epoch"]:   # commits may share
+                    best = m                          # an epoch)
+        return best
+
+    def read_at(self, epoch: int, table_id: int
+                ) -> Iterator[Tuple[bytes, Tuple]]:
+        """Time-travel range read: the table's live rows as of the newest
+        retained version <= epoch. Raises when the version fell out of
+        the retention window."""
+        m = self.manifest_at(epoch)
+        if m is None:
+            raise ValueError(
+                f"no retained version at or before epoch {epoch} "
+                f"(retention: last {HISTORY_VERSIONS} manifests)")
+        names = m["tables"].get(str(table_id), [])
+        readers = [self._reader(n) for n in reversed(names)]
+        for k, v in _merge([r.iter_range(None, None) for r in readers]):
+            if v is not None:
+                yield k, v
 
     # ---- compaction -----------------------------------------------------
     def _compact(self, table_id: int, epoch: int) -> List[str]:
@@ -521,7 +635,27 @@ class SpillStateStore(StateStore):
             self._gc(garbage)
         return merged
 
-    def _gc(self, names: Sequence[str]) -> None:
+    def _sweep(self) -> None:
+        """Startup GC: delete run files referenced by NO retained
+        version (crash windows can leak files the incremental age-out
+        in _write_manifest would have deleted)."""
+        keep = self._retained()
+        runs_dir = os.path.join(self.dir, "runs")
+        try:
+            on_disk = os.listdir(runs_dir)
+        except FileNotFoundError:
+            return
+        dead = [f for f in on_disk
+                if (f.endswith(".run") or f.endswith(".base"))
+                and f not in keep]
+        if dead:
+            self._gc(dead, spare_retained=False)
+
+    def _gc(self, names: Sequence[str],
+            spare_retained: bool = True) -> None:
+        if spare_retained:
+            keep = self._retained()
+            names = [n for n in names if n not in keep]
         for n in names:
             r = self._readers.pop(n, None)
             if r is not None:
@@ -535,6 +669,13 @@ class SpillStateStore(StateStore):
     # ---- recovery -------------------------------------------------------
     def _recover(self) -> None:
         """Read the manifest; data stays on disk until referenced."""
+        hpath = os.path.join(self.dir, MANIFEST_HISTORY)
+        if os.path.exists(hpath):
+            try:
+                with open(hpath) as f:
+                    self._history = json.load(f)
+            except (OSError, ValueError):
+                self._history = []
         path = os.path.join(self.dir, MANIFEST)
         if not os.path.exists(path):
             return

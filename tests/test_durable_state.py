@@ -44,14 +44,16 @@ def test_uncommitted_epoch_lost_on_reopen(tmp_path):
 def test_compaction_keeps_data_and_prunes_files(tmp_path):
     d = str(tmp_path)
     st = SpillStateStore(d)
-    for e in range(1, 12):
+    # enough commits that pre-compaction runs also AGE OUT of the
+    # time-travel retention window (HISTORY_VERSIONS manifests)
+    for e in range(1, 24):
         st.ingest_batch(3, [(f"k{e}".encode(), (e,))], epoch=e * 10)
         st.commit_epoch(e * 10)
     runs = os.listdir(os.path.join(d, "runs"))
-    assert len([r for r in runs if r.startswith("t3_")]) < 11  # compacted
+    assert len([r for r in runs if r.startswith("t3_")]) < 23  # pruned
     st2 = SpillStateStore(d)
-    assert st2.table_len(3) == 11
-    for e in range(1, 12):
+    assert st2.table_len(3) == 23
+    for e in range(1, 24):
         assert st2.get(3, f"k{e}".encode()) == (e,)
 
 
