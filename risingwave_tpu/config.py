@@ -59,6 +59,54 @@ class StorageConfig:
 
 
 @dataclass
+class RobustnessConfig:
+    """Retry / timeout / supervision knobs for the multi-process runtime
+    (the reference's `[meta] max_heartbeat_interval_secs` +
+    `[streaming] actor retry` family, collapsed to what this runtime
+    needs). Read once per process from `RW_<FIELD>` environment
+    variables, so worker OS processes spawned by the coordinator inherit
+    the operator's settings without a config file of their own; tests
+    mutate the module-global `ROBUSTNESS` instance directly."""
+    # RemoteInput -> coordinator exchange connect: bounded exponential
+    # backoff (base doubles per attempt, capped at 1s per sleep)
+    connect_attempts: int = 5
+    connect_backoff_s: float = 0.05
+    connect_timeout_s: float = 10.0
+    # worker process spawn: ADDR-handshake deadline + retries
+    spawn_attempts: int = 3
+    spawn_timeout_s: float = 30.0
+    spawn_backoff_s: float = 0.05
+    # ExchangeServer.wait_drained default deadline (worker shutdown)
+    drain_deadline_s: float = 120.0
+    # FragmentSupervisor: in-place respawns per worker slot before
+    # escalating to RemoteWorkerDied (full job recovery)
+    respawn_attempts: int = 3
+    respawn_backoff_s: float = 0.05
+
+    @classmethod
+    def from_env(cls) -> "RobustnessConfig":
+        import os
+        cfg = cls()
+        for f in fields(cls):
+            var = "RW_" + f.name.upper()
+            raw = os.environ.get(var)
+            if raw is not None:
+                kind = type(getattr(cfg, f.name))
+                try:
+                    setattr(cfg, f.name, kind(raw))
+                except ValueError:
+                    raise ValueError(
+                        f"bad {var}={raw!r}: expected {kind.__name__}"
+                    ) from None
+        return cfg
+
+
+# process-global instance (env-seeded once; workers re-derive from the
+# env they inherit at spawn)
+ROBUSTNESS = RobustnessConfig.from_env()
+
+
+@dataclass
 class NodeConfig:
     """Per-process startup configuration (the `risingwave.toml` analog).
 
@@ -71,7 +119,10 @@ class NodeConfig:
 
     @classmethod
     def from_toml(cls, path: str) -> "NodeConfig":
-        import tomllib
+        try:
+            import tomllib             # stdlib since 3.11
+        except ModuleNotFoundError:
+            import tomli as tomllib    # same API on 3.10
         with open(path, "rb") as f:
             raw = tomllib.load(f)
         cfg = cls()
@@ -150,6 +201,11 @@ SESSION_VAR_DEFAULTS: Dict[str, Any] = {
     # only); 'process' = worker OS processes over the credit-flow exchange
     # (real CPU parallelism — the compute-node placement analog)
     "streaming_placement": "local",
+    # true + process placement: a FragmentSupervisor respawns a single
+    # dead worker in place (shadow re-seed / epoch replay) instead of
+    # tearing the whole job down; bounded attempts, then the classic
+    # RemoteWorkerDied full-recovery path (graceful degradation)
+    "streaming_supervision": False,
     # true: plan eligible inner joins as arrangement-sharing lookup/delta
     # joins (ops/lookup_join.py) instead of private-state hash joins —
     # the reference's streaming_enable_delta_join session variable

@@ -20,6 +20,10 @@ Commands:
     trace [--last N]          per-barrier span summary; flags OPEN
                               (stalled) epochs with the stuck job —
                               works on a LIVE or wedged data dir
+    failpoints [--spec S]     list declared fault-injection points and
+                              which the spec (default: $RW_FAILPOINTS)
+                              arms; --arm validates a spec and prints
+                              the export line to arm a process tree
 """
 from __future__ import annotations
 
@@ -178,6 +182,40 @@ def cmd_backup(args) -> int:
     return 0
 
 
+def cmd_failpoints(args) -> int:
+    """Discover/validate fault-injection points (`utils/failpoint.py`).
+    Points are declared at their hook sites, so importing the hook-site
+    modules populates the listing; arming is per-process via the
+    RW_FAILPOINTS environment variable (spawned workers inherit it)."""
+    from ..utils import failpoint as fp
+    # imported for their declare() side effects
+    import risingwave_tpu.runtime.exchange_net  # noqa: F401
+    import risingwave_tpu.runtime.remote_fragments  # noqa: F401
+    import risingwave_tpu.runtime.worker  # noqa: F401
+    import risingwave_tpu.state.hummock  # noqa: F401
+    spec = args.arm if args.arm is not None else args.spec
+    try:
+        points = {p.name: p for p in fp.parse_spec(spec or "")}
+    except ValueError as e:
+        raise SystemExit(f"bad failpoint spec: {e}")
+    unknown = sorted(set(points) - set(fp.KNOWN))
+    if args.arm is not None:
+        if unknown:
+            raise SystemExit(f"unknown failpoint(s): {', '.join(unknown)}")
+        print(f"export {fp.ENV_VAR}="
+              f"'{','.join(p.spec() for p in points.values())}'")
+        return 0
+    for name in sorted(fp.KNOWN):
+        p = points.get(name)
+        state = (f"ARMED prob={p.prob:g} seed={p.seed}"
+                 + (f" max_fires={p.max_fires}"
+                    if p.max_fires is not None else "")) if p else "off"
+        print(f"{name:28s} {state:40s} {fp.KNOWN[name]}")
+    for name in unknown:
+        print(f"{name:28s} ARMED (unknown point — never fires)")
+    return 0
+
+
 def cmd_history(args) -> int:
     """Retained manifest versions (time-travel window)."""
     store = _store(args.data_dir)
@@ -217,5 +255,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("history")
     sp.add_argument("--data-dir", required=True)
     sp.set_defaults(fn=cmd_history)
+    sp = sub.add_parser("failpoints")
+    sp.add_argument("--spec", default=os.environ.get("RW_FAILPOINTS", ""))
+    sp.add_argument("--arm", default=None,
+                    help="validate a spec and print the export line")
+    sp.set_defaults(fn=cmd_failpoints)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -95,6 +95,16 @@ class ThreadedChannel(Channel):
             self.closed = True
             self.cv.notify_all()
 
+    def send_batch(self, msgs: Sequence[Message]) -> None:
+        """Append a pre-assembled batch atomically, WITHOUT capacity
+        waits: the producer already holds the whole batch in memory, so
+        blocking it here gains nothing and can deadlock a producer the
+        consumer thread must later join (the supervised drain's
+        epoch-atomic release)."""
+        with self.cv:
+            self.buf.extend(msgs)
+            self.cv.notify_all()
+
     def recv(self) -> Optional[Message]:
         with self.cv:
             msg = self.buf.popleft() if self.buf else None
@@ -134,6 +144,11 @@ class DispatchExecutor:
             n - 1).astype(np.int32)
         self._rr = 0
         self._iter: Optional[Iterator[Message]] = None
+        # last barrier fanned out + an optional observer: the
+        # FragmentSupervisor logs dispatched barriers so a respawned
+        # worker can be fed every barrier its predecessor never delivered
+        self.last_barrier: Optional[Barrier] = None
+        self.on_barrier = None
 
     def _dispatch_chunk(self, chunk: StreamChunk) -> None:
         if self.kind == "broadcast":
@@ -154,7 +169,7 @@ class DispatchExecutor:
         if n == 0:
             return
         vnodes = compute_vnodes([chunk.columns[i] for i in self.key_indices],
-                                self.vnode_count)
+                                vnode_count=self.vnode_count)
         out_of_row = self.vnode_to_out[vnodes]
         ops = chunk.ops
         # U-pair fixing: when the two halves of an update pair land on
@@ -185,6 +200,9 @@ class DispatchExecutor:
             self._iter = self.input.execute()
         for msg in self._iter:
             if isinstance(msg, Barrier):
+                self.last_barrier = msg
+                if self.on_barrier is not None:
+                    self.on_barrier(msg)
                 for ch in self.outputs:
                     ch.send(msg)
                 return msg
@@ -271,6 +289,14 @@ class MergeExecutor(Executor):
     def execute(self) -> Iterator[Message]:
         n = len(self.inputs)
         pending_barrier: List[Optional[Barrier]] = [None] * n
+        # epoch of a pumped-but-not-yet-aligned barrier: while set, the
+        # pumps are NOT driven again, so at most ONE barrier is ever in
+        # flight beyond the last alignment. Without this, a self-ticking
+        # source injects a barrier per pump while async workers are
+        # still responding — unbounded queues on a loaded host, and the
+        # supervisor's single-barrier re-injection / two-epoch
+        # retransmit retention would miss skipped epochs (barrier skew).
+        awaiting: Optional[int] = None
         while True:
             progressed = False
             for i, ch in enumerate(self.inputs):
@@ -294,7 +320,10 @@ class MergeExecutor(Executor):
             if all(b is not None for b in pending_barrier):
                 b = pending_barrier[0]
                 assert all(x.epoch.curr == b.epoch.curr
-                           for x in pending_barrier[1:]), "barrier skew"
+                           for x in pending_barrier[1:]), \
+                    ("barrier skew",
+                     [x.epoch.curr for x in pending_barrier])
+                awaiting = None
                 yield b.with_trace(self.name)
                 if b.is_stop():
                     return
@@ -302,11 +331,31 @@ class MergeExecutor(Executor):
                 continue
             if not progressed:
                 self.health_check()
+                # An in-flight barrier (`awaiting` pumped, or some input
+                # delivered it already): EVERY input received it via the
+                # pump fan-out, so stragglers need no further input —
+                # wait for one instead of pumping (its send() notifies,
+                # so the wait cuts short on arrival). Plain in-process
+                # channels can't be waited on; for them pumping IS how
+                # stragglers progress, so fall through to the pumps.
+                if awaiting is not None \
+                        or any(b is not None for b in pending_barrier):
+                    straggler = next(
+                        (ch for i, ch in enumerate(self.inputs)
+                         if pending_barrier[i] is None
+                         and hasattr(ch, "wait") and not ch.closed
+                         and len(ch) == 0), None)
+                    if straggler is not None:
+                        straggler.wait(0.005)
+                        continue
                 # all unblocked channels empty: drive the upstream pumps
                 done = True
                 for p in self.pumps:
-                    if p.pump_until_barrier() is not None:
+                    b = p.pump_until_barrier()
+                    if b is not None:
                         done = False
+                        if awaiting is None or b.epoch.curr > awaiting:
+                            awaiting = b.epoch.curr
                 if not done:
                     continue
                 # pumps exhausted. Inputs backed by threads/processes may

@@ -19,6 +19,12 @@ from ..core.vnode import VNODE_COUNT
 
 SHARD_AXIS = "shard"
 
+# jax moved shard_map out of experimental at 0.5; support both
+try:
+    shard_map = jax.shard_map
+except AttributeError:                     # jax < 0.5
+    from jax.experimental.shard_map import shard_map
+
 
 def make_mesh(n_devices: Optional[int] = None,
               devices: Optional[Sequence] = None) -> Mesh:

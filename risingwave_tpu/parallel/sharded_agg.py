@@ -28,7 +28,9 @@ from ..device.agg_step import (DeviceAggSpec, DeviceAggState, _acc_cast,
                                _bucket, epoch_core_full)
 from ..device.minput import SortedMultiset, ms_make
 from ..device.sorted_state import EMPTY_KEY, SortedState, sanitize_keys
-from .mesh import SHARD_AXIS, shard_of_vnode
+from .mesh import (SHARD_AXIS, shard_map as _shard_map,
+                   shard_of_vnode)
+
 
 
 def _bucketize(dest: jax.Array, mask: jax.Array, n_shards: int,
@@ -138,7 +140,7 @@ def make_sharded_agg_step(spec: DeviceAggSpec, mesh: Mesh,
                                      "u1", "u2", "u_cnt")}
         out_specs = (main_spec, ms_spec, sharded,
                      tuple(sharded for _ in range(nms)), ch_spec)
-        fn = jax.shard_map(local_step, mesh=mesh,
+        fn = _shard_map(local_step, mesh=mesh,
                            in_specs=in_specs, out_specs=out_specs)
         return fn(state, minputs, keys, signs, mask, inputs)
 

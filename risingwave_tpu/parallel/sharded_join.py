@@ -28,8 +28,10 @@ from ..device.agg_step import _acc_cast, _bucket
 from ..device.join_step import (JoinSide, grow_side, join_core, make_side,
                                 sanitize_keys)
 from ..device.sorted_state import EMPTY_KEY
-from .mesh import SHARD_AXIS, shard_of_vnode
+from .mesh import (SHARD_AXIS, shard_map as _shard_map,
+                   shard_of_vnode)
 from .sharded_agg import _bucketize
+
 
 
 def make_sharded_join_step(n_a_vals: int, n_b_vals: int, mesh: Mesh,
@@ -103,7 +105,7 @@ def make_sharded_join_step(n_a_vals: int, n_b_vals: int, mesh: Mesh,
                      out_pairs(n_a_vals, n_b_vals),
                      out_pairs(n_a_vals, n_b_vals),
                      {"a": sharded, "b": sharded, "pairs": sharded})
-        fn = jax.shard_map(local_step, mesh=mesh,
+        fn = _shard_map(local_step, mesh=mesh,
                            in_specs=in_specs, out_specs=out_specs)
         return fn(a, b, a_in, b_in)
 

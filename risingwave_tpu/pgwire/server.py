@@ -446,8 +446,27 @@ class _Conn:
             # statement text); bound text is kept for it
             "sql": _substitute_params(sql, text_vals, oids),
             "rows": None, "desc": None, "pos": 0, "done": False,
+            # zero-row CommandComplete tag for re-Executing a completed
+            # portal (PG yields no further rows but tags by statement
+            # kind, not a blanket SELECT 0)
+            "tag0": self._zero_tag(stmts, sql),
         }
         return portal_name, portal
+
+    @staticmethod
+    def _zero_tag(stmts, sql: str) -> str:
+        from ..sql import ast as A
+        if stmts and len(stmts) == 1:
+            s = stmts[0]
+            if isinstance(s, A.Insert):
+                return "INSERT 0 0"
+            if isinstance(s, A.Update):
+                return "UPDATE 0"
+            if isinstance(s, A.Delete):
+                return "DELETE 0"
+        kw = (sql.split() or ["SELECT"])[0].upper()
+        return {"INSERT": "INSERT 0 0", "UPDATE": "UPDATE 0",
+                "DELETE": "DELETE 0"}.get(kw, "SELECT 0")
 
     def _execute_portal(self, portal: dict, max_rows: int) -> None:
         """Run (or resume) a portal; honors the Execute row limit with
@@ -569,8 +588,10 @@ class _Conn:
                         self._error("portal does not exist", "34000")
                         skip_until_sync = True
                     elif portal["done"]:
-                        # PG: a completed portal yields no further rows
-                        self._send(b"C", b"SELECT 0\0")
+                        # PG: a completed portal yields no further rows;
+                        # the tag matches the statement kind
+                        self._send(b"C", portal.get(
+                            "tag0", "SELECT 0").encode() + b"\0")
                     else:
                         self._execute_portal(portal, max_rows)
                 except Exception as e:  # noqa: BLE001

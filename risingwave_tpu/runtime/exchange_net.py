@@ -31,11 +31,13 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from collections import deque
 from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..config import ROBUSTNESS
 from ..core.chunk import Column, Op, StreamChunk, StreamChunkBuilder
 from ..core.dtypes import DataType, TypeKind
 from ..core.encoding import decode_value_datum, encode_row
@@ -44,8 +46,16 @@ from ..core.schema import Schema
 from ..ops.executor import Executor
 from ..ops.message import (Barrier, BarrierKind, Message, Mutation,
                            MutationKind, Watermark)
+from ..utils.failpoint import declare, failpoint
 
 DEFAULT_PERMITS = 256          # initial credit per connection (in chunks)
+
+declare("exchange.connect",
+        "refuse one exchange connect attempt (retry/backoff seam)")
+declare("exchange.send_frame",
+        "drop the connection on a frame send (mid-stream write fault)")
+declare("exchange.recv_frame",
+        "drop the connection on a frame receive (mid-stream read fault)")
 
 # stable wire ids for the string-valued enums
 _MUT = {None: 0, MutationKind.STOP: 1, MutationKind.PAUSE: 2,
@@ -73,6 +83,8 @@ def _decode_row(buf: bytes, dtypes: Sequence[DataType]) -> Tuple:
 
 
 def _send_frame(sock: socket.socket, tag: bytes, body: bytes = b"") -> None:
+    if failpoint("exchange.send_frame"):
+        raise ConnectionError("failpoint exchange.send_frame")
     sock.sendall(struct.pack(">I", len(body) + 1) + tag + body)
 
 
@@ -87,6 +99,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket) -> Tuple[bytes, bytes]:
+    if failpoint("exchange.recv_frame"):
+        raise ConnectionError("failpoint exchange.recv_frame")
     (ln,) = struct.unpack(">I", _recv_exact(sock, 4))
     body = _recv_exact(sock, ln)
     return body[:1], body[1:]
@@ -252,7 +266,8 @@ class NetChannel:
     the producer's pump instead of buffering the whole stream."""
 
     def __init__(self, dtypes: Sequence[DataType],
-                 capacity: int = 4 * DEFAULT_PERMITS):
+                 capacity: int = 4 * DEFAULT_PERMITS,
+                 retain_epochs: bool = False):
         self.dtypes = list(dtypes)
         self.capacity = capacity
         self.buf: Deque[Message] = deque()
@@ -260,13 +275,52 @@ class NetChannel:
         self.closed = False
         self.aborted = False                # writer died mid-stream
         self.done = threading.Event()       # writer finished (EOS or abort)
+        # epoch retransmit buffer (retain_epochs=True): every data/
+        # watermark message of the CURRENT epoch plus every completed
+        # epoch the consumer has NOT yet confirmed delivered (the drain
+        # trims on each result barrier) is retained, so a supervisor can
+        # replay exactly what a dead stateless worker had not yet turned
+        # into delivered output. Recording continues while aborted —
+        # messages dispatched between death and detection are precisely
+        # the ones a respawn must not lose. A dead worker's buffered
+        # result epochs can keep alignment advancing past its death, so
+        # the undelivered window may span several epochs.
+        self.retain_epochs = retain_epochs
+        self.retrans: List[Message] = []
+        self.retrans_done: List[Tuple[int, List[Message]]] = []
 
     def _data_len(self) -> int:
         return sum(1 for m in self.buf if isinstance(m, StreamChunk))
 
+    def _retain(self, msg: Message) -> None:
+        if isinstance(msg, Barrier):
+            self.retrans.append(msg)
+            self.retrans_done.append((msg.epoch.curr, self.retrans))
+            self.retrans = []
+        else:
+            self.retrans.append(msg)
+
+    def trim_retrans(self, delivered_epoch: int) -> None:
+        """Drop retained epochs the consumer delivered results for."""
+        with self.cv:
+            self.retrans_done = [e for e in self.retrans_done
+                                 if e[0] > delivered_epoch]
+
+    def replay_for(self, last_delivered_epoch: int) -> List[Message]:
+        """Messages a respawned worker must re-ingest, given the last
+        barrier epoch its predecessor DELIVERED results for."""
+        out: List[Message] = []
+        for epoch, msgs in self.retrans_done:
+            if epoch > last_delivered_epoch:
+                out += msgs
+        out += self.retrans
+        return out
+
     # Channel-compatible surface for DispatchExecutor
     def send(self, msg: Message) -> None:
         with self.cv:
+            if self.retain_epochs:
+                self._retain(msg)
             if self.aborted:
                 return                      # consumer gone: drop, don't block
             if isinstance(msg, StreamChunk):
@@ -314,10 +368,19 @@ class ExchangeServer:
         self._accept_thread.start()
 
     def register(self, channel_id: int, dtypes: Sequence[DataType],
-                 capacity: int = 4 * DEFAULT_PERMITS) -> NetChannel:
-        ch = NetChannel(dtypes, capacity)
+                 capacity: int = 4 * DEFAULT_PERMITS,
+                 retain_epochs: bool = False) -> NetChannel:
+        ch = NetChannel(dtypes, capacity, retain_epochs=retain_epochs)
         self.channels[channel_id] = ch
         return ch
+
+    def unregister(self, channel_id: int) -> None:
+        """Forget a dead worker's channel (its writer thread, if any, has
+        already aborted); the id stays claimed so a late reconnect to it
+        is refused rather than spliced into a fresh stream."""
+        ch = self.channels.pop(channel_id, None)
+        if ch is not None:
+            ch.close()
 
     def _accept_loop(self) -> None:
         while True:
@@ -426,13 +489,22 @@ class ExchangeServer:
                 pass
             ch.done.set()
 
-    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+    _CONFIG_DEADLINE = object()      # sentinel: use ROBUSTNESS default
+
+    def wait_drained(self, timeout=_CONFIG_DEADLINE) -> bool:
         """Block until every channel's writer finished; True only if every
         stream actually delivered EOS (an aborted connection is False, not
-        'drained' — the consumer did NOT get the full stream)."""
+        'drained' — the consumer did NOT get the full stream). The default
+        deadline comes from RW_DRAIN_DEADLINE_S (RobustnessConfig) and is
+        SHARED across channels, not per-channel; pass None to wait
+        forever."""
+        if timeout is ExchangeServer._CONFIG_DEADLINE:
+            timeout = ROBUSTNESS.drain_deadline_s
+        end = None if timeout is None else time.monotonic() + timeout
         ok = True
         for ch in self.channels.values():
-            ok = ch.done.wait(timeout) and not ch.aborted and ok
+            left = None if end is None else max(0.0, end - time.monotonic())
+            ok = ch.done.wait(left) and not ch.aborted and ok
         return ok
 
     def close(self) -> None:
@@ -459,8 +531,38 @@ class RemoteInput(Executor):
         self.addr = addr
         self.channel_id = channel_id
 
+    def _connect(self) -> socket.socket:
+        """Bounded exponential-backoff connect: worker startup can race
+        the peer's listener, and transient faults (or the
+        `exchange.connect` failpoint) must not kill a whole fragment when
+        no stream state exists yet — before the H handshake a retry is
+        always safe."""
+        attempts = max(1, ROBUSTNESS.connect_attempts)
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            if attempt:
+                from ..utils.metrics import REGISTRY
+                REGISTRY.counter("exchange_connect_retries_total",
+                                 "exchange connect attempts after the "
+                                 "first").inc()
+                time.sleep(min(1.0, ROBUSTNESS.connect_backoff_s
+                               * (2 ** (attempt - 1))))
+            try:
+                if failpoint("exchange.connect"):
+                    raise ConnectionRefusedError(
+                        "failpoint exchange.connect")
+                sock = socket.create_connection(
+                    self.addr, timeout=ROBUSTNESS.connect_timeout_s)
+                sock.settimeout(None)
+                return sock
+            except OSError as e:
+                last = e
+        raise ConnectionError(
+            f"exchange connect to {self.addr} failed after "
+            f"{attempts} attempts: {last}") from last
+
     def execute(self) -> Iterator[Message]:
-        sock = socket.create_connection(self.addr)
+        sock = self._connect()
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             _send_frame(sock, b"H", struct.pack(">H", self.channel_id))

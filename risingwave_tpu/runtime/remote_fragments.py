@@ -10,25 +10,49 @@ reference's plan → fragments → actors-on-compute-nodes placement
 `src/stream/src/task/stream_manager.rs:610`), collapsed to one
 coordinator because there is no separate meta role here.
 
-Failure detection: a worker that dies mid-stream aborts its result
-channel; the Merge loop surfaces `RemoteWorkerDied` at the next poll
-instead of hanging, and Database-level recovery (DDL replay + source
-rewind) rebuilds the job — the `GlobalBarrierWorker::recovery` analog
-(`src/meta/src/barrier/worker.rs:664`).
+Failure handling has two tiers:
+
+* unsupervised (default): a worker that dies mid-stream aborts its
+  result channel; the Merge loop surfaces `RemoteWorkerDied` at the next
+  poll instead of hanging, and Database-level recovery (DDL replay +
+  source rewind) rebuilds the job — the `GlobalBarrierWorker::recovery`
+  analog (`src/meta/src/barrier/worker.rs:664`).
+* supervised (`SET streaming_supervision TO true`): a
+  `FragmentSupervisor` respawns JUST the dead fragment in place —
+  stateless partial-agg workers get the retained input epoch(s) replayed
+  (their outputs are epoch-atomic, so nothing is lost or double-counted);
+  stateful owned-group agg workers are re-seeded from the coordinator
+  shadow table and re-emit a full refresh of their groups (the MV applies
+  by pk, so the refresh reconciles any change the dead worker never
+  delivered). Bounded attempts per slot, then the supervisor escalates to
+  the unsupervised `RemoteWorkerDied` path — graceful degradation, never
+  a hang. Two-input join fragments escalate immediately (open item).
 """
 from __future__ import annotations
 
 import json
+import select
 import subprocess
 import sys
 import threading
-from typing import Any, List, Sequence
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
-from ..core.schema import Schema
+from ..config import ROBUSTNESS
+from ..core.chunk import Op, StreamChunk
+from ..core.vnode import compute_vnodes
 from ..ops import DispatchExecutor, MergeExecutor
 from ..ops.exchange import ThreadedChannel
 from ..ops.executor import Executor
+from ..ops.message import Barrier
+from ..utils.failpoint import declare, failpoint
+from ..utils.metrics import REGISTRY
 from .exchange_net import ExchangeServer, RemoteInput
+
+declare("fragment.spawn",
+        "fail one worker spawn attempt (startup retry seam)")
+declare("fragment.drain",
+        "abort one coordinator-side result drain (connection flap)")
 
 
 class RemoteWorkerDied(RuntimeError):
@@ -67,104 +91,332 @@ def serializable_agg(input: "Executor", calls) -> bool:
 
 
 class _WorkerHandle:
+    __slots__ = ("proc", "addr", "last_epoch", "drain_thread")
+
     def __init__(self, proc: subprocess.Popen, addr):
         self.proc = proc
         self.addr = addr
+        self.last_epoch: Optional[int] = None  # last result barrier drained
+        self.drain_thread: Optional[threading.Thread] = None
+
+
+def _read_hello_line(proc: subprocess.Popen, deadline_s: float) -> bytes:
+    """Read one newline-terminated line from the worker's stdout under a
+    HARD deadline — select per chunk, never a blocking readline (a
+    worker that wedges after a partial write must not hang the
+    coordinator)."""
+    import os as _os
+    fd = proc.stdout.fileno()
+    end = time.monotonic() + deadline_s
+    buf = b""
+    while b"\n" not in buf:
+        left = end - time.monotonic()
+        if left <= 0:
+            return b""
+        ready, _, _ = select.select([fd], [], [], left)
+        if not ready:
+            return b""
+        part = _os.read(fd, 4096)
+        if not part:                    # EOF: worker died during startup
+            return b""
+        buf += part
+    return buf.split(b"\n", 1)[0]
 
 
 def _spawn_worker(plan: Dict) -> _WorkerHandle:
-    """Spawn one worker process and complete the ADDR handshake."""
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "risingwave_tpu.runtime.worker",
-         json.dumps(plan)],
-        stdout=subprocess.PIPE, text=True)
-    line = proc.stdout.readline().split()
-    if not line or line[0] != "ADDR":
-        proc.kill()
-        raise RemoteWorkerDied(
-            f"worker pid={proc.pid} died during startup "
-            f"(hello: {line!r})")
-    return _WorkerHandle(proc, (line[1], int(line[2])))
+    """Spawn one worker process and complete the ADDR handshake, with a
+    startup deadline and bounded retries (transient spawn failures — or
+    the `fragment.spawn` failpoint — are absorbed here)."""
+    attempts = max(1, ROBUSTNESS.spawn_attempts)
+    last: Any = None
+    for attempt in range(attempts):
+        if attempt:
+            REGISTRY.counter("worker_spawn_retries_total",
+                             "worker spawn attempts after the first").inc()
+            time.sleep(min(1.0, ROBUSTNESS.spawn_backoff_s
+                           * (2 ** (attempt - 1))))
+        if failpoint("fragment.spawn"):
+            last = "failpoint fragment.spawn"
+            continue
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "risingwave_tpu.runtime.worker",
+             json.dumps(plan)],
+            stdout=subprocess.PIPE)
+        line = _read_hello_line(proc, ROBUSTNESS.spawn_timeout_s).split()
+        if not line or line[0] != b"ADDR":
+            proc.kill()
+            proc.wait()
+            last = (f"worker pid={proc.pid} no ADDR hello within "
+                    f"{ROBUSTNESS.spawn_timeout_s}s (got: {line!r})")
+            continue
+        return _WorkerHandle(proc, (line[1].decode(), int(line[2])))
+    raise RemoteWorkerDied(
+        f"worker spawn failed after {attempts} attempts: {last}")
 
 
-class RemoteFragmentSet:
-    """k worker processes running one HashAgg fragment each, plus the
-    coordinator-side exchange plumbing. Produces (merge_executor, pumps)
-    for the planner."""
+class FragmentSupervisor:
+    """Self-healing single-worker recovery for a remote fragment set —
+    the in-place analog of the reference's per-actor restart inside
+    `GlobalBarrierWorker::recovery`, scoped to one fragment so one dead
+    worker does not restart the world.
 
-    def __init__(self, input: Executor, group_indices: Sequence[int],
-                 calls, k: int):
-        from ..expr.expression import InputRef
-        self.server = ExchangeServer()
-        in_dtypes = input.schema.dtypes
-        in_cols = [[f.name, f.dtype.kind.value]
-                   for f in input.schema.fields]
-        net_channels = [self.server.register(i, in_dtypes)
-                        for i in range(k)]
-        self.workers: List[_WorkerHandle] = []
-        plans = []
-        for i in range(k):
-            plans.append({
-                "coord": [self.server.addr[0], self.server.addr[1]],
-                "in_channel": i,
-                "in_schema": in_cols,
-                "append_only": True,
-                "fragment": {
-                    "kind": "partial_hash_agg",
-                    "group_indices": list(group_indices),
-                    "calls": _serialize_calls(calls),
-                },
-            })
-        for p in plans:
-            self.workers.append(_spawn_worker(p))
-        # result side: one drain thread per worker feeding a ThreadedChannel
-        # the barrier-aligned Merge can poll
-        self.dispatch = DispatchExecutor(input, net_channels, kind="hash",
-                                         key_indices=list(group_indices))
-        # output schema: probe from a local twin of the fragment
-        from ..runtime.worker import build_fragment
+    Detection: the worker's result channel aborted, or its process
+    exited non-zero before delivering EOS (both the merge idle loop and
+    the Database heartbeat sweep land here via `check_alive`).
 
-        class _Stub(Executor):
-            def __init__(self, schema):
-                super().__init__(schema)
+    Recovery per fragment kind:
+    * stateless `partial_hash_agg` — respawn seed-free and replay the
+      input channel's retained epoch(s). Worker output is epoch-atomic
+      (partials flush at the barrier; the drain releases results only on
+      their barrier), so at the moment of death NOTHING of an
+      in-flight epoch was delivered and replaying it is exactly-once.
+    * stateful `hash_agg` — respawn re-seeded from the coordinator
+      shadow table (outputs suppressed until the re-injected in-flight
+      barrier), then the worker emits a full refresh of its owned
+      groups; the MV materializes by pk, so the refresh reconciles any
+      change the dead worker never managed to deliver.
+    * two-input joins — escalate to full recovery (open item).
 
-        stub = _Stub(input.schema)
-        stub.append_only = True
-        out_schema = build_fragment(plans[0], stub).schema
-        self.out_schema = out_schema
-        self.group_indices = list(group_indices)
-        self.calls = list(calls)
+    Bounded attempts per worker slot with exponential backoff; past the
+    bound (or on any non-recoverable shape) it raises `RemoteWorkerDied`
+    and stays escalated, handing over to DDL-replay recovery."""
+
+    def __init__(self, rset: "_RemoteSetBase"):
+        self.rset = rset
+        self.attempts = [0] * len(rset.workers)
+        self.respawns = 0
+        self._escalated: Optional[RemoteWorkerDied] = None
+
+    def check(self) -> None:
+        if self._escalated is not None:
+            raise self._escalated
+        s = self.rset
+        for i in range(len(s.workers)):
+            ch, w = s.channels[i], s.workers[i]
+            rc = w.proc.poll()
+            if getattr(ch, "aborted", False) \
+                    or (rc is not None and rc != 0 and not ch.closed):
+                self._recover(i)
+
+    def _escalate(self, msg: str) -> None:
+        REGISTRY.counter("supervisor_escalations_total",
+                         "supervised fragments handed to full recovery"
+                         ).inc()
+        err = RemoteWorkerDied(
+            msg + " (escalating: restart the job — DDL replay rebuilds "
+            "and replays the fragments)")
+        self._escalated = err
+        raise err
+
+    def _recover(self, i: int) -> None:
+        s = self.rset
+        w = s.workers[i]
+        ch_out = s.channels[i]
+        if len(s.dispatchers) > 1:
+            self._escalate(
+                f"worker pid={w.proc.pid} of a two-input join fragment "
+                "died; in-place respawn covers single-input fragments")
+        disp = s.dispatchers[0]
+        lb = disp.last_barrier
+        if lb is not None and lb.is_stop():
+            self._escalate(
+                f"worker pid={w.proc.pid} died during job stop")
+        if self.attempts[i] >= max(1, ROBUSTNESS.respawn_attempts):
+            self._escalate(
+                f"worker slot {i} kept dying "
+                f"({self.attempts[i]} respawns exhausted)")
+        self.attempts[i] += 1
+        # quiesce the old worker: reap the process, wait out its drain
+        # thread (the dead socket errors it out promptly) so nothing can
+        # mutate the result channel after we reset it
+        if w.proc.poll() is None:
+            w.proc.kill()
+        try:
+            w.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._escalate(f"worker pid={w.proc.pid} is unkillable")
+        if w.drain_thread is not None:
+            w.drain_thread.join(timeout=10)
+            if w.drain_thread.is_alive():
+                self._escalate("old result drain did not stop")
+        time.sleep(min(1.0, ROBUSTNESS.respawn_backoff_s
+                       * (2 ** (self.attempts[i] - 1))))
+        # fresh input channel under a fresh id: the old id stays claimed
+        # on the server, so a half-dead predecessor can never splice
+        # itself into the successor's stream
+        old_plan = s.plans[i]
+        old_cid = old_plan["in_channel"]
+        old_in = s.in_channels[0][i]
+        new_cid = s.alloc_cid()
+        new_in = s.server.register(new_cid, s.in_dtypes[0],
+                                   retain_epochs=old_in.retain_epochs)
+        plan = dict(old_plan)
+        plan["in_channel"] = new_cid
+        seeding = s.kind == "stateful"
+        if seeding:
+            plan["suppress_first_epoch"] = True
+            plan["refresh_after_seed"] = True
+        try:
+            nw = _spawn_worker(plan)
+        except RemoteWorkerDied as e:
+            self._escalate(str(e))
+        nw.last_epoch = w.last_epoch
+        last = -1 if w.last_epoch is None else w.last_epoch
+        if seeding:
+            for chunk in s.seed_chunks(0, i):
+                new_in.send(chunk)
+            # every dispatched barrier the dead worker never delivered —
+            # possibly SEVERAL: a dead worker's buffered result epochs
+            # keep alignment advancing past its death, so the gap is a
+            # window, not one barrier. Re-injecting them (in order) lets
+            # alignment complete epoch by epoch; the first one also
+            # flips the worker's post-seed output suppression off.
+            for b in s.missed_barriers(last):
+                new_in.send(b)
+        else:
+            for msg in old_in.replay_for(last):
+                new_in.send(msg)
+        # swap into the live topology (we run on the merge thread, so the
+        # dispatcher is quiescent during the swap)
+        disp.outputs[i] = new_in
+        s.in_channels[0][i] = new_in
+        s.plans[i] = plan
+        s.server.unregister(old_cid)
+        # reset the result channel in place: whole delivered epochs in
+        # its buffer stay valid (the epoch-atomic drain never leaves a
+        # partial tail); the generation bump makes any straggling writes
+        # from the old drain harmless
+        with ch_out.cv:
+            ch_out.gen += 1
+            ch_out.aborted = False
+            ch_out.closed = False
+            ch_out.cv.notify_all()
+        s.workers[i] = nw
+        s._start_drain(i)
+        self.respawns += 1
+        REGISTRY.counter("supervisor_respawns_total",
+                         "in-place worker respawns", labels=("kind",)
+                         ).labels(s.kind).inc()
+
+
+class _RemoteSetBase:
+    """Shared coordinator plumbing for a set of worker fragments: the
+    exchange server, per-worker plans/handles, epoch-atomic result
+    drains, liveness checking, and (optional) supervision.
+
+    Subclass contract: set `kind`, `server`, `workers`, `plans`,
+    `dispatchers` (one per input side), `in_channels` (per side, per
+    worker), `in_dtypes` (per side), `out_schema`, then call
+    `_finish_init(supervise)`."""
+
+    kind = "partial"                   # "partial" | "stateful"
+    seed_tables: Optional[List[Any]] = None
+    seed_strips: Sequence[int] = ()
+
+    def _finish_init(self, supervise: bool) -> None:
+        self._next_cid = 1 + max(
+            (p.get("in_channel_r", p["in_channel"]) for p in self.plans),
+            default=-1)
+        self.supervisor = FragmentSupervisor(self) if supervise else None
+        # dispatched-barrier log (supervised single-input sets): the
+        # respawn protocol replays every barrier a dead worker never
+        # delivered; trimmed as the drains confirm delivery
+        self.barrier_log: List[Barrier] = []
+        if self.supervisor is not None and len(self.dispatchers) == 1:
+            self.dispatchers[0].on_barrier = self._log_barrier
         self._start_drains()
 
+    def alloc_cid(self) -> int:
+        cid = self._next_cid
+        self._next_cid += 1
+        return cid
+
+    def _log_barrier(self, b: Barrier) -> None:
+        """Dispatcher hook (merge/main thread): record the fan-out and
+        age out barriers every worker has delivered results for."""
+        self.barrier_log.append(b)
+        low = min((-1 if w.last_epoch is None else w.last_epoch)
+                  for w in self.workers)
+        self.barrier_log = [x for x in self.barrier_log
+                            if x.epoch.curr > low]
+
+    def missed_barriers(self, last_delivered_epoch: int) -> List[Barrier]:
+        return [b for b in self.barrier_log
+                if b.epoch.curr > last_delivered_epoch]
+
+    # ---- result side ----------------------------------------------------
     def _start_drains(self) -> None:
         self.channels: List[ThreadedChannel] = []
-        self._drains: List[threading.Thread] = []
-        for w in self.workers:
+        for i in range(len(self.workers)):
             ch = ThreadedChannel(capacity=256)
-            t = threading.Thread(target=self._drain, args=(w, ch),
-                                 daemon=True)
+            ch.gen = 0                  # respawn generation (supervisor)
             self.channels.append(ch)
-            self._drains.append(t)
-            t.start()
+            self._start_drain(i)
 
-    def _drain(self, w: _WorkerHandle, ch: ThreadedChannel) -> None:
+    def _start_drain(self, i: int) -> None:
+        w, ch = self.workers[i], self.channels[i]
+        t = threading.Thread(target=self._drain, args=(i, w, ch),
+                             daemon=True)
+        w.drain_thread = t
+        t.start()
+
+    def _drain(self, i: int, w: _WorkerHandle, ch: ThreadedChannel) -> None:
+        """Pull one worker's result stream into its merge channel.
+
+        SUPERVISED sets drain EPOCH-ATOMICALLY: messages buffer here
+        until their barrier arrives, then release together, so a
+        connection that dies mid-epoch contributes nothing of that epoch
+        downstream — the invariant that makes in-place replay/re-seed
+        exactly-once (a partial tail could be neither retracted nor
+        deduplicated). Unsupervised sets forward per message (full
+        intra-epoch pipelining + channel backpressure) — their recovery
+        is a whole-job rebuild, which needs no epoch atomicity."""
+        gen = ch.gen
+        atomic = self.supervisor is not None
+        buf: List[Any] = []
         try:
             inp = RemoteInput(w.addr, 0, self.out_schema)
             for msg in inp.execute():
-                ch.send(msg)
+                if failpoint("fragment.drain"):
+                    raise ConnectionError("failpoint fragment.drain")
+                if isinstance(msg, Barrier):
+                    if atomic:
+                        # one lock-held append, no capacity waits: a
+                        # flush blocked on a full channel could never be
+                        # joined by the consumer thread during recovery
+                        buf.append(msg)
+                        ch.send_batch(buf)
+                        buf = []
+                    else:
+                        ch.send(msg)
+                    w.last_epoch = msg.epoch.curr
+                    if atomic:
+                        # delivery confirmed: this worker's input epochs
+                        # up to here will never need replaying
+                        for side in self.in_channels:
+                            if side[i].retain_epochs:
+                                side[i].trim_retrans(msg.epoch.curr)
+                elif atomic:
+                    buf.append(msg)
+                else:
+                    ch.send(msg)
+            if buf:                     # clean EOS: deliver the tail
+                ch.send_batch(buf)
         except (ConnectionError, OSError):
-            ch.aborted = True          # surfaced by merge_executor polling
+            if ch.gen == gen:
+                ch.aborted = True       # surfaced by merge_executor polling
         finally:
-            ch.close()
+            if ch.gen == gen:
+                ch.close()
 
-    def merge_executor(self) -> MergeExecutor:
-        merge = MergeExecutor(self.channels, self.out_schema,
-                              pumps=[self.dispatch])
-        merge.health_check = self.check_alive
-        merge._remote = self           # keeps workers alive with the plan
-        return merge
-
+    # ---- liveness -------------------------------------------------------
     def check_alive(self) -> None:
+        """Polled by the merge idle loop and the Database heartbeat
+        sweep. Supervised sets self-heal (or escalate); unsupervised
+        sets raise so job-level recovery can run."""
+        if self.supervisor is not None:
+            self.supervisor.check()
+            return
         for ch, w in zip(self.channels, self.workers):
             if getattr(ch, "aborted", False):
                 raise RemoteWorkerDied(
@@ -172,6 +424,30 @@ class RemoteFragmentSet:
                     "(recovery: restart the job — DDL replay rebuilds and "
                     "replays the fragments)")
 
+    # ---- seeds (stateful sets) -----------------------------------------
+    def seed_chunks(self, side: int, i: int) -> Iterator[StreamChunk]:
+        """Worker i's partition of the coordinator shadow table, as
+        INSERT chunks — exactly the rows the hash dispatcher would have
+        routed to it (same vnode map, so respawn ownership matches)."""
+        table = self.seed_tables[side] if self.seed_tables else None
+        if table is None:
+            return
+        strip = self.seed_strips[side] if self.seed_strips else 0
+        rows = [tuple(r)[:-strip] if strip else tuple(r)
+                for r in table.iter_all()]
+        disp = self.dispatchers[side]
+        dtypes = self.in_dtypes[side]
+        for lo in range(0, len(rows), 4096):
+            chunk = StreamChunk.from_rows(
+                dtypes, [(Op.INSERT, r) for r in rows[lo:lo + 4096]])
+            vn = compute_vnodes(
+                [chunk.columns[j] for j in disp.key_indices],
+                vnode_count=disp.vnode_count)
+            vis = disp.vnode_to_out[vn] == i
+            if vis.any():
+                yield StreamChunk(chunk.ops, chunk.columns, vis)
+
+    # ---- lifecycle ------------------------------------------------------
     def shutdown(self) -> None:
         for w in self.workers:
             if w.proc.poll() is None:
@@ -184,6 +460,69 @@ class RemoteFragmentSet:
         except Exception:
             pass
 
+
+class RemoteFragmentSet(_RemoteSetBase):
+    """k worker processes running one stateless partial-HashAgg fragment
+    each, plus the coordinator-side exchange plumbing. Produces
+    (merge_executor, pumps) for the planner."""
+
+    kind = "partial"
+
+    def __init__(self, input: Executor, group_indices: Sequence[int],
+                 calls, k: int, supervise: bool = False):
+        self.server = ExchangeServer()
+        in_dtypes = input.schema.dtypes
+        in_cols = [[f.name, f.dtype.kind.value]
+                   for f in input.schema.fields]
+        # retain_epochs: the supervisor replays a respawned stateless
+        # worker's in-flight input epoch(s) from the channel itself
+        net_channels = [self.server.register(i, in_dtypes,
+                                             retain_epochs=supervise)
+                        for i in range(k)]
+        self.in_channels = [net_channels]
+        self.in_dtypes = [list(in_dtypes)]
+        self.workers: List[_WorkerHandle] = []
+        self.plans: List[Dict] = []
+        for i in range(k):
+            self.plans.append({
+                "coord": [self.server.addr[0], self.server.addr[1]],
+                "in_channel": i,
+                "in_schema": in_cols,
+                "append_only": True,
+                "fragment": {
+                    "kind": "partial_hash_agg",
+                    "group_indices": list(group_indices),
+                    "calls": _serialize_calls(calls),
+                },
+            })
+        for p in self.plans:
+            self.workers.append(_spawn_worker(p))
+        # result side: one drain thread per worker feeding a ThreadedChannel
+        # the barrier-aligned Merge can poll
+        self.dispatch = DispatchExecutor(input, net_channels, kind="hash",
+                                         key_indices=list(group_indices))
+        self.dispatchers = [self.dispatch]
+        # output schema: probe from a local twin of the fragment
+        from ..runtime.worker import build_fragment
+
+        class _Stub(Executor):
+            def __init__(self, schema):
+                super().__init__(schema)
+
+        stub = _Stub(input.schema)
+        stub.append_only = True
+        out_schema = build_fragment(self.plans[0], stub).schema
+        self.out_schema = out_schema
+        self.group_indices = list(group_indices)
+        self.calls = list(calls)
+        self._finish_init(supervise)
+
+    def merge_executor(self) -> MergeExecutor:
+        merge = MergeExecutor(self.channels, self.out_schema,
+                              pumps=[self.dispatch])
+        merge.health_check = self.check_alive
+        merge._remote = self           # keeps workers alive with the plan
+        return merge
 
     # 2-phase merge stage: the coordinator-side final aggregation over the
     # workers' partial rows (the reference's 2-phase agg rewrite — partial
@@ -204,7 +543,7 @@ class RemoteFragmentSet:
         return out
 
 
-class RemoteStatefulSet:
+class RemoteStatefulSet(_RemoteSetBase):
     """Generalized worker placement: hash-dispatch each input by its key
     columns so every worker OWNS a disjoint key space, run a FULL
     stateful fragment (retractable agg, hash join) in each worker, and
@@ -214,24 +553,33 @@ class RemoteStatefulSet:
     RemoteFragmentSet above remains the cheaper plan for append-only
     composable aggregates.
 
-    Recovery contract: worker state is process-local and ephemeral; a
-    death surfaces as RemoteWorkerDied and the job rebuilds from the DDL
-    log + committed source offsets, exactly like the 2-phase path."""
+    Recovery contract: worker state is process-local and ephemeral. A
+    death either respawns in place re-seeded from the coordinator shadow
+    (supervised single-input fragments) or surfaces as RemoteWorkerDied
+    and the job rebuilds from the DDL log + committed source offsets."""
+
+    kind = "stateful"
 
     def __init__(self, inputs, key_indices_list, fragment: Dict, k: int,
-                 suppress_first_epoch: bool = False):
+                 suppress_first_epoch: bool = False,
+                 supervise: bool = False, seed_tables=None,
+                 seed_strips: Sequence[int] = ()):
         self.server = ExchangeServer()
         n_in = len(inputs)
         assert n_in in (1, 2) and len(key_indices_list) == n_in
+        self.seed_tables = list(seed_tables) if seed_tables else None
+        self.seed_strips = list(seed_strips) or [0] * n_in
         # channel ids: input 0 -> 0..k-1, input 1 -> k..2k-1
         chans = [[self.server.register(i * k + j,
                                        inputs[i].schema.dtypes)
                   for j in range(k)] for i in range(n_in)]
+        self.in_channels = chans
+        self.in_dtypes = [list(e.schema.dtypes) for e in inputs]
         self.dispatchers = [
             DispatchExecutor(inputs[i], chans[i], kind="hash",
                              key_indices=list(key_indices_list[i]))
             for i in range(n_in)]
-        plans = []
+        self.plans = []
         for j in range(k):
             p = {
                 "coord": [self.server.addr[0], self.server.addr[1]],
@@ -248,9 +596,9 @@ class RemoteStatefulSet:
                 p["in_schema_r"] = [[f.name, f.dtype.kind.value]
                                     for f in inputs[1].schema.fields]
                 p["append_only_r"] = inputs[1].append_only
-            plans.append(p)
+            self.plans.append(p)
         self.workers: List[_WorkerHandle] = []
-        for p in plans:
+        for p in self.plans:
             self.workers.append(_spawn_worker(p))
         # output schema via a local stub twin
         from .worker import build_fragment
@@ -262,14 +610,8 @@ class RemoteStatefulSet:
 
         stubs = [_Stub(e.schema, e.append_only) for e in inputs]
         self.out_schema = build_fragment(
-            plans[0], stubs[0], stubs[1] if n_in == 2 else None).schema
-        self._start_drains()
-
-    _drain = RemoteFragmentSet._drain
-    _start_drains = RemoteFragmentSet._start_drains
-    check_alive = RemoteFragmentSet.check_alive
-    shutdown = RemoteFragmentSet.shutdown
-    __del__ = RemoteFragmentSet.__del__
+            self.plans[0], stubs[0], stubs[1] if n_in == 2 else None).schema
+        self._finish_init(supervise)
 
     def merge_executor(self) -> MergeExecutor:
         merge = MergeExecutor(self.channels, self.out_schema,
@@ -329,12 +671,14 @@ class _SeedPrepend(Executor):
 
 
 def make_remote_join(lexec: Executor, rexec: Executor, lkeys, rkeys,
-                     join_type, k: int, left_state, right_state
-                     ) -> "RemoteStatefulSet":
+                     join_type, k: int, left_state, right_state,
+                     supervise: bool = False) -> "RemoteStatefulSet":
     """Hash join across k worker processes: both inputs hash-dispatch on
     the join key, each worker owns its key space and runs the FULL
     stateful HashJoinExecutor; the coordinator shadows both sides and
-    seeds fresh workers on recovery."""
+    seeds fresh workers on recovery. (In-place supervision escalates for
+    two-input fragments — the supervisor can't yet reconcile join output
+    emitted per-chunk; `FragmentSupervisor` docstring.)"""
     # shadow tables reuse the join-state layout (row + degree column);
     # the tee pads the degree, seeds strip it
     lseed = [tuple(r)[:-1] for r in left_state.iter_all()] \
@@ -351,7 +695,10 @@ def make_remote_join(lexec: Executor, rexec: Executor, lkeys, rkeys,
     fragment = {"kind": "hash_join", "left_keys": list(lkeys),
                 "right_keys": list(rkeys), "join_type": join_type.value}
     return RemoteStatefulSet([lin, rin], [list(lkeys), list(rkeys)],
-                             fragment, k, suppress_first_epoch=seeding)
+                             fragment, k, suppress_first_epoch=seeding,
+                             supervise=supervise,
+                             seed_tables=[left_state, right_state],
+                             seed_strips=[1, 1])
 
 
 def remotable_calls(calls) -> bool:
@@ -364,7 +711,8 @@ def remotable_calls(calls) -> bool:
 
 
 def make_remote_agg(input: Executor, group_indices, calls, k: int,
-                    shadow_table) -> "RemoteStatefulSet":
+                    shadow_table, supervise: bool = False
+                    ) -> "RemoteStatefulSet":
     """Retractable aggregation across k worker processes: the input
     (which must carry a unique row identity — the planner appends the
     upstream stream key) hash-dispatches on the group key; each worker
@@ -384,4 +732,6 @@ def make_remote_agg(input: Executor, group_indices, calls, k: int,
                 "group_indices": list(group_indices),
                 "calls": _serialize_calls(calls)}
     return RemoteStatefulSet([src], [list(group_indices)], fragment, k,
-                             suppress_first_epoch=seeding)
+                             suppress_first_epoch=seeding,
+                             supervise=supervise,
+                             seed_tables=[shadow_table])

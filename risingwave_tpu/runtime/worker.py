@@ -24,15 +24,20 @@ the reference runs actors on distributed compute nodes, not one.
 from __future__ import annotations
 
 import json
+import os
 import sys
-from typing import Any, Dict, List
+from typing import Any, Dict, Iterator, List
 
 from ..core.schema import Field, Schema
 from ..expr.agg import AggCall
 from ..expr.expression import InputRef
 from ..ops import HashAggExecutor
 from ..state import MemoryStateStore, StateTable
+from ..utils.failpoint import declare, failpoint
 from .exchange_net import ExchangeServer, RemoteInput
+
+declare("worker.crash",
+        "hard-kill the worker process mid-stream (os._exit per message)")
 
 
 def _schema(cols: List[List[str]]) -> Schema:
@@ -76,6 +81,23 @@ def build_fragment(plan: Dict[str, Any], upstream, upstream2=None) -> Any:
                            state_table=st)
 
 
+def _refresh_chunks(execu) -> Iterator[Any]:
+    """Full current output of an owned-group agg fragment, as INSERT
+    chunks — the post-respawn reconciliation stream. The coordinator's
+    MV applies changes by pk, so re-inserting every owned group's row
+    heals whatever the dead predecessor emitted-but-never-delivered."""
+    from ..core.chunk import Op, StreamChunk
+    groups = getattr(execu, "groups", None)
+    if groups is None:
+        return
+    rows = [tuple(k) + tuple(g.output())
+            for k, g in groups.items() if g.row_count > 0]
+    for lo in range(0, len(rows), 4096):
+        yield StreamChunk.from_rows(
+            execu.schema.dtypes,
+            [(Op.INSERT, r) for r in rows[lo:lo + 4096]])
+
+
 def main(argv: List[str]) -> int:
     plan = json.loads(argv[0])
     host, port = plan["coord"]
@@ -97,20 +119,32 @@ def main(argv: List[str]) -> int:
     # their OUTPUTS are already in the downstream MV's recovered
     # snapshot, so everything before the first barrier is swallowed.
     suppress = plan.get("suppress_first_epoch", False)
+    # Supervised respawn additionally asks for a one-shot full refresh
+    # of the rebuilt state right after the first barrier (see
+    # _refresh_chunks) — the seed swallow above hides any changes the
+    # dead predecessor never delivered, and the refresh re-states them.
+    refresh = plan.get("refresh_after_seed", False)
+    from ..ops.message import Barrier as _B
     try:
         for msg in execu.execute():
+            if failpoint("worker.crash"):
+                os._exit(3)             # hard death, like SIGKILL
             if suppress:
-                from ..ops.message import Barrier as _B
-                if isinstance(msg, _B):
-                    suppress = False
-                else:
+                if not isinstance(msg, _B):
                     continue
+                suppress = False
+                out.send(msg)
+                if refresh:
+                    for chunk in _refresh_chunks(execu):
+                        out.send(chunk)
+                    refresh = False
+                continue
             out.send(msg)
     except (ConnectionError, OSError):
         return 2          # coordinator gone: exit quietly, nothing to save
     finally:
         out.close()
-    ok = server.wait_drained(timeout=120)
+    ok = server.wait_drained()          # RW_DRAIN_DEADLINE_S-configurable
     server.close()
     return 0 if ok else 1
 

@@ -276,6 +276,10 @@ class Database:
                     pl = self.session_vars.get("streaming_placement")
                     if pl and pl != "local":
                         self._log_ddl(f"SET streaming_placement TO {pl}")
+                    sv = bool(self.session_vars.get(
+                        "streaming_supervision"))
+                    self._log_ddl("SET streaming_supervision TO "
+                                  + ("true" if sv else "false"))
                     dj = bool(self.session_vars.get(
                         "streaming_enable_delta_join"))
                     self._log_ddl("SET streaming_enable_delta_join TO "
@@ -557,6 +561,10 @@ class Database:
         # threads cannot provide it (GIL)
         planner.placement = self.session_vars.get("streaming_placement",
                                                   "local")
+        # supervised placement: a FragmentSupervisor respawns single dead
+        # workers in place instead of tearing the job down
+        planner.supervise = bool(self.session_vars.get(
+            "streaming_supervision"))
         planner.delta_join = bool(self.session_vars.get(
             "streaming_enable_delta_join"))
         self._pending_subs = []
@@ -1041,6 +1049,12 @@ class Database:
             for e in _walk_executors(shared.upstream):
                 r = getattr(e, "_remote", None)
                 if r is None:
+                    continue
+                if getattr(r, "supervisor", None) is not None:
+                    # supervised sets self-heal (or escalate) in place —
+                    # the sweep is just an extra detection path for
+                    # deaths while the job is quiescent
+                    r.check_alive()
                     continue
                 for w in r.workers:
                     if w.proc.poll() is not None:

@@ -362,7 +362,8 @@ class Planner:
                     for c in calls]
                 rfs = RemoteFragmentSet(
                     prune, [remap[i] for i in group_indices], pruned_calls,
-                    self.parallelism)
+                    self.parallelism,
+                    supervise=getattr(self, "supervise", False))
                 merge = rfs.merge_executor()
                 ng = len(group_indices)
                 st = self.make_state(gdtypes + [T.BYTEA], list(range(ng)))
@@ -380,7 +381,9 @@ class Planner:
                 dts = input.schema.dtypes
                 shadow = self.make_state(dts, list(carry_cols))
                 rfs = make_remote_agg(input, group_indices, calls,
-                                      self.parallelism, shadow)
+                                      self.parallelism, shadow,
+                                      supervise=getattr(self, "supervise",
+                                                        False))
                 return rfs.merge_executor()
         if self.parallelism > 1 and group_indices and not eowc:
             # Dispatch -> k parallel agg fragments -> Merge: the reference's
@@ -608,7 +611,9 @@ class Planner:
             rfs = make_remote_join(lexec, rexec, lkeys, rkeys,
                                    _JOIN_KIND[ref.kind],
                                    self.parallelism,
-                                   left_state, right_state)
+                                   left_state, right_state,
+                                   supervise=getattr(self, "supervise",
+                                                     False))
             return rfs.merge_executor(), ns
         else:
             execu = HashJoinExecutor(
@@ -1358,7 +1363,27 @@ class Planner:
                                             frame_mode=mode))
             else:
                 # rank family / lag / lead ignore the frame clause (PG)
-                calls.append(WindowFuncCall(f.name, arg))
+                offset = 1
+                if f.name in ("lag", "lead") and len(f.args) > 2:
+                    raise ValueError(
+                        f"{f.name} default-value argument (3-arg form) "
+                        "is not supported")
+                if f.name in ("lag", "lead") and len(f.args) > 1:
+                    # the offset argument must be a plan-time constant
+                    # (PG allows expressions; this runtime's incremental
+                    # affected-range computation needs a fixed offset)
+                    try:
+                        off = eval_const(f.args[1], T.INT64)
+                    except Exception:
+                        raise ValueError(
+                            f"{f.name} offset must be a constant "
+                            "integer") from None
+                    if off is None or int(off) < 0:
+                        raise ValueError(
+                            f"{f.name} offset must be a non-negative "
+                            f"constant, got {off!r}")
+                    offset = int(off)
+                calls.append(WindowFuncCall(f.name, arg, offset=offset))
         st = self.make_state([c.dtype for c in ns.cols],
                              list(range(len(ns.cols))))
         execu = OverWindowExecutor(execu, partition, order, calls,

@@ -41,7 +41,13 @@ import zlib
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
+from ..utils.failpoint import FailpointError, declare, failpoint
 from .store import StateStore
+
+declare("state.spill_write",
+        "crash before a spill run file becomes durable (finish())")
+declare("state.manifest_commit",
+        "crash between writing the tmp manifest and the atomic rename")
 
 MANIFEST = "MANIFEST.json"
 MANIFEST_HISTORY = "MANIFEST.history.json"
@@ -93,12 +99,13 @@ class Xor8:
     runs that cannot contain the key — without it every negative lookup
     pays a block read per run."""
 
-    __slots__ = ("seed", "seg", "fp")
+    __slots__ = ("seed", "seg", "fp", "ver")
 
-    def __init__(self, seed: int, seg: int, fp: bytes):
+    def __init__(self, seed: int, seg: int, fp: bytes, ver: int = 1):
         self.seed = seed
         self.seg = seg
         self.fp = fp
+        self.ver = ver
 
     @staticmethod
     def _h(key: bytes, seed: int) -> int:
@@ -108,12 +115,35 @@ class Xor8:
                             salt=seed.to_bytes(8, "little")).digest(),
             "little")
 
-    @staticmethod
-    def _positions(h: int, seg: int):
+    _M64 = 0xFFFFFFFFFFFFFFFF
+
+    @classmethod
+    def _remix(cls, x: int) -> int:
+        """splitmix64 finalizer: full-avalanche 64-bit mix."""
+        m = cls._M64
+        x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & m
+        x = (x ^ (x >> 27)) * 0x94D049BB133111EB & m
+        return x ^ (x >> 31)
+
+    @classmethod
+    def _positions(cls, h: int, seg: int, ver: int = 1):
         fp = (h ^ (h >> 32)) & 0xFF
-        p0 = (h & 0xFFFFF) % seg
-        p1 = seg + ((h >> 20) & 0xFFFFF) % seg
-        p2 = 2 * seg + ((h >> 40) & 0xFFFFF) % seg
+        if ver == 0:
+            # legacy layout: 20-bit hash slices. Slots >= 2**20 are
+            # unreachable, so construction reliably fails once
+            # seg > 2**20 (~2.5M keys). Kept only to read old run files.
+            p0 = (h & 0xFFFFF) % seg
+            p1 = seg + ((h >> 20) & 0xFFFFF) % seg
+            p2 = 2 * seg + ((h >> 40) & 0xFFFFF) % seg
+            return fp, p0, p1, p2
+        # full-width layout: three INDEPENDENTLY remixed 64-bit values
+        # (peeling runs at the sharp m = 1.23n threshold, so the three
+        # positions must be independent — bit rotations of one hash
+        # correlate and reliably fail to peel; the legacy disjoint
+        # slices were independent but couldn't address large segments)
+        p0 = cls._remix(h ^ 0x9E3779B97F4A7C15) % seg
+        p1 = seg + cls._remix(h ^ 0xC2B2AE3D27D4EB4F) % seg
+        p2 = 2 * seg + cls._remix(h ^ 0x165667B19E3779F9) % seg
         return fp, p0, p1, p2
 
     @classmethod
@@ -156,7 +186,7 @@ class Xor8:
 
     def may_contain(self, key: bytes) -> bool:
         h = self._h(key, self.seed)
-        f, p0, p1, p2 = self._positions(h, self.seg)
+        f, p0, p1, p2 = self._positions(h, self.seg, self.ver)
         return (self.fp[p0] ^ self.fp[p1] ^ self.fp[p2]) == f
 
 
@@ -189,9 +219,13 @@ class _RunWriter:
         self._buf = []
 
     def finish(self) -> None:
+        if failpoint("state.spill_write"):
+            self.abort()
+            raise FailpointError("state.spill_write: crashed before the "
+                                 "run file became durable")
         self._flush_block()
         xf = Xor8.build(self._keys)
-        filt = (xf.seed, xf.seg, xf.fp) if xf is not None else None
+        filt = (xf.seed, xf.seg, xf.fp, xf.ver) if xf is not None else None
         idx_blob = pickle.dumps((self._index, self.count, filt),
                                 protocol=4)
         self._f.write(idx_blob)
@@ -227,7 +261,10 @@ class RunReader:
         footer = pickle.loads(self._f.read(end - idx_off))
         if len(footer) == 3:             # filter-bearing format
             self.index, self.count, filt = footer
-            self.filter = Xor8(*filt) if filt is not None else None
+            # 3-tuple filters predate the full-width position layout
+            # (ver 0); 4-tuples carry their version explicitly
+            self.filter = None if filt is None else \
+                Xor8(*filt) if len(filt) == 4 else Xor8(*filt, ver=0)
         else:                            # pre-filter files stay readable
             self.index, self.count = footer
             self.filter = None
@@ -412,26 +449,14 @@ class SpillStateStore(StateStore):
                      reverse=True)
         return [self._deltas[(e, table_id)] for e in eps]
 
-    def _reader(self, name: str) -> RunReader:
-        """Open (or touch) one run reader, LRU-capping open fds."""
-        r = self._readers.pop(name, None)
-        if r is None:
-            r = RunReader(name, self._run_path(name), self.cache)
-        self._readers[name] = r
-        while len(self._readers) > MAX_OPEN_READERS:
-            old = next(iter(self._readers))
-            if old == name:
-                break
-            self._readers.pop(old).close()
-        return r
-
-    def _run_readers(self, table_id: int) -> List[RunReader]:
-        """This table's runs, newest first. Open handles are LRU-capped:
-        each reader keeps one fd for its lifetime, and a long-lived process
-        with many live runs would otherwise creep toward the ulimit."""
+    def _open_readers(self, names: Sequence[str]) -> List[RunReader]:
+        """Open readers for `names` (given oldest-first, returned newest
+        first), LRU-capping open fds while sparing THIS call's whole
+        live set — evicting (closing) a reader a still-running k-way
+        merge holds would yank its fd mid-iteration."""
         out = []
         live = set()
-        for name in reversed(self._manifest["tables"].get(str(table_id), [])):
+        for name in reversed(names):
             r = self._readers.pop(name, None)   # re-insert = mark recent
             if r is None:
                 r = RunReader(name, self._run_path(name), self.cache)
@@ -444,6 +469,13 @@ class SpillStateStore(StateStore):
                 break
             self._readers.pop(old).close()
         return out
+
+    def _run_readers(self, table_id: int) -> List[RunReader]:
+        """This table's runs, newest first. Open handles are LRU-capped:
+        each reader keeps one fd for its lifetime, and a long-lived process
+        with many live runs would otherwise creep toward the ulimit."""
+        return self._open_readers(
+            self._manifest["tables"].get(str(table_id), []))
 
     def close(self) -> None:
         """Release all cached run fds (safe to keep using the store —
@@ -501,6 +533,10 @@ class SpillStateStore(StateStore):
             json.dump(self._manifest, f)
             f.flush()
             os.fsync(f.fileno())
+        if failpoint("state.manifest_commit"):
+            raise FailpointError(
+                "state.manifest_commit: crashed between the tmp manifest "
+                "and the atomic rename (previous version must stay live)")
         os.replace(tmp, os.path.join(self.dir, MANIFEST))
         # retained version history (time travel, `src/meta/src/hummock/
         # manager/time_travel.rs` analog): the last HISTORY_VERSIONS
@@ -591,8 +627,11 @@ class SpillStateStore(StateStore):
             raise ValueError(
                 f"no retained version at or before epoch {epoch} "
                 f"(retention: last {HISTORY_VERSIONS} manifests)")
-        names = m["tables"].get(str(table_id), [])
-        readers = [self._reader(n) for n in reversed(names)]
+        # the version's FULL reader set opens with live-set protection
+        # (_open_readers): the per-name _reader() helper would let the
+        # LRU cap evict (close) an earlier reader of THIS call while
+        # the k-way merge still iterates it
+        readers = self._open_readers(m["tables"].get(str(table_id), []))
         for k, v in _merge([r.iter_range(None, None) for r in readers]):
             if v is not None:
                 yield k, v

@@ -1,0 +1,160 @@
+"""Deterministic failpoint registry — seeded fault injection.
+
+The `fail::fail_point!` analog (the reference gates recovery tests on
+failpoints like `collect_commit_epoch` and the madsim simulation tier
+kills nodes deterministically, `src/tests/simulation/`): named hooks
+compiled into the runtime's failure seams that normally cost one dict
+lookup, and under test/chaos configuration fire deterministically from a
+per-point seeded RNG.
+
+Arming:
+
+* environment (propagates to spawned worker processes automatically):
+      RW_FAILPOINTS="exchange.recv_frame:0.01:42,worker.crash:1:0:1"
+  each entry is  name:prob[:seed[:max_fires]]  —
+      prob       firing probability per hit in [0, 1] (bare `name`
+                 means 1, i.e. always);
+      seed       RNG seed (default 0). Same seed => the point fires on
+                 exactly the same hit sequence, run after run;
+      max_fires  cap on total fires per process (default unlimited).
+* programmatically: `arm("name", prob, seed, max_fires)` / `disarm` /
+  `reset()` — used by tests to target one process without touching the
+  environment of spawned workers.
+
+Call sites do `if failpoint("name"): <inject>` — the injected failure
+(raise, drop, `os._exit`) stays at the seam so each site fails the way
+real faults there fail. With nothing armed the hook is a dict lookup
+returning False; arming is strictly opt-in, so production behavior is
+byte-identical unless RW_FAILPOINTS is set.
+
+`declare(name, help)` at the call site's module registers the point for
+`risectl failpoints` discovery.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, List, Optional
+
+ENV_VAR = "RW_FAILPOINTS"
+
+# every declared hook site: name -> one-line description (risectl lists)
+KNOWN: Dict[str, str] = {}
+
+
+class FailpointError(RuntimeError):
+    """Raised by state-layer failpoints to simulate a crash mid-routine
+    (socket-layer points raise ConnectionError instead, so existing
+    failure handling exercises its real paths)."""
+
+
+def declare(name: str, help_: str) -> None:
+    KNOWN[name] = help_
+
+
+class Point:
+    """One armed failpoint: seeded RNG, fire count, optional cap."""
+
+    __slots__ = ("name", "prob", "seed", "max_fires", "fires", "hits",
+                 "_rng", "_lock")
+
+    def __init__(self, name: str, prob: float = 1.0, seed: int = 0,
+                 max_fires: Optional[int] = None):
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"failpoint {name!r}: prob {prob} not in [0,1]")
+        if max_fires is not None and max_fires < 0:
+            raise ValueError(f"failpoint {name!r}: negative max_fires")
+        self.name = name
+        self.prob = prob
+        self.seed = seed
+        self.max_fires = max_fires
+        self.fires = 0
+        self.hits = 0
+        # per-point independent RNG: each point's firing sequence depends
+        # only on (seed, its own hit ordinal), never on other points
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def draw(self) -> bool:
+        with self._lock:
+            self.hits += 1
+            if self.max_fires is not None and self.fires >= self.max_fires:
+                return False
+            fire = True if self.prob >= 1.0 else self._rng.random() < self.prob
+            if fire:
+                self.fires += 1
+        if fire:
+            from .metrics import REGISTRY
+            REGISTRY.counter("failpoint_fires_total",
+                             "injected faults fired, by point",
+                             labels=("point",)).labels(self.name).inc()
+        return fire
+
+    def spec(self) -> str:
+        s = f"{self.name}:{self.prob:g}:{self.seed}"
+        if self.max_fires is not None:
+            s += f":{self.max_fires}"
+        return s
+
+
+_ARMED: Dict[str, Point] = {}
+
+
+def failpoint(name: str) -> bool:
+    """True when the (armed) point fires. Disarmed: one dict lookup."""
+    p = _ARMED.get(name)
+    if p is None:
+        return False
+    return p.draw()
+
+
+def arm(name: str, prob: float = 1.0, seed: int = 0,
+        max_fires: Optional[int] = None) -> Point:
+    p = Point(name, prob, seed, max_fires)
+    _ARMED[name] = p
+    return p
+
+
+def disarm(name: str) -> None:
+    _ARMED.pop(name, None)
+
+
+def reset() -> None:
+    """Disarm everything (including env-derived points)."""
+    _ARMED.clear()
+
+
+def armed() -> List[Point]:
+    return list(_ARMED.values())
+
+
+def parse_spec(spec: str) -> List[Point]:
+    """Parse a RW_FAILPOINTS value into (unarmed) Point objects."""
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) > 4:
+            raise ValueError(f"bad failpoint spec {entry!r} "
+                             "(name:prob[:seed[:max_fires]])")
+        try:
+            prob = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
+            seed = int(parts[2]) if len(parts) > 2 and parts[2] else 0
+            mx = int(parts[3]) if len(parts) > 3 and parts[3] else None
+        except ValueError as e:
+            raise ValueError(f"bad failpoint spec {entry!r}: {e}") from None
+        out.append(Point(parts[0], prob, seed, mx))
+    return out
+
+
+def load_env() -> None:
+    """(Re-)arm from RW_FAILPOINTS; spawned workers inherit the env and
+    run this at import, so one setting covers the whole process tree."""
+    for p in parse_spec(os.environ.get(ENV_VAR, "")):
+        _ARMED[p.name] = p
+
+
+load_env()

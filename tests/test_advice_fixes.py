@@ -1,5 +1,6 @@
 """Regression tests for round-1 advisor findings (ADVICE.md)."""
 import numpy as np
+import pytest
 
 from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
 from risingwave_tpu.core.chunk import Column
@@ -278,3 +279,141 @@ def test_pgwire_rejects_embedded_udf_by_default():
         assert not any(t == b"E" for t, _ in msgs)
     finally:
         server3.stop()
+
+
+# ---------------------------------------------------------------------------
+# round-5 advisor findings (ADVICE.md) — satellites of the failpoint PR
+# ---------------------------------------------------------------------------
+
+
+def test_lag_lead_honor_constant_offset():
+    """planner.py used to drop f.args[1] silently, so lead(v,2) computed
+    lead(v,1)."""
+    from risingwave_tpu.sql import Database
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT, ts BIGINT, v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW m AS SELECT ts,"
+           " lead(v, 2) OVER (PARTITION BY k ORDER BY ts) AS ld,"
+           " lag(v, 3) OVER (PARTITION BY k ORDER BY ts) AS lg FROM t")
+    db.run("INSERT INTO t VALUES (1, 1, 10), (1, 2, 20), (1, 3, 30),"
+           " (1, 4, 40), (1, 5, 50)")
+    for _ in range(3):
+        db.tick()
+    assert sorted(db.query("SELECT * FROM m")) == [
+        (1, 30, None), (2, 40, None), (3, 50, None),
+        (4, None, 10), (5, None, 20)]
+    # 1-arg form stays offset 1
+    db.run("CREATE MATERIALIZED VIEW m1 AS SELECT ts,"
+           " lag(v) OVER (PARTITION BY k ORDER BY ts) AS lg FROM t")
+    for _ in range(3):
+        db.tick()
+    assert sorted(db.query("SELECT * FROM m1")) == [
+        (1, None), (2, 10), (3, 20), (4, 30), (5, 40)]
+
+
+def test_lag_lead_reject_unsupported_offsets():
+    import pytest
+    from risingwave_tpu.sql import Database
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT, ts BIGINT, v BIGINT)")
+    with pytest.raises(ValueError, match="constant"):
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT"
+               " lag(v, v) OVER (PARTITION BY k ORDER BY ts) FROM t")
+    with pytest.raises(ValueError, match="3-arg"):
+        db.run("CREATE MATERIALIZED VIEW m AS SELECT"
+               " lag(v, 1, 0) OVER (PARTITION BY k ORDER BY ts) FROM t")
+
+
+def test_xor8_positions_cover_large_segments():
+    """hummock.py:114 masked hashes to 20 bits, so filter slots >= 2**20
+    were unreachable and large-run construction reliably failed."""
+    from risingwave_tpu.state.hummock import Xor8
+    seg = 1 << 21
+    seen_hi = 0
+    for i in range(4096):
+        h = Xor8._h(b"key-%d" % i, 0)
+        _, p0, p1, p2 = Xor8._positions(h, seg)
+        assert p0 < seg and seg <= p1 < 2 * seg and 2 * seg <= p2 < 3 * seg
+        seen_hi = max(seen_hi, p0, p1 - seg, p2 - 2 * seg)
+        # the legacy layout provably cannot reach slots >= 2**20
+        _, q0, q1, q2 = Xor8._positions(h, seg, ver=0)
+        assert q0 < (1 << 20) and q1 - seg < (1 << 20) \
+            and q2 - 2 * seg < (1 << 20)
+    assert seen_hi >= (1 << 20), \
+        "full-width positions must reach the upper half of the segment"
+
+
+def test_xor8_build_and_roundtrip_mid_size():
+    from risingwave_tpu.state.hummock import Xor8
+    keys = [b"k%08d" % i for i in range(100_000)]
+    xf = Xor8.build(keys)
+    assert xf is not None and xf.ver == 1
+    assert all(xf.may_contain(k) for k in keys[::97]), \
+        "xor filters must have NO false negatives"
+    miss = sum(xf.may_contain(b"absent-%d" % i) for i in range(10_000))
+    assert miss < 200, f"false-positive rate blew up: {miss}/10000"
+
+
+def test_read_at_protects_full_reader_set_from_lru(tmp_path, monkeypatch):
+    """hummock.py read_at opened runs one at a time through _reader(), so
+    the LRU cap could close an earlier reader of the SAME merge while the
+    range scan still iterated it."""
+    from risingwave_tpu.state import hummock
+    from risingwave_tpu.state.hummock import SpillStateStore
+    monkeypatch.setattr(hummock, "MAX_OPEN_READERS", 2)
+    store = SpillStateStore(str(tmp_path / "d"))
+    # 4 runs for one table (below the compaction threshold of 8)
+    for i, epoch in enumerate(range(10, 50, 10)):
+        store.ingest_batch(7, [(b"k%d%03d" % (i, j), (i, j))
+                               for j in range(600)], epoch)
+        store.commit_epoch(epoch)
+    rows = list(store.read_at(store.committed_epoch, 7))
+    assert len(rows) == 4 * 600
+    store.close()
+
+
+def test_completed_portal_reexecute_keeps_statement_tag():
+    """pgwire/server.py:571 replied SELECT 0 to re-Execute of ANY
+    completed portal; PG tags by statement kind."""
+    import struct
+    from risingwave_tpu.pgwire.server import PgServer
+    from risingwave_tpu.sql import Database
+    from tests.test_pgwire import MiniClient
+
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+    server = PgServer(db).start()
+    try:
+        c = MiniClient(server.host, server.port)
+        c.startup()
+
+        def exec_twice(sql):
+            c.send(b"P", b"\0" + sql.encode() + b"\0" + struct.pack(">H", 0))
+            c.send(b"B", b"\0\0" + struct.pack(">HHH", 0, 0, 0))
+            c.send(b"E", b"\0" + struct.pack(">I", 0))
+            c.send(b"E", b"\0" + struct.pack(">I", 0))   # completed portal
+            c.send(b"S")
+            msgs = c.read_until(b"Z")
+            return [b.rstrip(b"\0").decode() for t, b in msgs if t == b"C"]
+
+        tags = exec_twice("INSERT INTO t VALUES (1, 10)")
+        assert tags == ["INSERT 0 1", "INSERT 0 0"], tags
+        tags = exec_twice("DELETE FROM t WHERE k = 99")
+        assert tags == ["DELETE 0", "DELETE 0"], tags
+        tags = exec_twice("SELECT * FROM t")
+        assert tags[0].startswith("SELECT") and tags[1] == "SELECT 0", tags
+    finally:
+        server.stop()
+
+
+@pytest.mark.slow
+def test_xor8_large_run_construction_succeeds():
+    """With 20-bit positions, any run big enough that seg > 2**20
+    (~2.55M keys) could never peel; full-width positions build fine."""
+    from risingwave_tpu.state.hummock import Xor8
+    n = 2_600_000
+    keys = [b"%016x" % i for i in range(n)]
+    xf = Xor8.build(keys)
+    assert xf is not None, "construction must not exhaust its seed retries"
+    assert xf.seg > (1 << 20)
+    assert all(xf.may_contain(k) for k in keys[:: n // 997])

@@ -103,3 +103,199 @@ def test_worker_kill_midstream_exactly_once_sink(tmp_path, seed):
     assert got == want_rows, (len(got), len(want_rows))
     rfs2 = find_remote(db2, "q4")
     rfs2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# FragmentSupervisor: in-place self-healing (SET streaming_supervision)
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisedRecovery:
+    """`SET streaming_supervision TO true`: one dead worker respawns in
+    place — same job objects, no DDL replay — instead of tearing the
+    whole job down (the reference survives node kills inside
+    `GlobalBarrierWorker::recovery`; this is the per-fragment analog)."""
+
+    def _fast_backoff(self):
+        from risingwave_tpu.config import ROBUSTNESS
+        ROBUSTNESS.respawn_backoff_s = 0.001
+        ROBUSTNESS.spawn_backoff_s = 0.001
+
+    @pytest.mark.parametrize("victim", [0, 1])
+    def test_stateless_worker_killed_midstream_respawns_in_place(
+            self, victim):
+        """Kill one stateless partial-agg worker MID-EPOCH (between the
+        37th dispatched chunk and its barrier — deterministic, no timer
+        races): the supervisor replays the retained input epoch(s) into
+        a fresh worker — exactly-once (worker output is epoch-atomic),
+        so the final MV equals the oracle with no job restart."""
+        from risingwave_tpu.core.chunk import StreamChunk
+        self._fast_backoff()
+        n, chunk = 40_000, 64
+        db = Database()
+        db.run(SRC.format(n=n, c=chunk))
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        db.run("SET streaming_supervision TO true")
+        db.run(MV)
+        rfs = find_remote(db, "q4")
+        old_pid = rfs.workers[victim].proc.pid
+        # hook the victim's input channel: hard-kill it right after its
+        # 37th data chunk — guaranteed mid-stream AND mid-epoch (epochs
+        # carry up to 64 source chunks), dispatch still in flight
+        vin = rfs.in_channels[0][victim]
+        orig_send, seen = vin.send, [0]
+
+        def send_and_kill(msg):
+            orig_send(msg)
+            if isinstance(msg, StreamChunk):
+                seen[0] += 1
+                if seen[0] == 37:
+                    rfs.workers[victim].proc.kill()
+                    rfs.workers[victim].proc.wait()
+        vin.send = send_and_kill
+        for _ in range(n // (64 * chunk) + 4):
+            db.tick()                  # must NOT raise RemoteWorkerDied
+        assert find_remote(db, "q4") is rfs, \
+            "job objects must survive (in-place recovery, no DDL replay)"
+        assert rfs.supervisor.respawns == 1
+        assert rfs.workers[victim].proc.pid != old_pid
+        assert sorted(db.query("SELECT * FROM q4")) == oracle(n, chunk)
+        rfs.shutdown()
+
+    def test_stateful_agg_worker_killed_respawns_with_shadow_reseed(self):
+        """Kill exactly one stateful-agg worker after it holds state: the
+        supervisor re-seeds the respawn from the coordinator shadow and
+        the post-respawn refresh reconciles the MV; retractions against
+        the reseeded state stay exact."""
+        self._fast_backoff()
+        db = Database()
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        db.run("SET streaming_supervision TO true")
+        db.run("CREATE MATERIALIZED VIEW ra AS SELECT k, count(*) AS c,"
+               " min(v) AS lo, max(v) AS hi FROM t GROUP BY k")
+        rfs = find_remote(db, "ra")
+        assert rfs.kind == "stateful"
+        db.run("INSERT INTO t VALUES (1, 10), (1, 5), (2, 7), (3, 30)")
+        for _ in range(4):
+            db.tick()
+        assert sorted(db.query("SELECT * FROM ra")) == \
+            [(1, 2, 5, 10), (2, 1, 7, 7), (3, 1, 30, 30)]
+        victim = 0
+        old_pid = rfs.workers[victim].proc.pid
+        rfs.workers[victim].proc.kill()
+        for _ in range(4):
+            db.tick()                  # supervisor respawns, no teardown
+        assert find_remote(db, "ra") is rfs
+        assert rfs.supervisor.respawns == 1
+        assert rfs.workers[victim].proc.pid != old_pid
+        # refresh must have reconciled every owned group exactly
+        assert sorted(db.query("SELECT * FROM ra")) == \
+            [(1, 2, 5, 10), (2, 1, 7, 7), (3, 1, 30, 30)]
+        # retraction against RESEEDED worker state: min(5) must retract
+        db.run("DELETE FROM t WHERE v = 5")
+        for _ in range(4):
+            db.tick()
+        assert sorted(db.query("SELECT * FROM ra")) == \
+            [(1, 1, 10, 10), (2, 1, 7, 7), (3, 1, 30, 30)]
+        # and fresh inserts keep aggregating on the respawned worker
+        db.run("INSERT INTO t VALUES (1, 2), (2, 9)")
+        for _ in range(4):
+            db.tick()
+        assert sorted(db.query("SELECT * FROM ra")) == \
+            [(1, 2, 2, 10), (2, 2, 7, 9), (3, 1, 30, 30)]
+        rfs.shutdown()
+
+    def test_drain_flap_failpoint_triggers_one_respawn(self):
+        """A seeded `fragment.drain` failpoint aborts exactly one result
+        drain (connection flap, worker still alive): the supervisor
+        treats it as a worker failure, respawns, and the job converges
+        — repeatable because max_fires bounds the chaos."""
+        from risingwave_tpu.utils import failpoint as fp
+        self._fast_backoff()
+        n, chunk = 20_000, 256
+        fp.arm("fragment.drain", prob=1.0, seed=0, max_fires=1)
+        try:
+            db = Database()
+            db.run(SRC.format(n=n, c=chunk))
+            db.run("SET streaming_parallelism = 2")
+            db.run("SET streaming_placement = 'process'")
+            db.run("SET streaming_supervision TO true")
+            db.run(MV)
+            rfs = find_remote(db, "q4")
+            for _ in range(n // (64 * chunk) + 4):
+                db.tick()
+            assert rfs.supervisor.respawns == 1
+            assert sorted(db.query("SELECT * FROM q4")) == oracle(n, chunk)
+            rfs.shutdown()
+        finally:
+            fp.reset()
+
+    def test_crash_looping_worker_escalates_to_full_recovery(
+            self, monkeypatch, tmp_path):
+        """RW_FAILPOINTS=worker.crash:1:0:1 makes EVERY worker process
+        (respawns included — they inherit the env) die on its first
+        message: bounded respawn attempts must exhaust and escalate to
+        the classic RemoteWorkerDied full-recovery path, never hang."""
+        from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+        self._fast_backoff()
+        monkeypatch.setenv("RW_FAILPOINTS", "worker.crash:1:0:1")
+        d = str(tmp_path / "data")
+        db = Database(data_dir=d)
+        db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        db.run("SET streaming_supervision TO true")
+        db.run("CREATE MATERIALIZED VIEW ra AS SELECT k, count(*) AS c"
+               " FROM t GROUP BY k")
+        rfs = find_remote(db, "ra")
+        with pytest.raises(RemoteWorkerDied, match="escalating"):
+            # the INSERT's flush already ticks the dataflow, so the
+            # chaos can escalate inside it or in the explicit ticks
+            db.run("INSERT INTO t VALUES (1, 10), (2, 20)")
+            for _ in range(30):
+                db.tick()
+        assert rfs.supervisor.respawns >= 1, \
+            "escalation must come AFTER in-place attempts were tried"
+        rfs.shutdown()
+        del db
+        # chaos off: full recovery (DDL replay) converges. The crash may
+        # have landed before or after the INSERT's checkpoint, so compare
+        # the MV against the recovered base table, not a pinned row set.
+        monkeypatch.delenv("RW_FAILPOINTS")
+        db2 = Database(data_dir=d)
+        for _ in range(4):
+            db2.tick()
+        db2.run("INSERT INTO t VALUES (1, 11)")
+        for _ in range(4):
+            db2.tick()
+        want = sorted(db2.query("SELECT k, count(*) FROM t GROUP BY k"))
+        got = sorted(db2.query("SELECT * FROM ra"))
+        assert got == want and any(k == 1 for k, _ in got), (got, want)
+        find_remote(db2, "ra").shutdown()
+
+    def test_join_fragment_death_escalates_immediately(self):
+        """Two-input join fragments are outside the in-place respawn
+        envelope (per-chunk join output can't be reconciled by refresh):
+        supervision must degrade gracefully to RemoteWorkerDied."""
+        from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+        self._fast_backoff()
+        db = Database()
+        db.run("CREATE TABLE a (k BIGINT, v BIGINT)")
+        db.run("CREATE TABLE b (k BIGINT, w BIGINT)")
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        db.run("SET streaming_supervision TO true")
+        db.run("CREATE MATERIALIZED VIEW rj AS SELECT a.v, b.w"
+               " FROM a JOIN b ON a.k = b.k")
+        db.run("INSERT INTO a VALUES (1, 10)")
+        for _ in range(3):
+            db.tick()
+        rfs = find_remote(db, "rj")
+        rfs.workers[0].proc.kill()
+        with pytest.raises(RemoteWorkerDied, match="two-input join"):
+            for _ in range(10):
+                db.tick()
+        rfs.shutdown()

@@ -1,0 +1,269 @@
+"""Failpoint fault injection + self-healing worker supervision.
+
+Reference analogs: the `fail::fail_point!` hooks the recovery tests arm
+(`src/meta/src/hummock/manager/commit_epoch.rs` commit-epoch failpoints)
+and the madsim deterministic node-kill tier
+(`src/tests/simulation/tests/integration_tests/recovery/`). Here the
+seeded registry (`utils/failpoint.py`) is exercised through every layer
+it hooks — exchange sockets, worker spawn/crash, spill/manifest
+commit — plus the FragmentSupervisor's in-place respawn paths.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.sql import Database
+from risingwave_tpu.utils import failpoint as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_failpoint_is_noop():
+    assert fp.failpoint("no.such.point") is False
+    assert fp.failpoint("exchange.connect") is False
+
+
+def test_seeded_firing_is_deterministic():
+    fp.arm("x", prob=0.3, seed=7)
+    seq1 = [fp.failpoint("x") for _ in range(200)]
+    fp.reset()
+    fp.arm("x", prob=0.3, seed=7)
+    seq2 = [fp.failpoint("x") for _ in range(200)]
+    assert seq1 == seq2
+    assert any(seq1) and not all(seq1)
+    fp.reset()
+    fp.arm("x", prob=0.3, seed=8)
+    assert [fp.failpoint("x") for _ in range(200)] != seq1, \
+        "a different seed must fire a different hit sequence"
+
+
+def test_max_fires_caps_total():
+    fp.arm("x", prob=1.0, seed=0, max_fires=3)
+    assert [fp.failpoint("x") for _ in range(10)].count(True) == 3
+
+
+def test_env_spec_parsing():
+    pts = fp.parse_spec("a, b:0.5 , c:0.25:9:2")
+    assert [(p.name, p.prob, p.seed, p.max_fires) for p in pts] == \
+        [("a", 1.0, 0, None), ("b", 0.5, 0, None), ("c", 0.25, 9, 2)]
+    with pytest.raises(ValueError):
+        fp.parse_spec("bad:prob")
+    with pytest.raises(ValueError):
+        fp.parse_spec("x:2.0")          # prob out of range
+    # round trip through the canonical spec string
+    assert fp.parse_spec(pts[2].spec())[0].max_fires == 2
+
+
+def test_env_load(monkeypatch):
+    monkeypatch.setenv(fp.ENV_VAR, "worker.crash:0.5:11")
+    fp.load_env()
+    armed = {p.name: p for p in fp.armed()}
+    assert armed["worker.crash"].seed == 11
+
+
+# ---------------------------------------------------------------------------
+# exchange layer: connect retry/backoff, frame faults
+# ---------------------------------------------------------------------------
+
+
+def _roundtrip_one_chunk():
+    """One chunk coordinator->consumer over a real socket exchange."""
+    from risingwave_tpu.core import Op, Schema, StreamChunk, dtypes as T
+    from risingwave_tpu.core.schema import Field
+    from risingwave_tpu.runtime.exchange_net import (ExchangeServer,
+                                                     RemoteInput)
+    schema = Schema([Field("v", T.INT64)])
+    server = ExchangeServer()
+    ch = server.register(0, schema.dtypes)
+    ch.send(StreamChunk.from_rows([T.INT64], [(Op.INSERT, (7,))]))
+    ch.close()
+    inp = RemoteInput(server.addr, 0, schema)
+    rows = [r for msg in inp.execute() for _, r in msg.op_rows()]
+    server.close()
+    return rows
+
+
+def test_connect_retry_absorbs_transient_refusals():
+    from risingwave_tpu.config import ROBUSTNESS
+    fp.arm("exchange.connect", prob=1.0, seed=0, max_fires=2)
+    old = ROBUSTNESS.connect_backoff_s
+    ROBUSTNESS.connect_backoff_s = 0.001
+    try:
+        assert _roundtrip_one_chunk() == [(7,)]
+    finally:
+        ROBUSTNESS.connect_backoff_s = old
+    assert fp._ARMED["exchange.connect"].fires == 2
+
+
+def test_connect_gives_up_after_bounded_attempts():
+    from risingwave_tpu.config import ROBUSTNESS
+    fp.arm("exchange.connect", prob=1.0, seed=0)   # every attempt refused
+    old = ROBUSTNESS.connect_backoff_s
+    ROBUSTNESS.connect_backoff_s = 0.001
+    try:
+        with pytest.raises(ConnectionError, match="attempts"):
+            _roundtrip_one_chunk()
+    finally:
+        ROBUSTNESS.connect_backoff_s = old
+
+
+def test_recv_frame_fault_surfaces_as_connection_error():
+    fp.arm("exchange.recv_frame", prob=1.0, seed=0, max_fires=1)
+    with pytest.raises(ConnectionError):
+        _roundtrip_one_chunk()
+
+
+# ---------------------------------------------------------------------------
+# worker spawn: startup retry + escalation
+# ---------------------------------------------------------------------------
+
+
+def test_spawn_retry_absorbs_transient_failures():
+    from risingwave_tpu.config import ROBUSTNESS
+    from risingwave_tpu.runtime import remote_fragments as rf
+    fp.arm("fragment.spawn", prob=1.0, seed=0,
+           max_fires=ROBUSTNESS.spawn_attempts - 1)
+    old = ROBUSTNESS.spawn_backoff_s
+    ROBUSTNESS.spawn_backoff_s = 0.001
+    try:
+        from risingwave_tpu.runtime.exchange_net import ExchangeServer
+        server = ExchangeServer()
+        plan = {"coord": [server.addr[0], server.addr[1]], "in_channel": 0,
+                "in_schema": [["k", "bigint"]], "append_only": True,
+                "fragment": {"kind": "partial_hash_agg",
+                             "group_indices": [0],
+                             "calls": [["count", None]]}}
+        w = rf._spawn_worker(plan)     # succeeds on the last attempt
+        assert w.proc.poll() is None
+        w.proc.kill()
+        server.close()
+    finally:
+        ROBUSTNESS.spawn_backoff_s = old
+
+
+def test_spawn_escalates_past_bounded_attempts():
+    from risingwave_tpu.config import ROBUSTNESS
+    from risingwave_tpu.runtime import remote_fragments as rf
+    fp.arm("fragment.spawn", prob=1.0, seed=0)
+    old = ROBUSTNESS.spawn_backoff_s
+    ROBUSTNESS.spawn_backoff_s = 0.001
+    try:
+        with pytest.raises(rf.RemoteWorkerDied, match="spawn failed"):
+            rf._spawn_worker({"in_channel": 0})
+    finally:
+        ROBUSTNESS.spawn_backoff_s = old
+
+
+# ---------------------------------------------------------------------------
+# state layer: crash-consistency torture
+# ---------------------------------------------------------------------------
+
+
+def _ingest(store, table_id, epoch, rows):
+    store.ingest_batch(table_id,
+                       [(k.encode(), (v,)) for k, v in rows], epoch)
+
+
+def test_manifest_commit_crash_preserves_previous_version(tmp_path):
+    from risingwave_tpu.state import SpillStateStore
+    d = str(tmp_path / "data")
+    store = SpillStateStore(d)
+    _ingest(store, 1, 10, [("a", 1), ("b", 2)])
+    store.commit_epoch(10)
+    # crash between the tmp manifest write and the atomic rename
+    fp.arm("state.manifest_commit", prob=1.0, seed=0, max_fires=1)
+    _ingest(store, 1, 20, [("c", 3)])
+    with pytest.raises(fp.FailpointError):
+        store.commit_epoch(20)
+    fp.reset()
+    store.close()
+    # recovery: the previous version must be fully readable, the
+    # uncommitted epoch gone ('uncommitted epochs vanish')
+    store2 = SpillStateStore(d)
+    assert store2.committed_epoch == 10
+    assert store2.get(1, b"a") == (1,)
+    assert store2.get(1, b"b") == (2,)
+    assert store2.get(1, b"c") is None
+    store2.close()
+
+
+def test_spill_write_crash_preserves_previous_version(tmp_path):
+    from risingwave_tpu.state import SpillStateStore
+    d = str(tmp_path / "data")
+    store = SpillStateStore(d)
+    _ingest(store, 1, 10, [("a", 1)])
+    store.commit_epoch(10)
+    fp.arm("state.spill_write", prob=1.0, seed=0, max_fires=1)
+    _ingest(store, 1, 20, [("b", 2)])
+    with pytest.raises(fp.FailpointError):
+        store.commit_epoch(20)
+    fp.reset()
+    store.close()
+    store2 = SpillStateStore(d)
+    assert store2.committed_epoch == 10
+    assert store2.get(1, b"a") == (1,)
+    assert store2.get(1, b"b") is None
+    # no torn .tmp run files survive recovery
+    leftovers = [f for f in os.listdir(os.path.join(d, "runs"))
+                 if f.endswith(".tmp")]
+    assert leftovers == []
+    store2.close()
+
+
+def test_repeated_runs_fire_identically_through_the_state_layer(tmp_path):
+    """Acceptance: same RW_FAILPOINTS seed => identical firing. Drive the
+    same commit sequence twice under a probabilistic point and compare
+    which commits crashed."""
+    from risingwave_tpu.state import SpillStateStore
+
+    def run(sub):
+        fp.reset()
+        fp.arm("state.manifest_commit", prob=0.4, seed=123)
+        store = SpillStateStore(str(tmp_path / sub))
+        crashed = []
+        for i, epoch in enumerate(range(10, 100, 10)):
+            _ingest(store, 1, epoch, [(f"k{i}", i)])
+            try:
+                store.commit_epoch(epoch)
+                crashed.append(False)
+            except fp.FailpointError:
+                crashed.append(True)
+        store.close()
+        fp.reset()
+        return crashed
+
+    a, b = run("a"), run("b")
+    assert a == b and any(a) and not all(a)
+
+
+# ---------------------------------------------------------------------------
+# risectl surface
+# ---------------------------------------------------------------------------
+
+
+def test_risectl_failpoints_lists_and_arms(capsys):
+    from risingwave_tpu.ctl import main
+    assert main(["failpoints"]) == 0
+    out = capsys.readouterr().out
+    for name in ("exchange.connect", "worker.crash", "state.spill_write",
+                 "state.manifest_commit", "fragment.spawn"):
+        assert name in out
+    assert main(["failpoints", "--arm", "worker.crash:0.1:42:3"]) == 0
+    out = capsys.readouterr().out
+    assert "export RW_FAILPOINTS='worker.crash:0.1:42:3'" in out
+    with pytest.raises(SystemExit):
+        main(["failpoints", "--arm", "nope.never"])
+    with pytest.raises(SystemExit):
+        main(["failpoints", "--arm", "worker.crash:banana"])
