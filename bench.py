@@ -345,6 +345,12 @@ def _device_cfg(on, capacity):
                         mv_persist_every=MV_PERSIST_EVERY)
 
 
+def _cap_stats(db):
+    """Per-fused-job capacity lifecycle: whether a (future) regression is
+    capacity-churn or compute lives in these counters."""
+    return {name: job.cap_report() for name, job in db._fused.items()}
+
+
 def _q4_db(on, n_events, chunk=None):
     from risingwave_tpu.sql import Database
     chunk = chunk or (Q4_CHUNK if on else 8192)
@@ -354,7 +360,7 @@ def _q4_db(on, n_events, chunk=None):
     db.run(Q4_MV)
     dt = drive(db, n_events, chunk=chunk)
     rows = db.query("SELECT * FROM q4")
-    return n_events / dt, rows
+    return n_events / dt, rows, _cap_stats(db)
 
 
 def stage_q4_device(n_events):
@@ -369,7 +375,7 @@ def stage_q4_device(n_events):
     t0 = time.perf_counter()
     _q4_db(True, n_events)
     warmup_s = time.perf_counter() - t0
-    eps, rows = _q4_db(True, n_events)
+    eps, rows, caps = _q4_db(True, n_events)
     cols = nexmark_host_columns(n_events)["bid"]
     oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
     assert len(rows) == len(oracle)
@@ -378,6 +384,7 @@ def stage_q4_device(n_events):
     return {"q4_sql": {
         "device_eps": round(eps), "events": n_events, "groups": len(rows),
         "warmup_s": round(warmup_s, 1),
+        "capacity": caps,
         "mv_verified": True,
         "note": "full SQL stack on device (fused epoch programs, "
                 "checkpoint every 8 barriers); warmup_s = first full "
@@ -387,7 +394,7 @@ def stage_q4_device(n_events):
 
 
 def stage_q4_host(n_events):
-    eps, _ = _q4_db(False, n_events)
+    eps, _, _ = _q4_db(False, n_events)
     return {"q4_sql_host": {"host_sql_eps": round(eps), "events": n_events}}
 
 
@@ -413,7 +420,7 @@ def _qx_db(on, n_events, capacity):
         "q7": db.query("SELECT * FROM nexmark_q7"),
         "q8": db.query("SELECT * FROM nexmark_q8"),
     }
-    return n_events / dt, out
+    return n_events / dt, out, _cap_stats(db)
 
 
 def stage_qx_device(n_events):
@@ -423,7 +430,7 @@ def stage_qx_device(n_events):
     budget without changing the steady-state story; compiled programs
     persist in the cache across attempts either way."""
     t0 = time.perf_counter()
-    eps, qx = _qx_db(True, n_events, QX_CAPACITY)
+    eps, qx, caps = _qx_db(True, n_events, QX_CAPACITY)
     warmup_s = round(time.perf_counter() - t0, 1)
     c = nexmark_host_columns(n_events)
     bid, auc, per = c["bid"], c["auction"], c["person"]
@@ -447,20 +454,22 @@ def stage_qx_device(n_events):
     return {"q5_q7_q8_sql": {
         "device_eps": round(eps), "events": n_events,
         "warmup_s": round(warmup_s, 1),
+        "capacity": caps,
         "numpy_batch_eps": {"q5": round(q5_np_eps), "q7": round(q7_np_eps),
                             "q8": round(q8_np_eps)},
         "rows": {k: len(v) for k, v in qx.items()},
         "mv_verified": True,
         "note": "three reference-SQL MVs concurrently over shared "
                 "sources; device_eps counts each source event once; "
-                "single pass (warmup_s = its wall incl. cache loads; "
-                "throughput is capacity-growth-replay-bound at this "
-                "scale); oracles computed independently in numpy",
+                "single pass (warmup_s = its wall incl. cache loads); "
+                "capacity block = predictive-growth lifecycle counters "
+                "(replays should be <=2/job; more means the predictor "
+                "regressed); oracles computed independently in numpy",
     }}
 
 
 def stage_qx_host(n_events):
-    eps, _ = _qx_db(False, n_events, QX_CAPACITY)
+    eps, _, _ = _qx_db(False, n_events, QX_CAPACITY)
     return {"q5_q7_q8_sql_host": {"host_sql_eps": round(eps),
                                   "events": n_events}}
 

@@ -40,6 +40,20 @@ class DeviceConfig:
     # checkpoint (reference-strict); higher trades mirror freshness for
     # throughput — queries always serve live device state regardless.
     mv_persist_every: int = 8
+    # capacity lifecycle (device/capacity.py): a growth replay sizes EVERY
+    # node from its observed entries-per-event rate extrapolated over
+    # max_events (cascade-free, ~1 replay per job) instead of doubling
+    # only the overflowed state. Off restores blind pow2 doubling.
+    predictive_growth: bool = True
+    # HBM budget the predictor's projections are scaled down to (never
+    # below the observed need — the budget trims headroom, not
+    # correctness).
+    hbm_budget_mb: int = 4096
+    # persistent XLA compilation cache directory: per-bucket re-traces hit
+    # disk across processes and runs. None = the platform-gated default
+    # (device/__init__.py); RW_COMPILE_CACHE_DIR overrides either ("" in
+    # the env disables). No-op on jax builds without the cache config.
+    compile_cache_dir: Optional[str] = None
 
 
 @dataclass
@@ -138,7 +152,9 @@ class NodeConfig:
         if dev is not None:
             mode = dev.pop("mode", "off")
             for k in dev:
-                if k not in ("capacity", "minmax", "fuse"):
+                if k not in ("capacity", "minmax", "fuse",
+                             "mv_persist_every", "predictive_growth",
+                             "hbm_budget_mb", "compile_cache_dir"):
                     raise ValueError(f"unknown config key [device] {k!r}")
             base = resolve_device(
                 int(mode) if isinstance(mode, str) and mode.isdigit()
@@ -230,10 +246,15 @@ def resolve_device(device) -> Optional[DeviceConfig]:
     if device is None or device == "off":
         return None
     if isinstance(device, DeviceConfig):
-        return device
-    if device in ("on", "single"):
-        return DeviceConfig()
-    if isinstance(device, int):
+        cfg = device
+    elif device in ("on", "single"):
+        cfg = DeviceConfig()
+    elif isinstance(device, int):
         from .parallel import make_mesh
-        return DeviceConfig(mesh=make_mesh(device))
-    raise ValueError(f"bad device config {device!r}")
+        cfg = DeviceConfig(mesh=make_mesh(device))
+    else:
+        raise ValueError(f"bad device config {device!r}")
+    if cfg.compile_cache_dir is not None:
+        from .device import configure_compile_cache
+        configure_compile_cache(cfg.compile_cache_dir)
+    return cfg

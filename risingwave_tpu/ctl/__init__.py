@@ -24,6 +24,10 @@ Commands:
                               which the spec (default: $RW_FAILPOINTS)
                               arms; --arm validates a spec and prints
                               the export line to arm a process tree
+    fused-stats               per-fused-job growth/replay/retrace
+                              counters and current per-node capacities
+                              (JSON) — diagnose capacity-bound runs
+                              without reading bench logs
 """
 from __future__ import annotations
 
@@ -216,6 +220,23 @@ def cmd_failpoints(args) -> int:
     return 0
 
 
+def cmd_fused_stats(args) -> int:
+    """Capacity-lifecycle report of every fused device job (the growth
+    counters persist in each job's state table, so the numbers are
+    cumulative across restarts). Opens a full Database: the DDL replay
+    rebuilds the fused programs and recovery presizes them from the
+    persisted high-water marks — a recovery that itself performs growth
+    replays would show up in the counters."""
+    from ..sql import Database
+    db = Database(data_dir=args.data_dir, device="auto")
+    if not db._fused:
+        print("no fused device jobs in this data directory")
+        return 0
+    out = {name: job.cap_report() for name, job in db._fused.items()}
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_history(args) -> int:
     """Retained manifest versions (time-travel window)."""
     store = _store(args.data_dir)
@@ -235,7 +256,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub = p.add_subparsers(dest="cmd", required=True)
     for name, fn in [("jobs", cmd_jobs), ("ddl-log", cmd_ddl_log),
                      ("manifest", cmd_manifest), ("compact", cmd_compact),
-                     ("metrics", cmd_metrics)]:
+                     ("metrics", cmd_metrics),
+                     ("fused-stats", cmd_fused_stats)]:
         sp = sub.add_parser(name)
         sp.add_argument("--data-dir", required=True)
         sp.set_defaults(fn=fn)

@@ -22,19 +22,45 @@ jax.config.update("jax_enable_x64", True)
 
 # Persistent XLA compilation cache: epoch-program compiles are expensive
 # (tens of seconds per shape on a remote-compile TPU tunnel) and fully
-# deterministic, so they are cached on disk across processes. Repo-local
-# by default; override with RW_TPU_JAX_CACHE (empty string disables).
-# Enabled ONLY under the TPU tunnel platform: with remote compile, CPU
-# AOT results come from the remote machine's CPU features and loading
-# them on this host risks SIGILL/garbage (observed), so CPU-platform
-# runs (tests) must not share the cache.
-_cache_dir = os.environ.get(
-    "RW_TPU_JAX_CACHE",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__)))), ".jax_cache"))
-if _cache_dir and "axon" in os.environ.get("JAX_PLATFORMS", ""):
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+# deterministic, so they are cached on disk across processes — every
+# per-bucket capacity re-trace after the first run of a query shape is a
+# disk hit instead of a compile (the r05 q5/q7/q8 421.7s-warmup lever).
+_DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
+def configure_compile_cache(cache_dir=None) -> bool:
+    """Point jax's persistent compilation cache at `cache_dir`.
+
+    Resolution order: RW_COMPILE_CACHE_DIR env (operator override; empty
+    string disables) > explicit argument (DeviceConfig.compile_cache_dir)
+    > legacy RW_TPU_JAX_CACHE env > repo-local .jax_cache. Returns True
+    when the cache was enabled; no-ops cleanly (False) on jax builds
+    without the cache config or when resolution yields no directory.
+    """
+    env = os.environ.get("RW_COMPILE_CACHE_DIR")
+    if env is not None:
+        cache_dir = env
+    elif cache_dir is None:
+        cache_dir = os.environ.get("RW_TPU_JAX_CACHE", _DEFAULT_CACHE)
+    if not cache_dir:
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except (AttributeError, ValueError):   # jax without the cache knobs
+        return False
+    return True
+
+
+# Default policy at import: enabled ONLY under the TPU tunnel platform
+# (or when the operator set RW_COMPILE_CACHE_DIR explicitly). With remote
+# compile, CPU AOT results come from the remote machine's CPU features
+# and loading them on this host risks SIGILL/garbage (observed), so
+# CPU-platform runs (tests) must not share the cache unless asked to.
+if "axon" in os.environ.get("JAX_PLATFORMS", "") \
+        or os.environ.get("RW_COMPILE_CACHE_DIR"):
+    configure_compile_cache()
 
 from .sorted_state import (  # noqa: E402,F401
     EMPTY_KEY,

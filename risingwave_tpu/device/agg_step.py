@@ -135,11 +135,6 @@ class DeviceAggSpec:
     def make_state(self, capacity: int) -> SortedState:
         return make_state(capacity, self.dtypes, self.kinds)
 
-    def make_full_state(self, capacity: int) -> DeviceAggState:
-        return DeviceAggState(self.make_state(capacity),
-                              tuple(ms_make(capacity)
-                                    for _ in self.minputs))
-
 
 def _row_deltas(spec: DeviceAggSpec, signs, mask,
                 inputs: Sequence[Tuple[Any, Any]]) -> List[jax.Array]:
@@ -346,8 +341,7 @@ def _pull_changes(changes: Dict[str, Any], formatted: bool = True,
     return jax.device_get(ch)
 
 
-def _bucket(n: int, lo: int = 256) -> int:
-    return max(lo, 1 << (max(1, n) - 1).bit_length())
+from .capacity import bucket as _bucket  # noqa: E402  (pow2 sizing)
 
 
 def _acc_cast(v: np.ndarray) -> np.ndarray:
@@ -478,18 +472,22 @@ class DeviceHashAgg:
             # ~0.5s latency per pull, so per-scalar int() calls add up)
             needed_h, ms_needed_h, count_h = jax.device_get(
                 (needed, ms_needed, changes["count"]))
+            # predictive growth (device/capacity.py): size ahead of the
+            # observed need so one grow skips the intermediate pow2
+            # buckets (each bucket is a retrace)
+            from .capacity import predict_capacity
             grown = False
             if int(needed_h) > self.state.capacity:
                 self.state = grow_state(
-                    self.state, _bucket(int(needed_h),
-                                        lo=self.state.capacity * 2),
+                    self.state,
+                    predict_capacity(int(needed_h), self.state.capacity),
                     self.spec.kinds)
                 grown = True
             for i, nd in enumerate(ms_needed_h):
                 if int(nd) > self.minputs[i].capacity:
                     ms = ms_grow(self.minputs[i],
-                                 _bucket(int(nd),
-                                         lo=self.minputs[i].capacity * 2))
+                                 predict_capacity(int(nd),
+                                                  self.minputs[i].capacity))
                     self.minputs = (self.minputs[:i] + (ms,)
                                     + self.minputs[i + 1:])
                     grown = True

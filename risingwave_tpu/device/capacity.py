@@ -1,0 +1,69 @@
+"""Predictive capacity sizing for device state.
+
+Fixed-capacity device state (sorted runs, join sides, pair buffers) grows
+by restoring a snapshot and replaying at a larger size — on the fused path
+one growth costs a checkpoint-window replay plus a per-node re-trace, so
+discovering cardinality one pow2 doubling at a time is the dominant cost
+of capacity-bound runs (the r05 q5/q7/q8 bench: 2,553 events/s against
+q4's 671k, all of it growth-replay churn). The fix is the same lesson
+PanJoin draws for adaptive stream-join partitioning and "Global Hash
+Tables Strike Back!" for parallel GROUP BY sizing: right-size up front
+from an observed rate instead of reacting one overflow at a time.
+
+`project` extrapolates an observed entries-per-event rate over the
+source's event horizon (`max_events`); callers clamp the result against
+an HBM budget (`DeviceConfig.hbm_budget_mb`) and never below the observed
+need — the budget trims headroom, not correctness.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+# Multiplicative headroom on the extrapolated rate. Keep it SMALL: the
+# pow2 bucket already rounds up (2x worst-case headroom), and group/pair
+# counts are usually sublinear in events (they saturate) so the linear
+# projection itself over-shoots. A large factor pushes dead-linear rates
+# (bids-per-event) one whole bucket past their true need, and every
+# subsequent epoch pays the sort over the padded state; an under-shoot
+# merely costs one more (bounded) replay.
+HEADROOM = 1.05
+# Unbounded sources have no horizon to extrapolate over: grow two pow2
+# steps past the observed need (4x) so each replay buys several doublings.
+UNBOUNDED_STEP = 4
+
+
+def bucket(n: int, lo: int = 256) -> int:
+    """Smallest pow2 >= n, floored at lo (pow2 buckets bound the number of
+    distinct traced shapes per node)."""
+    return max(lo, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+def project(need: int, events_seen: int, horizon: Optional[int],
+            headroom: float = HEADROOM) -> int:
+    """Raw (un-bucketed) slot projection for a state that holds `need`
+    entries after `events_seen` events, extrapolated to `horizon` events.
+
+    Returns 0 when nothing was observed; never less than `need`. Once the
+    horizon is reached (sync at drain — the bench shape), the observed
+    need IS the final need: size exactly, no headroom — over-shoot costs
+    every subsequent epoch its sort over the padded state.
+    """
+    if need <= 0:
+        return 0
+    if horizon and events_seen:
+        if horizon > events_seen:
+            return max(need,
+                       int(need * horizon / events_seen * headroom) + 64)
+        return need
+    return need * UNBOUNDED_STEP
+
+
+def predict_capacity(need: int, current: int, events_seen: int = 0,
+                     horizon: Optional[int] = None, lo: int = 256) -> int:
+    """Bucketed growth target for one standalone state (the per-operator
+    wrappers, which grow-and-retry inside one epoch instead of replaying):
+    at least the observed need, at least the current capacity, sized ahead
+    by the rate projection so one grow skips the intermediate buckets."""
+    if need <= current:
+        return current
+    return bucket(max(need, project(need, events_seen, horizon)), lo=current)

@@ -418,11 +418,16 @@ class _NexmarkDesc:
 
 
 def try_fuse(execu, ns, device_cfg, name: str,
-             mv_state_table=None, make_state=None) -> Optional[FusedJob]:
+             mv_state_table=None, make_state=None,
+             cap_hints=None) -> Optional[FusedJob]:
     """Lower a planned MV executor tree to a FusedJob, or None.
 
     `execu` is the tree Database._create_mv would hand to Materialize;
     `ns` its namespace (schema + stream key + visibility).
+    `cap_hints` (FusedJob.cap_hints of a previous incarnation) presizes
+    the program's nodes BEFORE state allocation, so a re-created MV with
+    the same plan never re-climbs the capacity growth ladder; hints whose
+    node index/type no longer match the plan are ignored.
     """
     from ..ops import ProjectExecutor
     if device_cfg is None or getattr(device_cfg, "mesh", None) is not None:
@@ -462,6 +467,16 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                       f.capacity))
             pull = MVPull("pair", mv_idx, m.dtypes, m.decoders)
         program = FusedProgram(f.nodes, f.epoch_events or 8192 * 64)
+        for i, hint in (cap_hints or {}).items():
+            i = int(i)
+            # index + type + structural hash must all match: a hint from a
+            # DIFFERENT plan must never presize this one (the hash also
+            # keeps preset capacities to values a budget-governed run of
+            # the SAME plan actually reached)
+            if i < len(program.nodes) \
+                    and type(program.nodes[i]).__name__ == hint.get("type") \
+                    and hash(program.nodes[i]) == hint.get("sig"):
+                program.nodes[i].preset_caps(hint.get("caps", {}))
         job_table = make_state([T.INT64, T.INT64], [0]) if make_state \
             else None
         return FusedJob(name, program, pull, f.max_events,
@@ -469,7 +484,11 @@ def try_fuse(execu, ns, device_cfg, name: str,
                         job_state_table=job_table,
                         mv_schema_len=len(ns.cols),
                         persist_every=getattr(device_cfg,
-                                              "mv_persist_every", 1))
+                                              "mv_persist_every", 1),
+                        predictive=getattr(device_cfg,
+                                           "predictive_growth", True),
+                        hbm_budget_mb=getattr(device_cfg,
+                                              "hbm_budget_mb", 4096))
     except FuseReject:
         return None
 

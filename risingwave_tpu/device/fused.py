@@ -209,9 +209,31 @@ class Node:
     def init_state(self):
         return None
 
-    def grow(self, state, stats: Dict[str, int]):
-        """(state', grew) given this node's pulled stats."""
-        return state, False
+    # ---- capacity lifecycle (FusedJob.sync / recover drive these) -------
+    # Capacity is declarative: a node names its capacity slots and reports
+    # per-slot observed needs from its pulled stats; the JOB owns the
+    # growth policy (predictive sizing, HBM budget, replay accounting) and
+    # hands back bucketed targets. preset_caps (before init_state) serves
+    # high-water presizing; cap_resize pads live state mid-run.
+    def cap_current(self) -> Dict[str, int]:
+        """slot name -> current capacity (empty = stateless node)."""
+        return {}
+
+    def cap_needs(self, stats: Dict[str, int]) -> Dict[str, int]:
+        """slot name -> observed slots needed, from this node's stats."""
+        return {}
+
+    def cap_bytes(self) -> Dict[str, int]:
+        """slot name -> approximate HBM bytes per slot (budget math)."""
+        return {}
+
+    def preset_caps(self, caps: Dict[str, int]) -> None:
+        """Adopt capacities BEFORE init_state (high-water presizing)."""
+
+    def cap_resize(self, state, caps: Dict[str, int]):
+        """Pad live state to the given (pow2, >= current) capacities and
+        adopt them; slots absent from `caps` keep their size."""
+        return state
 
     def apply(self, state, ins: List[Optional[Delta]], extra,
               epoch_events: int):
@@ -253,8 +275,7 @@ def _node_step(node: Node, epoch_events: int, state, ins, extra):
 _JIT_STEP = None
 
 
-def _bucket(n: int, lo: int = 256) -> int:
-    return max(lo, 1 << (max(1, int(n)) - 1).bit_length())
+from .capacity import bucket as _bucket  # noqa: E402  (pow2 sizing)
 
 
 class SourceNode(Node):
@@ -455,6 +476,9 @@ class AggNode(Node):
         self.pack = pack
         self.spec = spec
         self.capacity = capacity
+        # per-minput multiset capacities (tracked on the node so presizing
+        # can set them before init_state builds the arrays)
+        self.ms_caps = [capacity] * len(spec.minputs)
         # row identity of emitted change rows = pack(group, outputs); None
         # when no join/pair-MV consumes this stream (pk then unused)
         self.pk_pack = pk_pack
@@ -469,29 +493,53 @@ class AggNode(Node):
                                 + ["packbad"])
 
     def init_state(self):
-        return self.spec.make_full_state(self.capacity)
-
-    def grow(self, state, stats):
         from .agg_step import DeviceAggState
-        from .minput import ms_grow
-        from .sorted_state import grow_state
-        grew = False
-        main = state.main
+        from .minput import ms_make
+        return DeviceAggState(self.spec.make_state(self.capacity),
+                              tuple(ms_make(c) for c in self.ms_caps))
+
+    def cap_current(self):
+        caps = {"main": self.capacity}
+        for i, c in enumerate(self.ms_caps):
+            caps[f"ms{i}"] = c
+        return caps
+
+    def cap_needs(self, stats):
         # `touched` guards the change-set compaction bound (2 * capacity):
         # an epoch touching more unique groups than capacity must grow and
         # replay even if enough groups died for the merge itself to fit
-        need = max(stats["needed"], stats.get("touched", 0))
-        if need > main.capacity:
-            self.capacity = _bucket(need, lo=main.capacity * 2)
+        needs = {"main": max(stats["needed"], stats.get("touched", 0))}
+        for i in range(len(self.ms_caps)):
+            needs[f"ms{i}"] = stats[f"ms{i}"]
+        return needs
+
+    def cap_bytes(self):
+        from .minput import MS_SLOT_BYTES
+        caps = {"main": 8 * (1 + len(self.spec.dtypes))}
+        for i in range(len(self.ms_caps)):
+            caps[f"ms{i}"] = MS_SLOT_BYTES
+        return caps
+
+    def preset_caps(self, caps):
+        self.capacity = max(self.capacity, caps.get("main", 0))
+        for i in range(len(self.ms_caps)):
+            self.ms_caps[i] = max(self.ms_caps[i], caps.get(f"ms{i}", 0))
+
+    def cap_resize(self, state, caps):
+        from .agg_step import DeviceAggState
+        from .minput import ms_grow
+        from .sorted_state import grow_state
+        main = state.main
+        if caps.get("main", 0) > main.capacity:
+            self.capacity = caps["main"]
             main = grow_state(main, self.capacity, self.spec.kinds)
-            grew = True
         ms = list(state.minputs)
         for i in range(len(ms)):
-            if stats[f"ms{i}"] > ms[i].capacity:
-                ms[i] = ms_grow(ms[i], _bucket(stats[f"ms{i}"],
-                                               lo=ms[i].capacity * 2))
-                grew = True
-        return DeviceAggState(main, tuple(ms)), grew
+            c = caps.get(f"ms{i}", 0)
+            if c > ms[i].capacity:
+                self.ms_caps[i] = c
+                ms[i] = ms_grow(ms[i], c)
+        return DeviceAggState(main, tuple(ms))
 
     def _call_outputs(self, ch, which: str):
         """Per-call (array, null) at the touched keys, old or new."""
@@ -604,7 +652,7 @@ class JoinNode(Node):
         self.r_keys = list(r_keys)
         self.pack = pack
         self.cond = cond
-        self.capacity = capacity
+        self.cap_a = self.cap_b = self.capacity = capacity
         self.m = pair_capacity
         self.l_val_dtypes = list(l_val_dtypes)
         self.r_val_dtypes = list(r_val_dtypes)
@@ -612,24 +660,42 @@ class JoinNode(Node):
 
     def init_state(self):
         from .join_step import make_side
-        return (make_side(self.capacity, self.l_val_dtypes),
-                make_side(self.capacity, self.r_val_dtypes))
+        return (make_side(self.cap_a, self.l_val_dtypes),
+                make_side(self.cap_b, self.r_val_dtypes))
 
-    def grow(self, state, stats):
+    def cap_current(self):
+        return {"a": self.cap_a, "b": self.cap_b, "pairs": self.m}
+
+    def cap_needs(self, stats):
+        return {"a": stats["need_a"], "b": stats["need_b"],
+                "pairs": stats["need_pairs"]}
+
+    def cap_bytes(self):
+        # pair buffer: two probe outputs carry both sides' payloads + ids
+        pair = 16 * (3 + len(self.l_val_dtypes) + len(self.r_val_dtypes))
+        return {"a": 8 * (2 + len(self.l_val_dtypes)),
+                "b": 8 * (2 + len(self.r_val_dtypes)),
+                "pairs": pair}
+
+    def preset_caps(self, caps):
+        self.cap_a = max(self.cap_a, caps.get("a", 0))
+        self.cap_b = max(self.cap_b, caps.get("b", 0))
+        self.m = max(self.m, caps.get("pairs", 0))
+        self.capacity = max(self.cap_a, self.cap_b)
+
+    def cap_resize(self, state, caps):
         from .join_step import grow_side
         a, b = state
-        grew = False
-        if stats["need_a"] > a.jk.shape[0]:
-            a = grow_side(a, _bucket(stats["need_a"], lo=a.jk.shape[0] * 2))
-            grew = True
-        if stats["need_b"] > b.jk.shape[0]:
-            b = grow_side(b, _bucket(stats["need_b"], lo=b.jk.shape[0] * 2))
-            grew = True
-        self.capacity = max(a.jk.shape[0], b.jk.shape[0])
-        if stats["need_pairs"] > self.m:
-            self.m = _bucket(stats["need_pairs"], lo=self.m * 2)
-            grew = True
-        return (a, b), grew
+        if caps.get("a", 0) > a.jk.shape[0]:
+            self.cap_a = caps["a"]
+            a = grow_side(a, self.cap_a)
+        if caps.get("b", 0) > b.jk.shape[0]:
+            self.cap_b = caps["b"]
+            b = grow_side(b, self.cap_b)
+        self.capacity = max(self.cap_a, self.cap_b)
+        if caps.get("pairs", 0) > self.m:
+            self.m = caps["pairs"]    # jit-static: _mut_sig salts the trace
+        return (a, b)
 
     def _sig(self):
         return (tuple(self.l_keys), tuple(self.r_keys), self.pack,
@@ -695,14 +761,28 @@ class MVKeyedNode(Node):
         dts = [c.acc_dtype for c in self.agg.spec.calls]
         return make_mv_state(self.capacity, dts)
 
-    def grow(self, state, stats):
+    def cap_current(self):
+        return {"main": self.capacity}
+
+    def cap_needs(self, stats):
+        return {"main": stats["needed"]}
+
+    def cap_bytes(self):
+        # key + liveness + (value, null) per call (bools cost a byte but
+        # the budget math rounds to words)
+        return {"main": 8 * (2 + 2 * len(self.agg.spec.calls))}
+
+    def preset_caps(self, caps):
+        self.capacity = max(self.capacity, caps.get("main", 0))
+
+    def cap_resize(self, state, caps):
         from .materialize import mv_kinds
         from .sorted_state import grow_state
-        if stats["needed"] > state.capacity:
-            self.capacity = _bucket(stats["needed"], lo=state.capacity * 2)
+        if caps.get("main", 0) > state.capacity:
+            self.capacity = caps["main"]
             return grow_state(state, self.capacity,
-                              mv_kinds(len(self.agg.spec.calls))), True
-        return state, False
+                              mv_kinds(len(self.agg.spec.calls)))
+        return state
 
     def _sig(self):
         return ("mvk",) + self.agg._sig()
@@ -736,13 +816,24 @@ class MVPairNode(Node):
         from .join_step import make_side
         return make_side(self.capacity, self.val_dtypes)
 
-    def grow(self, state, stats):
+    def cap_current(self):
+        return {"main": self.capacity}
+
+    def cap_needs(self, stats):
+        return {"main": stats["needed"]}
+
+    def cap_bytes(self):
+        return {"main": 8 * (2 + len(self.val_dtypes))}
+
+    def preset_caps(self, caps):
+        self.capacity = max(self.capacity, caps.get("main", 0))
+
+    def cap_resize(self, state, caps):
         from .join_step import grow_side
-        if stats["needed"] > state.jk.shape[0]:
-            self.capacity = _bucket(stats["needed"],
-                                    lo=state.jk.shape[0] * 2)
-            return grow_side(state, self.capacity), True
-        return state, False
+        if caps.get("main", 0) > state.jk.shape[0]:
+            self.capacity = caps["main"]
+            return grow_side(state, self.capacity)
+        return state
 
     def _sig(self):
         return (tuple(str(d) for d in self.val_dtypes),)
@@ -852,6 +943,21 @@ class FusedProgram:
 # ---------------------------------------------------------------------------
 
 
+# job state table key schema (pk = key). Key 0 predates the capacity
+# lifecycle (old stores hold only it); cumulative growth counters and
+# per-node capacity high-water marks live at reserved keys so restarts
+# and re-created MVs presize instead of re-climbing the growth ladder.
+_JS_COUNTER = 0              # committed event counter
+_JS_REPLAYS = 1              # cumulative growth replays
+_JS_RETRACES = 2             # cumulative node re-traces from growth
+_JS_GROWTHS = 3              # cumulative capacity-slot increases
+_JS_CAP_BASE = 16            # + node_idx * stride + slot ordinal
+_JS_CAP_STRIDE = 16          # minimum per-node key stride; a program
+                             # whose widest node has more capacity slots
+                             # gets a wider stride (deterministic from the
+                             # plan, so recovery decodes the same keys)
+
+
 class FusedJob:
     """Owns the device state of one fused MV fragment.
 
@@ -861,13 +967,22 @@ class FusedJob:
     and advance the restore snapshot. Capacity overflow restores the last
     snapshot, grows, and deterministically replays — barrier-boundary
     exactness is never compromised by the async window.
+
+    Capacity lifecycle: overflow replays are PREDICTIVE and cascade-free —
+    one overflow re-sizes every node in the program from its observed
+    entries-per-event rate extrapolated over `max_events` (clamped by the
+    HBM budget), so the replay at larger capacity does not immediately
+    overflow a downstream node and re-enter the loop. Per-node capacity
+    high-water marks checkpoint into the job state table; `recover()`
+    presizes from them, making restart replays growth-free.
     """
 
     def __init__(self, name: str, program: FusedProgram, pull: MVPull,
                  max_events: Optional[int],
                  mv_state_table=None, job_state_table=None,
                  mv_schema_len: Optional[int] = None,
-                 persist_every: int = 1):
+                 persist_every: int = 1,
+                 predictive: bool = True, hbm_budget_mb: int = 4096):
         import jax.numpy as jnp
         self.name = name
         self.program = program
@@ -883,6 +998,20 @@ class FusedJob:
         # otherwise throttle every epoch); drain always mirrors
         self.persist_every = max(1, persist_every)
         self._last_persist = -1
+        self.predictive = predictive
+        self.hbm_budget_mb = hbm_budget_mb
+        # growth accounting (risectl fused-stats / bench detail blocks);
+        # cumulative across restarts (recover() restores the persisted
+        # values, checkpoints write them back)
+        self.growth_replays = 0
+        self.retraces = 0
+        self.growths = 0
+        # key stride of the capacity rows: plan-derived (deterministic on
+        # recovery), widened past the minimum when a node has more slots
+        self._js_stride = max([_JS_CAP_STRIDE]
+                              + [len(n.cap_current())
+                                 for n in program.nodes])
+        self._js_written: Dict[int, int] = {}
         self.counter = 0
         self.committed = 0
         self.states = program.init_states()
@@ -910,13 +1039,61 @@ class FusedJob:
 
     # ---- sync / growth / replay ----------------------------------------
     def _dispatch_range(self, lo: int, hi: int) -> None:
+        """Replay/recovery epochs are PURE device dispatch: the epoch's
+        event_lo advances as a device-side scalar add instead of a fresh
+        host->device transfer per epoch (one RTT each on a remote tunnel),
+        and no per-epoch host work (stats pulls, MV mirroring, tracer
+        spans) happens until the terminal sync/checkpoint."""
         import jax.numpy as jnp
         e = self.program.epoch_events
+        lo_dev = jnp.int64(lo)
         c = lo
         while c < hi:
             self.states, self.stats_acc = self._step(
-                self.states, jnp.int64(c), self.stats_acc)
+                self.states, lo_dev, self.stats_acc)
+            lo_dev = lo_dev + e
             c += e
+
+    def _predict_caps(self, needs: Dict[int, Dict[str, int]]
+                      ) -> Dict[int, Dict[str, int]]:
+        """Bucketed capacity targets for EVERY node (cascade-free): each
+        slot is sized from its observed entries-per-event rate extrapolated
+        over max_events, scaled down toward the observed need when the
+        summed projection exceeds the HBM budget (correctness floor: never
+        below need or current)."""
+        from .capacity import project
+        if not self.predictive:
+            out: Dict[int, Dict[str, int]] = {}
+            for i, node in enumerate(self.program.nodes):
+                cur = node.cap_current()
+                nd = needs.get(i) or {}
+                grown = {s: _bucket(nd[s], lo=cur[s] * 2)
+                         for s in cur if nd.get(s, 0) > cur[s]}
+                if grown:
+                    out[i] = grown
+            return out
+        events = max(1, self.counter)
+        plans = []           # [node, slot, need, current, bytes/slot, proj]
+        for i, node in enumerate(self.program.nodes):
+            cur = node.cap_current()
+            if not cur:
+                continue
+            bpe = node.cap_bytes()
+            nd = needs.get(i) or {}
+            for s, c in cur.items():
+                n = nd.get(s, 0)
+                p = max(c, project(n, events, self.max_events))
+                plans.append([i, s, n, c, bpe.get(s, 16), p])
+        budget = self.hbm_budget_mb << 20
+        total = sum(_bucket(p[5]) * p[4] for p in plans)
+        if total > budget:
+            scale = budget / total
+            for p in plans:
+                p[5] = max(p[2], p[3], int(p[5] * scale))
+        out = {}
+        for i, s, n, c, _, p in plans:
+            out.setdefault(i, {})[s] = _bucket(max(n, p), lo=c)
+        return out
 
     def sync(self) -> None:
         """Block; verify stats; grow + replay from snapshot when any state
@@ -931,16 +1108,29 @@ class FusedJob:
                         f"at node {ni} ({type(self.program.nodes[ni]).__name__}"
                         ") — a column left its statically proven range. "
                         "Re-create this MV with device='off'.")
+            needs = {i: node.cap_needs(self.program.node_stats(i, vec))
+                     for i, node in enumerate(self.program.nodes)}
+            overflow = any(
+                needs[i].get(s, 0) > c
+                for i, node in enumerate(self.program.nodes)
+                for s, c in node.cap_current().items())
+            if not overflow:
+                return
+            targets = self._predict_caps(needs)
             snap_states, snap_counter = self.snapshot
-            grew = False
             new_states = []
             for i, node in enumerate(self.program.nodes):
-                st, g = node.grow(snap_states[i],
-                                  self.program.node_stats(i, vec))
-                new_states.append(st)
-                grew = grew or g
-            if not grew:
-                return
+                cur = node.cap_current()
+                want = targets.get(i) or {}
+                grown = {s: want[s] for s in want if want[s] > cur.get(s, 0)}
+                if grown:
+                    self.retraces += 1
+                    self.growths += len(grown)
+                    new_states.append(node.cap_resize(snap_states[i],
+                                                      grown))
+                else:
+                    new_states.append(snap_states[i])
+            self.growth_replays += 1
             target = self.counter
             self.states = tuple(new_states)
             self.snapshot = (self.states, snap_counter)
@@ -948,6 +1138,19 @@ class FusedJob:
             self.stats_acc = self._zero_stats
             self._dispatch_range(snap_counter, target)
             self.counter = target
+
+    def _job_state_rows(self) -> List[Tuple[int, int]]:
+        """Growth counters + per-node capacity high-water marks, in the
+        job-state key schema (see _JS_*)."""
+        rows = [(_JS_REPLAYS, self.growth_replays),
+                (_JS_RETRACES, self.retraces),
+                (_JS_GROWTHS, self.growths)]
+        stride = self._js_stride
+        for i, node in enumerate(self.program.nodes):
+            cur = node.cap_current()
+            for si, s in enumerate(sorted(cur)):
+                rows.append((_JS_CAP_BASE + i * stride + si, cur[s]))
+        return rows
 
     def _checkpoint(self, epoch: int) -> None:
         self.sync()
@@ -959,8 +1162,16 @@ class FusedJob:
             self._persist_mv(epoch)
             self._last_persist = self.counter
         if self.job_state_table is not None:
+            dirty = False
             if self.committed != self.counter or self.committed == 0:
-                self.job_state_table.insert((0, self.counter))
+                self.job_state_table.insert((_JS_COUNTER, self.counter))
+                dirty = True
+            for k, v in self._job_state_rows():
+                if self._js_written.get(k) != v:
+                    self.job_state_table.insert((k, v))
+                    self._js_written[k] = v
+                    dirty = True
+            if dirty:
                 self.job_state_table.commit(epoch)
         self.snapshot = (self.states, self.counter)
         self.stats_acc = self._zero_stats
@@ -1019,12 +1230,36 @@ class FusedJob:
     # ---- recovery -------------------------------------------------------
     def recover(self) -> None:
         """Deterministic-source recovery: restore the committed event
-        counter and regenerate state device-side (offset rewind)."""
+        counter, presize every node from its persisted capacity high-water
+        mark (the replay then performs ZERO growth replays), and
+        regenerate state device-side (offset rewind)."""
         if self.job_state_table is None:
             return
-        target = 0
+        rows: Dict[int, int] = {}
         for row in self.job_state_table.iter_all():
-            target = max(target, int(row[1]))
+            k = int(row[0])
+            rows[k] = max(rows.get(k, 0), int(row[1]))
+        target = rows.get(_JS_COUNTER, 0)
+        # growth counters are cumulative across restarts
+        self.growth_replays = rows.get(_JS_REPLAYS, 0)
+        self.retraces = rows.get(_JS_RETRACES, 0)
+        self.growths = rows.get(_JS_GROWTHS, 0)
+        preset = False
+        for i, node in enumerate(self.program.nodes):
+            cur = node.cap_current()
+            caps = {}
+            for si, s in enumerate(sorted(cur)):
+                v = rows.get(_JS_CAP_BASE + i * self._js_stride + si, 0)
+                if v > cur[s]:
+                    caps[s] = v
+            if caps:
+                node.preset_caps(caps)
+                preset = True
+        self._js_written = {k: v for k, v in rows.items() if k != _JS_COUNTER}
+        if preset:
+            # nothing dispatched yet — rebuild empty state at full size
+            self.states = self.program.init_states()
+            self.snapshot = (self.states, 0)
         if target == 0:
             return
         self._dispatch_range(0, target)
@@ -1037,6 +1272,34 @@ class FusedJob:
             self._persisted = {tuple(r): None
                                for r in self.mv_state_table.iter_all()}
         self._last_persist = -1     # mirror may be stale: refresh next ckpt
+
+    # ---- capacity introspection -----------------------------------------
+    def cap_report(self) -> Dict[str, Any]:
+        """Growth accounting + live per-node capacities (risectl
+        fused-stats, bench detail blocks)."""
+        nodes = {}
+        for i, node in enumerate(self.program.nodes):
+            cur = node.cap_current()
+            if cur:
+                nodes[f"{i}:{type(node).__name__}"] = dict(cur)
+        return {"growth_replays": self.growth_replays,
+                "retraces": self.retraces, "growths": self.growths,
+                "committed_events": self.committed, "nodes": nodes}
+
+    def cap_hints(self) -> Dict[int, Dict[str, Any]]:
+        """Per-node capacity snapshot keyed by program node index, in the
+        shape try_fuse(cap_hints=...) consumes — lets a re-created MV with
+        the same plan start at this job's high-water capacities. Each hint
+        carries the node's structural hash (`Node.__hash__` over `_sig`),
+        so a re-created MV whose plan differs does NOT inherit capacities
+        from an unrelated node that merely shares index and type."""
+        out = {}
+        for i, node in enumerate(self.program.nodes):
+            cur = node.cap_current()
+            if cur:
+                out[i] = {"type": type(node).__name__, "sig": hash(node),
+                          "caps": dict(cur)}
+        return out
 
 
 def _np_unpack(pack: PackPlan, keys: np.ndarray) -> List[np.ndarray]:

@@ -263,22 +263,27 @@ class DeviceHashJoin:
         bsz = _bucket(max(len(ajk), len(bjk), 1), lo=64)
         A = pad((ajk, apk, asg, avals), bsz)
         B = pad((bjk, bpk, bsg, bvals), bsz)
+        from .capacity import predict_capacity
         while True:
             new_a, new_b, o1, o2, needed = join_epoch_step(
                 self.a, self.b, *A, *B, m=self.m)
             na_, nb_, np_ = (int(needed["a"]), int(needed["b"]),
                              int(needed["pairs"]))
             if np_ > self.m:
-                self.m = _bucket(np_, lo=self.m * 2)
+                # predictive (device/capacity.py): jump past the
+                # intermediate pow2 buckets — each bucket is a retrace
+                self.m = predict_capacity(np_, self.m)
                 continue
             grown = False
             if na_ > self.a.jk.shape[0]:
-                self.a = grow_side(self.a, _bucket(na_,
-                                                   lo=self.a.jk.shape[0] * 2))
+                self.a = grow_side(self.a,
+                                   predict_capacity(na_,
+                                                    self.a.jk.shape[0]))
                 grown = True
             if nb_ > self.b.jk.shape[0]:
-                self.b = grow_side(self.b, _bucket(nb_,
-                                                   lo=self.b.jk.shape[0] * 2))
+                self.b = grow_side(self.b,
+                                   predict_capacity(nb_,
+                                                    self.b.jk.shape[0]))
                 grown = True
             if grown:
                 continue

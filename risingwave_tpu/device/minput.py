@@ -27,6 +27,10 @@ from .sorted_state import EMPTY_KEY, running_sum, search_method
 
 _LOW63 = np.int64(0x7FFFFFFFFFFFFFFF)
 
+# HBM bytes per multiset slot (k1 + k2 + cnt, all int64) — the capacity
+# predictor's budget math (device/capacity.py, AggNode.cap_bytes)
+MS_SLOT_BYTES = 24
+
 
 def order_encode_f64(v: np.ndarray) -> np.ndarray:
     """Monotone float64 -> int64 (numpy): total order of the encoding

@@ -160,6 +160,10 @@ class Database:
         self._iters: Dict[str, Iterator[Message]] = {}
         # fused device jobs (whole-fragment epoch programs, device/fused.py)
         self._fused: Dict[str, Any] = {}
+        # capacity high-water of DROPPED fused jobs, by name: a re-created
+        # MV with the same plan presizes from its predecessor instead of
+        # re-climbing the growth ladder (try_fuse cap_hints)
+        self._fused_cap_hw: Dict[str, Any] = {}
         self.sink_results: Dict[str, List[Tuple]] = {}
         self.epoch_committed = 0
         self._nexmark_gen = None
@@ -583,7 +587,8 @@ class Database:
             from ..device.fuse_planner import try_fuse
             job = try_fuse(execu, ns, self.device, stmt.name,
                            mv_state_table=mv_table,
-                           make_state=self._make_state)
+                           make_state=self._make_state,
+                           cap_hints=self._fused_cap_hw.get(stmt.name))
             if job is not None:
                 for shared, port in self._pending_subs:
                     shared.unsubscribe(port)
@@ -856,7 +861,11 @@ class Database:
                 return "DROP_SKIPPED"
             raise
         self._iters.pop(stmt.name, None)
-        self._fused.pop(stmt.name, None)
+        dropped_job = self._fused.pop(stmt.name, None)
+        if dropped_job is not None:
+            # remember where its capacities topped out — a re-created MV
+            # with the same plan starts there (zero growth replays)
+            self._fused_cap_hw[stmt.name] = dropped_job.cap_hints()
         # release upstream taps, or their buffers grow forever
         for shared, port in (obj.runtime or {}).get("upstream_subs", []):
             shared.unsubscribe(port)
