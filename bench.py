@@ -351,6 +351,14 @@ def _cap_stats(db):
     return {name: job.cap_report() for name, job in db._fused.items()}
 
 
+def _profile_stats(db):
+    """Per-fused-job epoch-timeline summary (utils/profile.py): phase
+    totals + compile events + slowest epochs, so eps regressions are
+    attributable to a PHASE (compile vs dispatch vs device vs commit)
+    instead of a single end-to-end number."""
+    return {name: job.profiler.summary() for name, job in db._fused.items()}
+
+
 def _q4_db(on, n_events, chunk=None):
     from risingwave_tpu.sql import Database
     chunk = chunk or (Q4_CHUNK if on else 8192)
@@ -360,7 +368,7 @@ def _q4_db(on, n_events, chunk=None):
     db.run(Q4_MV)
     dt = drive(db, n_events, chunk=chunk)
     rows = db.query("SELECT * FROM q4")
-    return n_events / dt, rows, _cap_stats(db)
+    return n_events / dt, rows, _cap_stats(db), _profile_stats(db)
 
 
 def stage_q4_device(n_events):
@@ -375,7 +383,7 @@ def stage_q4_device(n_events):
     t0 = time.perf_counter()
     _q4_db(True, n_events)
     warmup_s = time.perf_counter() - t0
-    eps, rows, caps = _q4_db(True, n_events)
+    eps, rows, caps, prof = _q4_db(True, n_events)
     cols = nexmark_host_columns(n_events)["bid"]
     oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
     assert len(rows) == len(oracle)
@@ -385,16 +393,20 @@ def stage_q4_device(n_events):
         "device_eps": round(eps), "events": n_events, "groups": len(rows),
         "warmup_s": round(warmup_s, 1),
         "capacity": caps,
+        "profile": prof,
         "mv_verified": True,
         "note": "full SQL stack on device (fused epoch programs, "
                 "checkpoint every 8 barriers); warmup_s = first full "
                 "pass incl. compile/cache-load, device_eps = steady "
-                "state (second pass, jit-cached)",
+                "state (second pass, jit-cached); profile block = "
+                "measured-pass epoch timeline (phase_s splits the wall "
+                "into host-pack/dispatch/device-sync/commit; "
+                "compile_events decompose any residual warmup)",
     }}
 
 
 def stage_q4_host(n_events):
-    eps, _, _ = _q4_db(False, n_events)
+    eps, _, _, _ = _q4_db(False, n_events)
     return {"q4_sql_host": {"host_sql_eps": round(eps), "events": n_events}}
 
 
@@ -420,7 +432,7 @@ def _qx_db(on, n_events, capacity):
         "q7": db.query("SELECT * FROM nexmark_q7"),
         "q8": db.query("SELECT * FROM nexmark_q8"),
     }
-    return n_events / dt, out, _cap_stats(db)
+    return n_events / dt, out, _cap_stats(db), _profile_stats(db)
 
 
 def stage_qx_device(n_events):
@@ -430,7 +442,7 @@ def stage_qx_device(n_events):
     budget without changing the steady-state story; compiled programs
     persist in the cache across attempts either way."""
     t0 = time.perf_counter()
-    eps, qx, caps = _qx_db(True, n_events, QX_CAPACITY)
+    eps, qx, caps, prof = _qx_db(True, n_events, QX_CAPACITY)
     warmup_s = round(time.perf_counter() - t0, 1)
     c = nexmark_host_columns(n_events)
     bid, auc, per = c["bid"], c["auction"], c["person"]
@@ -455,6 +467,7 @@ def stage_qx_device(n_events):
         "device_eps": round(eps), "events": n_events,
         "warmup_s": round(warmup_s, 1),
         "capacity": caps,
+        "profile": prof,
         "numpy_batch_eps": {"q5": round(q5_np_eps), "q7": round(q7_np_eps),
                             "q8": round(q8_np_eps)},
         "rows": {k: len(v) for k, v in qx.items()},
@@ -464,12 +477,14 @@ def stage_qx_device(n_events):
                 "single pass (warmup_s = its wall incl. cache loads); "
                 "capacity block = predictive-growth lifecycle counters "
                 "(replays should be <=2/job; more means the predictor "
-                "regressed); oracles computed independently in numpy",
+                "regressed); profile block attributes the wall to "
+                "compile vs dispatch vs device-sync vs commit per job; "
+                "oracles computed independently in numpy",
     }}
 
 
 def stage_qx_host(n_events):
-    eps, _, _ = _qx_db(False, n_events, QX_CAPACITY)
+    eps, _, _, _ = _qx_db(False, n_events, QX_CAPACITY)
     return {"q5_q7_q8_sql_host": {"host_sql_eps": round(eps),
                                   "events": n_events}}
 

@@ -54,6 +54,11 @@ class DeviceConfig:
     # (device/__init__.py); RW_COMPILE_CACHE_DIR overrides either ("" in
     # the env disables). No-op on jax builds without the cache config.
     compile_cache_dir: Optional[str] = None
+    # epoch-timeline profiler (utils/profile.py): per-epoch phase-split
+    # spans (host-pack / dispatch / device-sync / commit), compile-event
+    # timing, and the rw_epoch_profile / rw_fused_node_stats surfaces.
+    # Costs a few perf_counter reads per epoch; off removes even that.
+    profile: bool = True
 
 
 @dataclass
@@ -96,6 +101,11 @@ class RobustnessConfig:
     # escalating to RemoteWorkerDied (full job recovery)
     respawn_attempts: int = 3
     respawn_backoff_s: float = 0.05
+    # metrics plane: a worker whose last heartbeat frame (piggybacked on
+    # its result stream) is older than this is flagged WEDGED in
+    # rw_worker_liveness / worker_liveness — alive-but-stuck detection
+    # ahead of the spawn/drain deadlines (it observes; it never kills)
+    heartbeat_timeout_s: float = 60.0
 
     @classmethod
     def from_env(cls) -> "RobustnessConfig":
@@ -154,7 +164,8 @@ class NodeConfig:
             for k in dev:
                 if k not in ("capacity", "minmax", "fuse",
                              "mv_persist_every", "predictive_growth",
-                             "hbm_budget_mb", "compile_cache_dir"):
+                             "hbm_budget_mb", "compile_cache_dir",
+                             "profile"):
                     raise ValueError(f"unknown config key [device] {k!r}")
             base = resolve_device(
                 int(mode) if isinstance(mode, str) and mode.isdigit()

@@ -19,7 +19,15 @@ Commands:
     history                   retained manifest versions (time travel)
     trace [--last N]          per-barrier span summary; flags OPEN
                               (stalled) epochs with the stuck job —
-                              works on a LIVE or wedged data dir
+                              works on a LIVE or wedged data dir;
+                              --stuck-only drops committed epochs so
+                              stalls survive fresh committed traffic
+    profile [JOB]             fused-job epoch timeline from
+                              epoch_profile.jsonl: phase totals
+                              (host-pack / dispatch / device-sync /
+                              commit), compile events, top-N slowest
+                              epochs (JSON) — decompose warmup vs
+                              steady state without rerunning anything
     failpoints [--spec S]     list declared fault-injection points and
                               which the spec (default: $RW_FAILPOINTS)
                               arms; --arm validates a spec and prints
@@ -170,7 +178,25 @@ def cmd_trace(args) -> int:
     if not os.path.exists(path):
         print("no barrier trace (directory has no barrier_trace.jsonl)")
         return 1
-    print(diagnose(path, last=args.last))
+    print(diagnose(path, last=args.last, stuck_only=args.stuck_only))
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Offline epoch-profile summary (the fused-path flame-graph-lite):
+    reads epoch_profile.jsonl without opening the Database — same
+    wedged-process contract as `trace`."""
+    from ..utils.profile import PROFILE_FILE, summarize_file
+    path = os.path.join(args.data_dir, PROFILE_FILE)
+    if not os.path.exists(path):
+        print("no epoch profile (directory has no epoch_profile.jsonl — "
+              "fused jobs write it when DeviceConfig.profile is on)")
+        return 1
+    out = summarize_file(path, job=args.job, top=args.top)
+    if args.job is not None and not out:
+        print(f"no profile records for job {args.job!r}")
+        return 1
+    print(json.dumps(out, indent=2, sort_keys=True))
     return 0
 
 
@@ -269,7 +295,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("trace")
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--last", type=int, default=5)
+    sp.add_argument("--stuck-only", action="store_true",
+                    help="print only OPEN (uncommitted) epochs")
     sp.set_defaults(fn=cmd_trace)
+    sp = sub.add_parser("profile")
+    sp.add_argument("job", nargs="?", default=None)
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--top", type=int, default=10,
+                    help="slowest epochs to list per job")
+    sp.set_defaults(fn=cmd_profile)
     sp = sub.add_parser("backup")
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--dest", required=True)

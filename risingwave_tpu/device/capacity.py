@@ -58,6 +58,23 @@ def project(need: int, events_seen: int, horizon: Optional[int],
     return need * UNBOUNDED_STEP
 
 
+def node_hbm_bytes(node) -> int:
+    """Allocated HBM bytes of one node's declared capacity slots (the
+    declarative interface: cap_current x cap_bytes). 0 for stateless
+    nodes."""
+    cur = node.cap_current()
+    if not cur:
+        return 0
+    bpe = node.cap_bytes()
+    return sum(c * bpe.get(s, 0) for s, c in cur.items())
+
+
+def hbm_footprint(nodes) -> int:
+    """Total allocated HBM bytes across a program's nodes — the numerator
+    of the rw_hbm_budget_utilization gauge (denominator: hbm_budget_mb)."""
+    return sum(node_hbm_bytes(n) for n in nodes)
+
+
 def predict_capacity(need: int, current: int, events_seen: int = 0,
                      horizon: Optional[int] = None, lo: int = 256) -> int:
     """Bucketed growth target for one standalone state (the per-operator

@@ -488,7 +488,8 @@ def try_fuse(execu, ns, device_cfg, name: str,
                         predictive=getattr(device_cfg,
                                            "predictive_growth", True),
                         hbm_budget_mb=getattr(device_cfg,
-                                              "hbm_budget_mb", 4096))
+                                              "hbm_budget_mb", 4096),
+                        profile=getattr(device_cfg, "profile", True))
     except FuseReject:
         return None
 

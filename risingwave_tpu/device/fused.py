@@ -84,6 +84,13 @@ _register_delta()
 NUM = ("num",)
 
 
+def _nrows(mask):
+    """Device row count of a boolean mask (profiler stats: one scalar in
+    the existing stats vector, no extra sync)."""
+    import jax.numpy as jnp
+    return jnp.sum(mask, dtype=jnp.int64)
+
+
 # ---------------------------------------------------------------------------
 # lossless key packing
 # ---------------------------------------------------------------------------
@@ -204,6 +211,10 @@ class Node:
     """
     inputs: Tuple[int, ...] = ()
     stat_names: Tuple[str, ...] = ()
+    # subset of stat_names that accumulate across epochs by SUM (row-flow
+    # counters); everything else accumulates by MAX (capacity needs,
+    # violation flags). The job's stats accumulator honors this split.
+    stat_sums: Tuple[str, ...] = ()
     takes_event_lo: bool = False
 
     def init_state(self):
@@ -282,6 +293,8 @@ class SourceNode(Node):
     """On-device exact Nexmark/datagen events for this epoch's id range."""
 
     takes_event_lo = True
+    stat_names = ("rows_out",)
+    stat_sums = ("rows_out",)
 
     def __init__(self, table: str, gencfg, col_names: Sequence[str],
                  rowid_pos: Optional[int], max_events: Optional[int],
@@ -321,7 +334,7 @@ class SourceNode(Node):
         cols = [ids if i == self.rowid_pos else all_cols[nm]
                 for i, nm in enumerate(self.col_names)]
         d = Delta(cols, jnp.ones(ids.shape, jnp.int32), mask, pk=ids)
-        return state, d, [], None
+        return state, d, [_nrows(mask)], None
 
 
 class MapNode(Node):
@@ -342,6 +355,9 @@ class MapNode(Node):
 
 
 class FilterNode(Node):
+    stat_names = ("rows_out",)
+    stat_sums = ("rows_out",)
+
     def __init__(self, input: int, pred: Any):
         self.inputs = (input,)
         self.pred = pred
@@ -353,13 +369,16 @@ class FilterNode(Node):
         d = ins[0]
         ok, valid = self.pred.eval_device(d.cols)
         out = Delta(d.cols, d.sign, d.mask & ok & valid, pk=d.pk, pk2=d.pk2)
-        return state, out, [], None
+        return state, out, [_nrows(out.mask)], None
 
 
 class HopNode(Node):
     """Row -> size/hop windowed copies, appending window_start/window_end
     (`HopWindowExecutor` / TUMBLE when hop == size). Row identity extends
     with the window ordinal so each copy stays unique."""
+
+    stat_names = ("rows_out",)
+    stat_sums = ("rows_out",)
 
     def __init__(self, input: int, time_col: int, hop_usecs: int,
                  size_usecs: int):
@@ -385,7 +404,7 @@ class HopNode(Node):
         cols = [rep(c) for c in d.cols] + [starts, starts + self.size]
         pk = rep(d.pk) * n + k if d.pk is not None else None
         out = Delta(cols, rep(d.sign), rep(d.mask), pk=pk)
-        return state, out, [], None
+        return state, out, [_nrows(out.mask)], None
 
 
 class ChainNode(Node):
@@ -395,6 +414,9 @@ class ChainNode(Node):
     elimination — a source column no downstream expression reads is never
     materialized to HBM (the datagen of q4's 5 unused bid columns folds
     away entirely)."""
+
+    stat_names = ("rows_out",)
+    stat_sums = ("rows_out",)
 
     def __init__(self, chain: List[Node], inputs: Tuple[int, ...]):
         self.chain = list(chain)
@@ -411,7 +433,7 @@ class ChainNode(Node):
             node_ins = ins if i == 0 else [out]
             _, out, _, _ = n.apply(None, node_ins,
                                    extra if i == 0 else None, epoch_events)
-        return None, out, [], None
+        return None, out, [_nrows(out.mask)], None
 
 
 _CHAINABLE = ()          # filled below once all node classes exist
@@ -490,7 +512,8 @@ class AggNode(Node):
         self.emit_out = True
         self.stat_names = tuple(["needed", "touched"]
                                 + [f"ms{i}" for i in range(len(spec.minputs))]
-                                + ["packbad"])
+                                + ["packbad", "rows_in", "rows_out"])
+        self.stat_sums = ("rows_in", "rows_out")
 
     def init_state(self):
         from .agg_step import DeviceAggState
@@ -583,6 +606,7 @@ class AggNode(Node):
         new_state, _needed, ch = epoch_core_full(
             self.spec, state, keys, d.sign, d.mask, tuple(inputs))
         needed, ms_needed = _needed
+        rows_in = _nrows(d.mask & (d.sign != 0))
         stats_tail = [m.astype(jnp.int64) for m in ms_needed]
         if not self.emit_out:
             # terminal agg: only the MV apply reads the change set — keep
@@ -594,8 +618,12 @@ class AggNode(Node):
                 sub = ch[f"minput{mi}"]
                 aux[f"minput{mi}"] = {k: sub[k] for k in
                                      ("new_found", "new_min", "new_max")}
+            # no delta stream is materialized: rows_out counts the change
+            # set the terminal MV applies (upserts + deletes)
+            rows_out = _nrows(ch["old_found"] | ch["new_found"])
             stats = [needed.astype(jnp.int64),
-                     ch["count"].astype(jnp.int64)] + stats_tail + [packbad]
+                     ch["count"].astype(jnp.int64)] + stats_tail \
+                + [packbad, rows_in, rows_out]
             return new_state, None, stats, aux
         # ---- change stream: old rows (-1) then new rows (+1) ------------
         old_found, new_found = ch["old_found"], ch["new_found"]
@@ -634,7 +662,8 @@ class AggNode(Node):
             packbad = packbad | self.pk_pack.check(cols, mask)
         out = Delta(cols, sign, mask, pk=pk)
         stats = [needed.astype(jnp.int64),
-                 ch["count"].astype(jnp.int64)] + stats_tail + [packbad]
+                 ch["count"].astype(jnp.int64)] + stats_tail \
+            + [packbad, rows_in, _nrows(mask)]
         return new_state, out, stats, ch
 
 
@@ -656,7 +685,9 @@ class JoinNode(Node):
         self.m = pair_capacity
         self.l_val_dtypes = list(l_val_dtypes)
         self.r_val_dtypes = list(r_val_dtypes)
-        self.stat_names = ("need_a", "need_b", "need_pairs", "packbad")
+        self.stat_names = ("need_a", "need_b", "need_pairs", "packbad",
+                           "rows_in", "rows_out")
+        self.stat_sums = ("rows_in", "rows_out")
 
     def init_state(self):
         from .join_step import make_side
@@ -740,9 +771,12 @@ class JoinNode(Node):
             ok, valid = self.cond.eval_device(ocols)
             omask = omask & ok & valid
         out = Delta(ocols, nsign, omask, pk=njk, pk2=npk)
+        rows_in = _nrows(A.mask & (A.sign != 0)) \
+            + _nrows(B.mask & (B.sign != 0))
         stats = [needed["a"].astype(jnp.int64),
                  needed["b"].astype(jnp.int64),
-                 needed["pairs"].astype(jnp.int64), packbad]
+                 needed["pairs"].astype(jnp.int64), packbad,
+                 rows_in, _nrows(omask)]
         return (new_a, new_b), out, stats, None
 
 
@@ -754,7 +788,8 @@ class MVKeyedNode(Node):
         self.inputs = (input,)
         self.agg = agg_node
         self.capacity = capacity
-        self.stat_names = ("needed",)
+        self.stat_names = ("needed", "rows_in")
+        self.stat_sums = ("rows_in",)
 
     def init_state(self):
         from .materialize import make_mv_state
@@ -799,7 +834,8 @@ class MVKeyedNode(Node):
             [o.astype(v.dtype) for o, v in
              zip(outs, [state.vals[1 + 2 * i] for i in range(len(outs))])],
             nulls)
-        return state, None, [needed.astype(jnp.int64)], None
+        return state, None, [needed.astype(jnp.int64),
+                             _nrows(upsert | delete)], None
 
 
 class MVPairNode(Node):
@@ -810,7 +846,8 @@ class MVPairNode(Node):
         self.inputs = (input,)
         self.val_dtypes = list(val_dtypes)
         self.capacity = capacity
-        self.stat_names = ("needed",)
+        self.stat_names = ("needed", "rows_in")
+        self.stat_sums = ("rows_in",)
 
     def init_state(self):
         from .join_step import make_side
@@ -846,7 +883,8 @@ class MVPairNode(Node):
         vals = tuple(c if jnp.issubdtype(c.dtype, jnp.floating)
                      else c.astype(jnp.int64) for c in d.cols)
         state, needed = merge_side(state, d.pk, d.pk2, sign, vals)
-        return state, None, [needed.astype(jnp.int64)], None
+        return state, None, [needed.astype(jnp.int64),
+                             _nrows(sign != 0)], None
 
 
 # HopNode stays un-chained: fusing the 5x window expansion into the
@@ -890,14 +928,38 @@ class FusedProgram:
         for i, n in enumerate(self.nodes):
             for s in n.stat_names:
                 self.stat_layout.append((i, s))
+        # which stats_acc slots accumulate by SUM (row-flow counters) vs
+        # MAX (capacity needs / violation flags) — see Node.stat_sums
+        self._sum_mask = np.array(
+            [name in self.nodes[ni].stat_sums
+             for ni, name in self.stat_layout] or [False], dtype=bool)
+        # epoch profiler (utils/profile.py), attached by the owning
+        # FusedJob; None (or disabled) = zero per-node instrumentation
+        self.profiler = None
 
     def init_states(self):
         return tuple(n.init_state() for n in self.nodes)
 
+    def _node_label(self, i: int) -> str:
+        """Compile-event label: program position + structural signature —
+        two programs sharing a node signature share its compile, and the
+        label makes that dedupe visible in the warmup decomposition."""
+        n = self.nodes[i]
+        return f"{i}:{type(n).__name__}:{hash(n) & 0xFFFFFFFF:08x}"
+
     def epoch(self, states, event_lo):
         """Host loop over per-node jitted steps: each call dispatches
-        async; only device-array handles flow between nodes."""
+        async; only device-array handles flow between nodes. With a live
+        profiler, each step is wall-timed: a step flagged as pending (cold
+        start / post-growth) or blocking past the compile threshold is
+        recorded as a compile/retrace event — dispatch is async, so a
+        blocking step call IS trace+compile time."""
         import jax.numpy as jnp
+        from ..utils.profile import COMPILE_THRESHOLD_S
+        import time as _time
+        prof = self.profiler
+        if prof is not None and not prof.enabled:
+            prof = None
         outs: List[Optional[Delta]] = []
         auxes: List[Any] = []
         new_states = list(states)
@@ -911,8 +973,16 @@ class FusedProgram:
                 extra = auxes[node.inputs[0]]
             else:
                 extra = None
+            if prof is not None:
+                t0 = _time.perf_counter()
             st, out, s, aux = _node_step(node, self.epoch_events,
                                          states[i], ins, extra)
+            if prof is not None:
+                dt = _time.perf_counter() - t0
+                kind = prof.pending_compile.pop(i, None)
+                if kind is not None or dt > COMPILE_THRESHOLD_S:
+                    prof.compile_event(self._node_label(i), dt,
+                                       kind=kind or "retrace")
             new_states[i] = st
             outs.append(out)
             auxes.append(aux)
@@ -922,14 +992,19 @@ class FusedProgram:
         return tuple(new_states), vec
 
     def step_fn(self):
-        """(states, event_lo, stats_acc) -> (states', max(stats_acc, vec)).
-        A host closure — per-node jits re-trace on their own when a grown
-        node's shapes change; ungrown nodes keep their compiled steps."""
+        """(states, event_lo, stats_acc) -> (states', combine(stats_acc,
+        vec)) where capacity/flag slots combine by max and row-flow
+        counters by sum (`_sum_mask`). A host closure — per-node jits
+        re-trace on their own when a grown node's shapes change; ungrown
+        nodes keep their compiled steps."""
         import jax.numpy as jnp
+        sum_mask = jnp.asarray(self._sum_mask)
 
         def step(states, event_lo, stats_acc):
             new_states, vec = self.epoch(states, event_lo)
-            return new_states, jnp.maximum(stats_acc, vec)
+            acc = jnp.where(sum_mask, stats_acc + vec,
+                            jnp.maximum(stats_acc, vec))
+            return new_states, acc
 
         return step
 
@@ -982,10 +1057,18 @@ class FusedJob:
                  mv_state_table=None, job_state_table=None,
                  mv_schema_len: Optional[int] = None,
                  persist_every: int = 1,
-                 predictive: bool = True, hbm_budget_mb: int = 4096):
+                 predictive: bool = True, hbm_budget_mb: int = 4096,
+                 profile: bool = True):
         import jax.numpy as jnp
+        from ..utils.profile import JobProfiler
         self.name = name
         self.program = program
+        # epoch-timeline profiler: phase-split spans + compile events
+        # (utils/profile.py). Every node's first step is a cold compile.
+        self.profiler = JobProfiler(name, enabled=profile)
+        self.profiler.pending_compile = {
+            i: "compile" for i in range(len(program.nodes))}
+        program.profiler = self.profiler
         # node indices predate the chain transform — remap through it
         pull.node_idx = program.remap.get(pull.node_idx, pull.node_idx)
         self.pull = pull
@@ -1021,6 +1104,11 @@ class FusedJob:
         self.stats_acc = self._zero_stats
         self._step = program.step_fn()
         self._persisted: Dict[Tuple, Tuple] = {}
+        # last device-pulled stats vector (sync) + job-lifetime totals
+        # (sum slots accumulate, max slots high-water — _accum_totals):
+        # the rw_fused_node_stats / node_report substrate
+        self._last_stats = np.zeros(len(self.stats_acc), np.int64)
+        self._stat_totals = np.zeros(len(self.stats_acc), np.int64)
 
     # ---- barrier protocol ----------------------------------------------
     @property
@@ -1030,12 +1118,37 @@ class FusedJob:
 
     def on_barrier(self, barrier) -> None:
         import jax.numpy as jnp
+        import time as _time
+        # no span for post-drain barriers: a drained job keeps seeing
+        # ticks forever, and zero-event records would evict the real
+        # epoch history from the profile ring (sync/commit at a
+        # post-drain checkpoint still lands in the phase totals)
+        prof = self.profiler if self.profiler.enabled \
+            and not self.drained else None
+        if prof is not None:
+            prof.begin_epoch(self.counter, self.program.epoch_events)
         if not self.drained:
+            t0 = _time.perf_counter() if prof is not None else 0.0
+            lo = jnp.int64(self.counter)
+            if prof is not None:
+                t1 = _time.perf_counter()
+                prof.phase("host_pack", t1 - t0)
+                t0 = t1
             self.states, self.stats_acc = self._step(
-                self.states, jnp.int64(self.counter), self.stats_acc)
+                self.states, lo, self.stats_acc)
+            if prof is not None:
+                prof.phase("dispatch", _time.perf_counter() - t0)
             self.counter += self.program.epoch_events
         if barrier.is_checkpoint:
             self._checkpoint(barrier.epoch.curr)
+        if prof is not None:
+            prof.end_epoch()
+        if self.profiler.enabled and barrier.is_checkpoint:
+            # flush AFTER end_epoch so the checkpoint epoch's own record
+            # (the one carrying device_sync/commit splits) reaches the
+            # jsonl now, not one checkpoint later — `risectl profile`
+            # against a wedged process must see the newest checkpoint
+            self.profiler.flush()
 
     # ---- sync / growth / replay ----------------------------------------
     def _dispatch_range(self, lo: int, hi: int) -> None:
@@ -1097,10 +1210,23 @@ class FusedJob:
 
     def sync(self) -> None:
         """Block; verify stats; grow + replay from snapshot when any state
-        overflowed its static capacity."""
+        overflowed its static capacity. The blocking device_get is the
+        epoch timeline's `device_sync` phase: it covers every epoch
+        dispatched since the last sync (growth replays included)."""
+        import time as _time
+        prof = self.profiler if self.profiler.enabled else None
+        t_sync = _time.perf_counter() if prof is not None else 0.0
+        try:
+            self._sync_inner()
+        finally:
+            if prof is not None:
+                prof.phase("device_sync", _time.perf_counter() - t_sync)
+
+    def _sync_inner(self) -> None:
         import jax
         while True:
             vec = np.asarray(jax.device_get(self.stats_acc))
+            self._last_stats = vec
             for k, (ni, nm) in enumerate(self.program.stat_layout):
                 if nm == "packbad" and vec[k] != 0:
                     raise RuntimeError(
@@ -1126,6 +1252,10 @@ class FusedJob:
                 if grown:
                     self.retraces += 1
                     self.growths += len(grown)
+                    # the grown node's next step call re-traces: flag it so
+                    # the profiler attributes that wall to compile, not
+                    # steady-state dispatch
+                    self.profiler.pending_compile[i] = "retrace"
                     new_states.append(node.cap_resize(snap_states[i],
                                                       grown))
                 else:
@@ -1153,7 +1283,18 @@ class FusedJob:
         return rows
 
     def _checkpoint(self, epoch: int) -> None:
+        import time as _time
         self.sync()
+        # fold the checkpoint window's stats into job-lifetime totals
+        # BEFORE the accumulator resets (sum slots add, max slots
+        # high-water — mirrors the device-side combine). Unconditional:
+        # the vector was pulled by the sync regardless, and the
+        # rw_fused_node_stats surface must stay truthful with the
+        # profiler off
+        self._accum_totals(self._last_stats)
+        prof = self.profiler if self.profiler.enabled else None
+        if prof is not None:
+            t0 = _time.perf_counter()
         due = self.counter != self._last_persist and (
             self.drained
             or self.counter - max(0, self._last_persist)
@@ -1173,6 +1314,9 @@ class FusedJob:
                     dirty = True
             if dirty:
                 self.job_state_table.commit(epoch)
+        if prof is not None:
+            self._export_hbm_gauges()
+            prof.phase("commit", _time.perf_counter() - t0)
         self.snapshot = (self.states, self.counter)
         self.stats_acc = self._zero_stats
         self.committed = self.counter
@@ -1272,6 +1416,64 @@ class FusedJob:
             self._persisted = {tuple(r): None
                                for r in self.mv_state_table.iter_all()}
         self._last_persist = -1     # mirror may be stale: refresh next ckpt
+
+    # ---- profiler / metrics surfaces -------------------------------------
+    def _accum_totals(self, vec: np.ndarray) -> None:
+        sm = self.program._sum_mask
+        if len(vec) != len(self._stat_totals):
+            return                      # defensive: layout mismatch
+        self._stat_totals = np.where(sm, self._stat_totals + vec,
+                                     np.maximum(self._stat_totals, vec))
+
+    def _export_hbm_gauges(self) -> None:
+        """rw_hbm_bytes{job,node} + budget utilization: the HBM footprint
+        the capacity lifecycle actually allocated, checkpoint-fresh."""
+        from ..utils.metrics import REGISTRY
+        from .capacity import node_hbm_bytes
+        g = REGISTRY.gauge("rw_hbm_bytes",
+                           "fused per-node device state bytes",
+                           labels=("job", "node"))
+        total = 0
+        for i, node in enumerate(self.program.nodes):
+            if not node.cap_current():
+                continue
+            nbytes = node_hbm_bytes(node)
+            g.labels(self.name, f"{i}:{type(node).__name__}").set(nbytes)
+            total += nbytes
+        REGISTRY.gauge("rw_hbm_budget_utilization",
+                       "fused job HBM footprint over hbm_budget_mb",
+                       labels=("job",)).labels(self.name).set(
+            total / float(self.hbm_budget_mb << 20))
+
+    def node_report(self) -> List[Tuple]:
+        """Per-node/per-slot attribution rows (rw_fused_node_stats):
+        (node, type, slot, rows_in, rows_out, entries, capacity,
+        occupancy, hbm_mb, overflowed). Row counters are job-lifetime
+        sums; `entries` is the slot's high-water observed need — all of
+        it from the stats vector the regular syncs already pull, no extra
+        device traffic."""
+        out: List[Tuple] = []
+        totals = self._stat_totals
+        for i, node in enumerate(self.program.nodes):
+            st = self.program.node_stats(i, totals)
+            rows_in = st.get("rows_in", 0)
+            rows_out = st.get("rows_out", 0)
+            cur = node.cap_current()
+            tname = type(node).__name__
+            if not cur:
+                out.append((i, tname, "-", rows_in, rows_out,
+                            0, 0, 0.0, 0.0, False))
+                continue
+            bpe = node.cap_bytes()
+            needs = node.cap_needs(st)
+            for s in sorted(cur):
+                cap = cur[s]
+                entries = needs.get(s, 0)
+                out.append((i, tname, s, rows_in, rows_out, entries, cap,
+                            entries / cap if cap else 0.0,
+                            cap * bpe.get(s, 0) / float(1 << 20),
+                            entries > cap))
+        return out
 
     # ---- capacity introspection -----------------------------------------
     def cap_report(self) -> Dict[str, Any]:

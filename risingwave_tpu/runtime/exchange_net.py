@@ -22,18 +22,25 @@ Frames: u32 big-endian length, 1 tag byte, body.
   C chunk      u16 nrows, nrows x (u8 op, u32 len, value-encoded row)
   B barrier    u64 curr, u64 prev, u8 kind, u8 mutation
   W watermark  u16 col_idx, u8 type_kind, u32 len, value-encoded datum
+  M metrics    JSON {pid, ts, epoch, m: registry delta} — the cluster
+               metrics plane: workers piggyback registry deltas and a
+               heartbeat on their result stream; permit-exempt like
+               barriers (observability must not be backpressured away)
   P permits    u32 n                (receiver -> sender)
   H hello      u16 channel_id       (receiver -> sender, once)
   E eos
 """
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, Iterator, List, Optional, Sequence, Tuple
+from dataclasses import dataclass, field
+from typing import (Any, Deque, Dict, Iterator, List, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -56,6 +63,18 @@ declare("exchange.send_frame",
         "drop the connection on a frame send (mid-stream write fault)")
 declare("exchange.recv_frame",
         "drop the connection on a frame receive (mid-stream read fault)")
+
+@dataclass
+class MetricsFrame:
+    """Worker -> coordinator metrics/heartbeat piggyback (M frame). Not a
+    dataflow Message: the coordinator's result drain consumes it (registry
+    merge + heartbeat timestamp) and never forwards it downstream. An
+    empty payload is still a valid heartbeat."""
+    pid: int
+    ts: float                               # sender wall clock
+    epoch: Optional[int] = None             # last completed result epoch
+    payload: Dict[str, Any] = field(default_factory=dict)
+
 
 # stable wire ids for the string-valued enums
 _MUT = {None: 0, MutationKind.STOP: 1, MutationKind.PAUSE: 2,
@@ -232,6 +251,10 @@ def encode_message(msg: Message, dtypes: Sequence[DataType]
         datum = encode_value_datum(msg.value, msg.dtype)
         return b"W", struct.pack(">HBI", msg.col_idx,
                                  _TKIND[msg.dtype.kind], len(datum)) + datum
+    if isinstance(msg, MetricsFrame):
+        return b"M", json.dumps({"pid": msg.pid, "ts": msg.ts,
+                                 "epoch": msg.epoch,
+                                 "m": msg.payload}).encode()
     raise TypeError(f"cannot encode {type(msg).__name__}")
 
 
@@ -250,6 +273,10 @@ def decode_message(tag: bytes, body: bytes, dtypes: Sequence[DataType]
         dt = DataType(_TKIND_INV[kind])
         v, _ = decode_value_datum(body[7:7 + ln], 0, dt)
         return Watermark(col_idx, dt, v)
+    if tag == b"M":
+        d = json.loads(body.decode())
+        return MetricsFrame(d.get("pid", 0), d.get("ts", 0.0),
+                            d.get("epoch"), d.get("m") or {})
     raise ValueError(f"unknown frame {tag!r}")
 
 

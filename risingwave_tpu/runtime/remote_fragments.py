@@ -36,7 +36,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..config import ROBUSTNESS
 from ..core.chunk import Op, StreamChunk
@@ -47,7 +47,7 @@ from ..ops.executor import Executor
 from ..ops.message import Barrier
 from ..utils.failpoint import declare, failpoint
 from ..utils.metrics import REGISTRY
-from .exchange_net import ExchangeServer, RemoteInput
+from .exchange_net import ExchangeServer, MetricsFrame, RemoteInput
 
 declare("fragment.spawn",
         "fail one worker spawn attempt (startup retry seam)")
@@ -292,6 +292,8 @@ class FragmentSupervisor:
             ch_out.closed = False
             ch_out.cv.notify_all()
         s.workers[i] = nw
+        s.heartbeats[i] = time.time()    # fresh liveness window
+        s._wedged[i] = False
         s._start_drain(i)
         self.respawns += 1
         REGISTRY.counter("supervisor_respawns_total",
@@ -317,6 +319,11 @@ class _RemoteSetBase:
         self._next_cid = 1 + max(
             (p.get("in_channel_r", p["in_channel"]) for p in self.plans),
             default=-1)
+        # metrics plane: per-slot last-heartbeat wall clock (workers
+        # piggyback M frames on their result streams; the drains stamp
+        # these) — the substrate of worker_liveness / rw_worker_liveness
+        self.heartbeats = [time.time()] * len(self.workers)
+        self._wedged = [False] * len(self.workers)
         self.supervisor = FragmentSupervisor(self) if supervise else None
         # dispatched-barrier log (supervised single-input sets): the
         # respawn protocol replays every barrier a dead worker never
@@ -379,6 +386,18 @@ class _RemoteSetBase:
             for msg in inp.execute():
                 if failpoint("fragment.drain"):
                     raise ConnectionError("failpoint fragment.drain")
+                if isinstance(msg, MetricsFrame):
+                    # metrics plane piggyback: fold the worker's registry
+                    # delta into the coordinator's global registry under a
+                    # `worker` label, stamp the heartbeat, and DON'T
+                    # forward (observability is not dataflow)
+                    if ch.gen == gen:
+                        self.heartbeats[i] = time.time()
+                        if msg.payload:
+                            REGISTRY.merge_remote(
+                                msg.payload,
+                                worker=f"{self.kind}{i}/{msg.pid}")
+                    continue
                 if isinstance(msg, Barrier):
                     if atomic:
                         # one lock-held append, no capacity waits: a
@@ -410,10 +429,46 @@ class _RemoteSetBase:
                 ch.close()
 
     # ---- liveness -------------------------------------------------------
+    def liveness_rows(self, job: str) -> List[Tuple]:
+        """(job, worker, pid, last_epoch, heartbeat_age_s, state) per
+        slot — the rw_worker_liveness rows. `wedged?` = process alive but
+        no heartbeat frame within RW_HEARTBEAT_TIMEOUT_S: the
+        stuck-not-dead failure mode the spawn/drain deadlines only catch
+        much later."""
+        now = time.time()
+        out = []
+        for i, w in enumerate(self.workers):
+            age = now - self.heartbeats[i]
+            if w.proc.poll() is not None:
+                state = "dead"
+            elif age > ROBUSTNESS.heartbeat_timeout_s:
+                state = "wedged?"
+            else:
+                state = "ok"
+            out.append((job, f"{self.kind}{i}", w.proc.pid,
+                        -1 if w.last_epoch is None else w.last_epoch,
+                        age, state))
+        return out
+
+    def _check_wedged(self) -> None:
+        """Count ok->wedged transitions (alive process, stale heartbeat —
+        the liveness_rows predicate) so dashboards see the stall even if
+        the worker later recovers."""
+        for i, row in enumerate(self.liveness_rows("")):
+            stale = row[5] == "wedged?"
+            if stale and not self._wedged[i]:
+                REGISTRY.counter(
+                    "worker_wedged_suspect_total",
+                    "workers whose heartbeat went stale while the "
+                    "process stayed alive").inc()
+            self._wedged[i] = stale
+
     def check_alive(self) -> None:
         """Polled by the merge idle loop and the Database heartbeat
         sweep. Supervised sets self-heal (or escalate); unsupervised
-        sets raise so job-level recovery can run."""
+        sets raise so job-level recovery can run. Either way the wedged
+        sweep runs first — it observes, it never kills."""
+        self._check_wedged()
         if self.supervisor is not None:
             self.supervisor.check()
             return

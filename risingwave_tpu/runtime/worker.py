@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 from typing import Any, Dict, Iterator, List
 
 from ..core.schema import Field, Schema
@@ -34,7 +35,8 @@ from ..expr.expression import InputRef
 from ..ops import HashAggExecutor
 from ..state import MemoryStateStore, StateTable
 from ..utils.failpoint import declare, failpoint
-from .exchange_net import ExchangeServer, RemoteInput
+from ..utils.metrics import REGISTRY
+from .exchange_net import ExchangeServer, MetricsFrame, RemoteInput
 
 declare("worker.crash",
         "hard-kill the worker process mid-stream (os._exit per message)")
@@ -101,6 +103,16 @@ def _refresh_chunks(execu) -> Iterator[Any]:
 def main(argv: List[str]) -> int:
     plan = json.loads(argv[0])
     host, port = plan["coord"]
+    kind = plan.get("fragment", {}).get("kind", "?")
+    # worker-local metric families; the coordinator's drain merges them
+    # into its global registry under an extra `worker` label (the cluster
+    # metrics plane), so they show up in one cluster-wide expose()
+    m_epochs = REGISTRY.counter("worker_epochs_total",
+                                "result epochs this worker completed",
+                                labels=("fragment",)).labels(kind)
+    m_chunks = REGISTRY.counter("worker_chunks_total",
+                                "data chunks this worker emitted",
+                                labels=("fragment",)).labels(kind)
     upstream = RemoteInput((host, port), plan["in_channel"],
                            _schema(plan["in_schema"]),
                            append_only=plan.get("append_only", False))
@@ -114,6 +126,17 @@ def main(argv: List[str]) -> int:
     server = ExchangeServer()
     out = server.register(0, execu.schema.dtypes)
     print(f"ADDR {server.addr[0]} {server.addr[1]}", flush=True)
+    # metrics plane piggyback: registry DELTAS + a heartbeat frame ride
+    # the result stream after every barrier (and once at startup, so
+    # liveness covers the backfill/seed window before the first barrier)
+    hb_state: Dict = {}
+
+    def heartbeat(epoch=None):
+        nonlocal hb_state
+        delta, hb_state = REGISTRY.dump_delta(hb_state)
+        out.send(MetricsFrame(os.getpid(), time.time(), epoch, delta))
+
+    heartbeat()
     # Recovery seeding: the coordinator replays shadowed state rows as
     # the first epoch; they rebuild this worker's fragment state but
     # their OUTPUTS are already in the downstream MV's recovered
@@ -124,6 +147,7 @@ def main(argv: List[str]) -> int:
     # _refresh_chunks) — the seed swallow above hides any changes the
     # dead predecessor never delivered, and the refresh re-states them.
     refresh = plan.get("refresh_after_seed", False)
+    from ..core.chunk import StreamChunk as _Chunk
     from ..ops.message import Barrier as _B
     try:
         for msg in execu.execute():
@@ -134,12 +158,19 @@ def main(argv: List[str]) -> int:
                     continue
                 suppress = False
                 out.send(msg)
+                m_epochs.inc()
+                heartbeat(msg.epoch.curr)
                 if refresh:
                     for chunk in _refresh_chunks(execu):
                         out.send(chunk)
                     refresh = False
                 continue
             out.send(msg)
+            if isinstance(msg, _B):
+                m_epochs.inc()
+                heartbeat(msg.epoch.curr)
+            elif isinstance(msg, _Chunk):
+                m_chunks.inc()
     except (ConnectionError, OSError):
         return 2          # coordinator gone: exit quietly, nothing to save
     finally:

@@ -155,6 +155,8 @@ class Database:
         # dir for offline hang diagnosis (risectl trace)
         from ..utils.trace import BarrierTracer
         self.tracer = BarrierTracer(data_dir)
+        # fused jobs mirror epoch-profile records here (risectl profile)
+        self._data_dir = data_dir
         self.injector = BarrierInjector(checkpoint_frequency)
         self.sinks: List[Tuple[str, Iterator[Message]]] = []   # job pumps
         self._iters: Dict[str, Iterator[Message]] = {}
@@ -600,6 +602,7 @@ class Database:
                                "upstream_subs": [], "fused_job": job}
                 self.catalog.create(obj)
                 self._fused[stmt.name] = job
+                job.profiler.attach(self._data_dir)
                 job.recover()      # no-op unless the store has a committed
                 return "CREATE_MATERIALIZED_VIEW"     # event counter
             # fallback: the plan stayed on the host/per-operator path, so
@@ -1043,13 +1046,10 @@ class Database:
         REGISTRY.gauge("streaming_jobs", "running dataflows"
                        ).set(len(self._iters))
 
-    def _heartbeat_workers(self) -> None:
-        """Proactive worker liveness sweep, once per barrier tick (the
-        meta heartbeat/expire analog, `src/meta/src/manager/cluster.rs`):
-        a worker that dies while its job is QUIESCENT surfaces at the
-        next tick instead of whenever traffic next touches its stream."""
-        from ..runtime.remote_fragments import RemoteWorkerDied
-        from ..utils.metrics import REGISTRY
+    def _remote_sets(self) -> Iterator[Tuple[str, Any]]:
+        """(job name, remote worker set) pairs across all live jobs — the
+        shared walk behind the liveness sweep, the worker_liveness gauge
+        and the rw_worker_liveness system table."""
         for obj in self.catalog.objects.values():
             rt = obj.runtime if isinstance(obj.runtime, dict) else None
             shared = rt.get("shared") if rt else None
@@ -1057,25 +1057,49 @@ class Database:
                 continue
             for e in _walk_executors(shared.upstream):
                 r = getattr(e, "_remote", None)
-                if r is None:
-                    continue
-                if getattr(r, "supervisor", None) is not None:
-                    # supervised sets self-heal (or escalate) in place —
-                    # the sweep is just an extra detection path for
-                    # deaths while the job is quiescent
-                    r.check_alive()
-                    continue
-                for w in r.workers:
-                    if w.proc.poll() is not None:
-                        REGISTRY.counter(
-                            "worker_heartbeat_failures",
-                            "dead workers caught by the heartbeat sweep"
-                            ).inc()
-                        raise RemoteWorkerDied(
-                            f"worker pid={w.proc.pid} of job "
-                            f"{obj.name!r} exited rc="
-                            f"{w.proc.returncode} (heartbeat sweep; "
-                            "restart the job — DDL replay rebuilds it)")
+                if r is not None:
+                    yield obj.name, r
+
+    def _worker_liveness_rows(self) -> List[Tuple]:
+        """rw_worker_liveness rows: per-worker heartbeat age + state (ok /
+        wedged? / dead) from the metrics-plane heartbeat frames."""
+        return [row for name, r in self._remote_sets()
+                for row in r.liveness_rows(name)]
+
+    def _heartbeat_workers(self) -> None:
+        """Proactive worker liveness sweep, once per barrier tick (the
+        meta heartbeat/expire analog, `src/meta/src/manager/cluster.rs`):
+        a worker that dies while its job is QUIESCENT surfaces at the
+        next tick instead of whenever traffic next touches its stream,
+        and a WEDGED worker (alive, heartbeat frames gone stale) shows in
+        the worker_liveness gauge before any spawn/drain deadline."""
+        from ..runtime.remote_fragments import RemoteWorkerDied
+        from ..utils.metrics import REGISTRY
+        liveness = REGISTRY.gauge(
+            "worker_liveness",
+            "seconds since a worker's last metrics-plane heartbeat",
+            labels=("job", "worker"))
+        for name, r in self._remote_sets():
+            for job, wname, _pid, _ep, age, _state in r.liveness_rows(name):
+                liveness.labels(job, wname).set(age)
+            if getattr(r, "supervisor", None) is not None:
+                # supervised sets self-heal (or escalate) in place —
+                # the sweep is just an extra detection path for
+                # deaths while the job is quiescent
+                r.check_alive()
+                continue
+            r._check_wedged()
+            for w in r.workers:
+                if w.proc.poll() is not None:
+                    REGISTRY.counter(
+                        "worker_heartbeat_failures",
+                        "dead workers caught by the heartbeat sweep"
+                        ).inc()
+                    raise RemoteWorkerDied(
+                        f"worker pid={w.proc.pid} of job "
+                        f"{name!r} exited rc="
+                        f"{w.proc.returncode} (heartbeat sweep; "
+                        "restart the job — DDL replay rebuilds it)")
 
     def metrics(self) -> str:
         """Prometheus text exposition (MonitorService analog)."""

@@ -81,7 +81,44 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("emitted", T.INT64), ("total", T.INT64),
                   ("progress", T.VARCHAR)),
         lambda db: _ddl_progress(db)),
+    # epoch-timeline profiler (utils/profile.py): one row per fused-job
+    # epoch with its phase split — host pack, async dispatch, blocking
+    # device sync, state-table commit (ring-buffered; the full history
+    # is in epoch_profile.jsonl / `risectl profile`)
+    "rw_epoch_profile": (
+        Schema.of(("job", T.VARCHAR), ("seq", T.INT64),
+                  ("events", T.INT64), ("host_pack_ms", T.FLOAT64),
+                  ("dispatch_ms", T.FLOAT64), ("device_sync_ms", T.FLOAT64),
+                  ("commit_ms", T.FLOAT64), ("wall_ms", T.FLOAT64)),
+        lambda db: _epoch_profile(db)),
+    # per-node attribution from the on-device stats vector: row flow,
+    # observed entries vs capacity (occupancy), allocated HBM
+    "rw_fused_node_stats": (
+        Schema.of(("job", T.VARCHAR), ("node", T.INT64),
+                  ("type", T.VARCHAR), ("slot", T.VARCHAR),
+                  ("rows_in", T.INT64), ("rows_out", T.INT64),
+                  ("entries", T.INT64), ("capacity", T.INT64),
+                  ("occupancy", T.FLOAT64), ("hbm_mb", T.FLOAT64),
+                  ("overflow", T.BOOLEAN)),
+        lambda db: _fused_node_stats(db)),
+    # metrics-plane worker heartbeats: age of the last M frame per
+    # remote worker; `wedged?` = alive process, stale heartbeat
+    "rw_worker_liveness": (
+        Schema.of(("job", T.VARCHAR), ("worker", T.VARCHAR),
+                  ("pid", T.INT64), ("last_epoch", T.INT64),
+                  ("heartbeat_age_s", T.FLOAT64), ("state", T.VARCHAR)),
+        lambda db: db._worker_liveness_rows()),
 }
+
+
+def _epoch_profile(db) -> List[Tuple]:
+    return [row for job in db._fused.values()
+            for row in job.profiler.rows()]
+
+
+def _fused_node_stats(db) -> List[Tuple]:
+    return [(name,) + row for name, job in db._fused.items()
+            for row in job.node_report()]
 
 
 def _ddl_progress(db) -> List[Tuple]:

@@ -1,0 +1,205 @@
+"""Epoch-timeline device profiler for fused jobs.
+
+StreamBox-HBM's lesson (arxiv 1901.01328) is that an HBM-resident
+streaming engine is only tunable with continuous phase/occupancy
+accounting; this module is that accounting for the fused execution path.
+Each epoch of a `FusedJob` is one phase-split span:
+
+  host_pack    — building the epoch's host-side inputs (event cursor)
+  dispatch     — the async per-node jit dispatch loop (no device sync)
+  device_sync  — blocking on the device (`jax.device_get` of stats_acc at
+                 a checkpoint/SELECT — covers ALL device compute enqueued
+                 since the last sync, growth replays included)
+  commit       — MV mirror diff + job-state-table rows at a checkpoint
+
+Non-checkpoint epochs only carry host_pack+dispatch (their device work is
+paid for by the next sync — that asymmetry is the async-dispatch design,
+and exactly what the profiler exists to make visible). Compile/retrace
+events are timed separately and labeled by node signature so warmup time
+is decomposable from steady state.
+
+Records land in a memory ring (the `rw_epoch_profile` system table) AND —
+when a data directory is attached — in `epoch_profile.jsonl`, appended at
+checkpoints so `risectl profile` works offline against any data dir, the
+same contract as `barrier_trace.jsonl`. Overhead when enabled is a few
+`perf_counter` calls per epoch plus two per node; `DeviceConfig.profile=
+False` removes even that.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+PROFILE_FILE = "epoch_profile.jsonl"
+_MAX_FILE_BYTES = 4 << 20
+PHASES = ("host_pack", "dispatch", "device_sync", "commit")
+# a per-node step call slower than this is recorded as a compile/retrace
+# even when the profiler did not expect one (catches shape changes that
+# arrived through a path growth accounting doesn't flag)
+COMPILE_THRESHOLD_S = 0.25
+RING = 512
+
+
+class JobProfiler:
+    """Per-FusedJob epoch profiler. All methods are cheap no-ops when
+    `enabled` is False; callers guard their own perf_counter reads on
+    `enabled` so a disabled profiler costs one attribute load per epoch."""
+
+    def __init__(self, job: str, enabled: bool = True):
+        self.job = job
+        self.enabled = enabled
+        self.ring: deque = deque(maxlen=RING)
+        self.compiles: deque = deque(maxlen=256)   # (label, kind, seconds)
+        self.path: Optional[str] = None
+        self._f = None
+        self._buf: List[Dict[str, Any]] = []
+        self._cur: Optional[Dict[str, Any]] = None
+        self.epochs = 0
+        self.totals = {p: 0.0 for p in PHASES}
+        # node index -> reason ("compile" | "retrace") whose NEXT step
+        # call is expected to trace+compile (cold start, or capacity
+        # growth re-traced the node); filled by FusedJob, consumed by
+        # FusedProgram.epoch
+        self.pending_compile: Dict[int, str] = {}
+
+    # ---- wiring ----------------------------------------------------------
+    def attach(self, data_dir: Optional[str]) -> None:
+        """Mirror records into <data_dir>/epoch_profile.jsonl (the
+        `risectl profile` surface)."""
+        if data_dir and self.enabled:
+            self.path = os.path.join(data_dir, PROFILE_FILE)
+
+    # ---- epoch spans -----------------------------------------------------
+    def begin_epoch(self, seq: int, events: int) -> None:
+        self._cur = {"seq": seq, "events": events,
+                     "ph": {}, "t0": time.perf_counter()}
+
+    def phase(self, name: str, seconds: float) -> None:
+        """Accumulate a phase duration. Sync time from OUTSIDE an epoch
+        span (a SELECT pulling the MV between barriers) still lands in the
+        totals so warmup decomposition stays honest."""
+        self.totals[name] = self.totals.get(name, 0.0) + seconds
+        if self._cur is not None:
+            ph = self._cur["ph"]
+            ph[name] = ph.get(name, 0.0) + seconds
+
+    def end_epoch(self) -> None:
+        cur = self._cur
+        if cur is None:
+            return
+        self._cur = None
+        wall = time.perf_counter() - cur.pop("t0")
+        rec = {"ev": "epoch", "job": self.job, "seq": cur["seq"],
+               "events": cur["events"], "wall_ms": wall * 1e3,
+               "ph_ms": {k: v * 1e3 for k, v in cur["ph"].items()}}
+        self.ring.append(rec)
+        self._buf.append(rec)
+        self.epochs += 1
+
+    # ---- compile / retrace events ---------------------------------------
+    def compile_event(self, label: str, seconds: float,
+                      kind: str = "compile") -> None:
+        self.compiles.append((label, kind, seconds))
+        self._buf.append({"ev": "compile", "job": self.job, "label": label,
+                          "kind": kind, "s": seconds})
+
+    # ---- file sink (flushed at checkpoints) ------------------------------
+    def flush(self) -> None:
+        if self.path is None:
+            self._buf.clear()            # unattached: the ring is the record
+            return
+        if not self._buf:
+            return
+        try:
+            if self._f is None:
+                self._f = open(self.path, "a")
+            for rec in self._buf:
+                self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+            if os.path.getsize(self.path) > _MAX_FILE_BYTES:
+                from .trace import rotate_tail
+                self._f.close()
+                rotate_tail(self.path)
+                self._f = open(self.path, "a")
+        except OSError:
+            self.path = None             # profiling must never fail the job
+        self._buf.clear()
+
+    # ---- surfaces --------------------------------------------------------
+    def rows(self) -> List[Tuple]:
+        """rw_epoch_profile rows: (job, seq, events, host_pack_ms,
+        dispatch_ms, device_sync_ms, commit_ms, wall_ms)."""
+        out = []
+        for r in self.ring:
+            ph = r["ph_ms"]
+            out.append((self.job, r["seq"], r["events"],
+                        ph.get("host_pack", 0.0), ph.get("dispatch", 0.0),
+                        ph.get("device_sync", 0.0), ph.get("commit", 0.0),
+                        r["wall_ms"]))
+        return out
+
+    def summary(self, top: int = 5) -> Dict[str, Any]:
+        """Compact report for bench detail blocks / risectl."""
+        slow = sorted(self.ring, key=lambda r: -r["wall_ms"])[:top]
+        return {
+            "epochs": self.epochs,
+            "phase_s": {k: round(v, 4) for k, v in self.totals.items()},
+            "compile_events": [
+                {"label": lb, "kind": kd, "s": round(s, 3)}
+                for lb, kd, s in self.compiles],
+            "compile_s": round(sum(s for _, _, s in self.compiles), 3),
+            "top_epochs": [
+                {"seq": r["seq"], "wall_ms": round(r["wall_ms"], 3),
+                 "ph_ms": {k: round(v, 3) for k, v in r["ph_ms"].items()}}
+                for r in slow],
+        }
+
+
+# ---------------------------------------------------------------------------
+# offline reader (risectl profile)
+# ---------------------------------------------------------------------------
+
+
+def summarize_file(path: str, job: Optional[str] = None,
+                   top: int = 10) -> Dict[str, Any]:
+    """Per-job profile summary from an epoch_profile.jsonl: phase totals,
+    compile/retrace events, and the top-N slowest epochs with their phase
+    splits — the offline `risectl profile` answer."""
+    jobs: Dict[str, Dict[str, Any]] = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            j = rec.get("job", "?")
+            if job is not None and j != job:
+                continue
+            agg = jobs.setdefault(j, {"epochs": 0, "events": 0,
+                                      "phase_ms": {p: 0.0 for p in PHASES},
+                                      "compiles": [], "_all": []})
+            if rec.get("ev") == "epoch":
+                agg["epochs"] += 1
+                agg["events"] += rec.get("events", 0)
+                for k, v in rec.get("ph_ms", {}).items():
+                    agg["phase_ms"][k] = agg["phase_ms"].get(k, 0.0) + v
+                agg["_all"].append(rec)
+            elif rec.get("ev") == "compile":
+                agg["compiles"].append(
+                    {"label": rec.get("label"), "kind": rec.get("kind"),
+                     "s": rec.get("s")})
+    out = {}
+    for j, agg in jobs.items():
+        slow = sorted(agg.pop("_all"), key=lambda r: -r["wall_ms"])[:top]
+        agg["phase_ms"] = {k: round(v, 3) for k, v in agg["phase_ms"].items()}
+        agg["compile_s"] = round(sum(c["s"] or 0 for c in agg["compiles"]), 3)
+        agg["slowest_epochs"] = [
+            {"seq": r["seq"], "events": r.get("events"),
+             "wall_ms": round(r["wall_ms"], 3),
+             "ph_ms": {k: round(v, 3) for k, v in r["ph_ms"].items()}}
+            for r in slow]
+        out[j] = agg
+    return out

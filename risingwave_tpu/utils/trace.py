@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -27,6 +28,22 @@ from typing import Any, Dict, List, Optional, Tuple
 TRACE_FILE = "barrier_trace.jsonl"
 _MAX_FILE_BYTES = 1 << 20          # rotate: keep the tail fresh, file small
 RING = 128
+
+
+def rotate_tail(path: str) -> None:
+    """Drop the first half of a JSONL file IN CONSTANT MEMORY: seek to the
+    midpoint, realign to the next line boundary, and stream the tail into
+    a replacement file. (The old rotation read the whole file into a list —
+    at the 1 MiB rotation point that is a per-4096-events full-file read
+    plus a transient double-size allocation, on the barrier path.)"""
+    with open(path, "rb") as src:
+        src.seek(0, os.SEEK_END)
+        size = src.tell()
+        src.seek(size // 2)
+        src.readline()                       # align to a line boundary
+        with open(path + ".rot", "wb") as dst:
+            shutil.copyfileobj(src, dst, 1 << 16)
+    os.replace(path + ".rot", path)
 
 
 class BarrierTracer:
@@ -53,11 +70,8 @@ class BarrierTracer:
             self._emitted += 1
             if self._emitted % 4096 == 0 \
                     and os.path.getsize(self.path) > _MAX_FILE_BYTES:
-                with open(self.path) as f:
-                    lines = f.readlines()
                 self._f.close()
-                with open(self.path, "w") as f:
-                    f.writelines(lines[len(lines) // 2:])
+                rotate_tail(self.path)
                 self._f = open(self.path, "a")
         except OSError:
             self._f = None             # tracing must never fail the job
@@ -114,10 +128,13 @@ class BarrierSpan:
                            "ts": self.commit_ts})
 
 
-def diagnose(path: str, last: int = 5) -> str:
+def diagnose(path: str, last: int = 5, stuck_only: bool = False) -> str:
     """Offline hang localization over a barrier_trace.jsonl (the risectl
     `trace` surface): per-epoch summary; an epoch with no commit event is
-    flagged with the job(s) that started and never finished."""
+    flagged with the job(s) that started and never finished. With
+    `stuck_only`, committed epochs are dropped BEFORE the last-N window,
+    so the OPEN epochs are findable even when fresh committed traffic has
+    pushed them out of the tail."""
     epochs: Dict[int, Dict[str, Any]] = {}
     order: List[int] = []
     with open(path) as f:
@@ -142,6 +159,8 @@ def diagnose(path: str, last: int = 5) -> str:
                     rec["jobs"][ev["job"]][1] = ev["ts"]
             elif ev["ev"] == "commit":
                 rec["commit"] = ev["ts"]
+    if stuck_only:
+        order = [e for e in order if epochs[e]["commit"] is None]
     lines = []
     for e in order[-last:]:
         rec = epochs[e]
@@ -158,4 +177,7 @@ def diagnose(path: str, last: int = 5) -> str:
             done = len(rec["jobs"])
             lines.append(f"epoch {e} [{rec['kind']}] OPEN — {done} jobs "
                          "collected, commit never ran (store/coordinator)")
-    return "\n".join(lines) if lines else "no barrier trace events"
+    if lines:
+        return "\n".join(lines)
+    return ("no OPEN epochs (every traced barrier committed)" if stuck_only
+            else "no barrier trace events")

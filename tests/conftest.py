@@ -20,3 +20,24 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """CI metrics-naming lint: after the suite has exercised every code
+    path that registers metrics, walk the process-global REGISTRY and
+    fail the run on Prometheus-invalid metric/label names or on a name
+    registered with conflicting label sets (utils/metrics.lint_registry).
+    A collection-only run (no tests executed) has nothing to lint."""
+    if getattr(session, "testscollected", 0) == 0:
+        return
+    from risingwave_tpu.utils.metrics import REGISTRY, lint_registry
+    problems = lint_registry(REGISTRY)
+    if problems:
+        rep = session.config.pluginmanager.get_plugin("terminalreporter")
+        for p in problems:
+            msg = f"metrics lint: {p}"
+            if rep is not None:
+                rep.write_line(msg, red=True)
+            else:
+                print(msg)
+        session.exitstatus = 1

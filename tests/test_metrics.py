@@ -1,5 +1,45 @@
 """Metrics kernel + runtime wiring."""
-from risingwave_tpu.utils.metrics import MetricsRegistry
+import re
+
+from risingwave_tpu.utils.metrics import MetricsRegistry, lint_registry
+
+
+# ---------------------------------------------------------------------------
+# exposition parser (round-trip testing): understands HELP/TYPE lines,
+# escaped label values and histogram series
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unesc(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            out.append({"n": "\n", '"': '"', "\\": "\\"}[v[i + 1]])
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text: str):
+    """{(name, frozenset(labels.items())): float} + {name: type}."""
+    samples, types = {}, {}
+    for line in text.splitlines():
+        if not line or line.startswith("# HELP"):
+            continue
+        if line.startswith("# TYPE"):
+            _, _, name, t = line.split(None, 3)
+            types[name] = t
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = {k: _unesc(v) for k, v in _LABEL_RE.findall(m.group(3) or "")}
+        samples[(m.group(1), frozenset(labels.items()))] = float(m.group(4))
+    return samples, types
 
 
 def test_counter_gauge_histogram_exposition():
@@ -59,3 +99,105 @@ def test_barrier_trace_breadcrumbs():
     inj.inject_stop()
     barriers = [m for m in it if isinstance(m, Barrier)]
     assert barriers and "HashAgg" in barriers[0].trace
+
+
+def test_label_value_escaping_round_trip():
+    """Quotes, backslashes and newlines in label VALUES must survive the
+    exposition format (the pre-PR5 _fmt_labels emitted broken text)."""
+    reg = MetricsRegistry()
+    c = reg.counter("q_total", "queries", labels=("sql",))
+    nasty = 'SELECT "a\\b"\nFROM t'
+    c.labels(nasty).inc(3)
+    text = reg.expose()
+    # the raw text contains no literal newline inside a sample line
+    assert all(l.count('"') % 2 == 0 for l in text.splitlines() if l)
+    samples, _ = parse_exposition(text)
+    assert samples[("q_total", frozenset({("sql", nasty)}.union(set())))] \
+        == 3.0
+
+
+def test_exposition_full_round_trip_and_bucket_monotonicity():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "a", labels=("k",)).labels("x").inc(2)
+    reg.gauge("g", "g").set(-1.5)
+    h = reg.histogram("lat_s", "lat", labels=("op",), buckets=(0.1, 1, 5))
+    for v in (0.05, 0.5, 0.5, 3, 30):
+        h.labels("scan").observe(v)
+    samples, types = parse_exposition(reg.expose())
+    assert types == {"a_total": "counter", "g": "gauge",
+                     "lat_s": "histogram"}
+    assert samples[("a_total", frozenset({("k", "x")}))] == 2.0
+    assert samples[("g", frozenset())] == -1.5
+    base = {("op", "scan")}
+    buckets = [samples[("lat_s_bucket",
+                        frozenset(base | {("le", le)}))]
+               for le in ("0.1", "1", "5", "+Inf")]
+    assert buckets == sorted(buckets), "bucket counts must be cumulative"
+    assert buckets[-1] == samples[("lat_s_count", frozenset(base))] == 5.0
+    assert samples[("lat_s_sum", frozenset(base))] == 34.05
+
+
+def test_child_mutation_thread_safety():
+    """Exchange drains + supervisor + barrier loop increment concurrently;
+    += on a float is not atomic without the mutation lock."""
+    import threading
+    reg = MetricsRegistry()
+    c = reg.counter("n_total", "n").labels()
+    g = reg.gauge("gv", "g").labels()
+    h = reg.histogram("hd", "h", buckets=(1.0,)).labels()
+
+    def work():
+        for _ in range(10_000):
+            c.inc()
+            g.inc(2)
+            h.observe(0.5)
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == 80_000
+    assert g.value == 160_000
+    assert h.total == 80_000 and h.counts[0] == 80_000
+
+
+def test_dump_delta_and_merge_remote():
+    """The cluster plane: worker-side registry deltas replace (never add)
+    on the coordinator, under an extra worker label."""
+    worker = MetricsRegistry()
+    worker.counter("worker_epochs_total", "e", labels=("fragment",)) \
+        .labels("partial_hash_agg").inc(4)
+    worker.histogram("w_lat", "l", buckets=(1.0,)).observe(0.5)
+    delta, state = worker.dump_delta({})
+    assert "worker_epochs_total" in delta and "w_lat" in delta
+    # nothing changed -> empty delta (the piggyback frame stays small)
+    delta2, state2 = worker.dump_delta(state)
+    assert delta2 == {}
+    coord = MetricsRegistry()
+    coord.counter("barrier_count", "b").inc()
+    coord.merge_remote(delta, worker="partial0/123")
+    coord.merge_remote(delta, worker="partial0/123")   # idempotent
+    samples, _ = parse_exposition(coord.expose())
+    assert samples[("worker_epochs_total",
+                    frozenset({("fragment", "partial_hash_agg"),
+                               ("worker", "partial0/123")}))] == 4.0
+    assert samples[("w_lat_count",
+                    frozenset({("worker", "partial0/123")}))] == 1.0
+    # local families still expose
+    assert samples[("barrier_count", frozenset())] == 1.0
+
+
+def test_lint_registry():
+    reg = MetricsRegistry()
+    reg.counter("ok_total", "fine", labels=("a",))
+    assert lint_registry(reg) == []
+    reg.counter("bad-name", "dash is invalid")
+    reg.gauge("bad_label", "x", labels=("0digit",))
+    # same name, conflicting label sets (second registration is silently
+    # deduped at runtime — the lint must still flag it)
+    reg.counter("ok_total", "fine", labels=("a", "b"))
+    problems = lint_registry(reg)
+    assert any("bad-name" in p for p in problems)
+    assert any("0digit" in p for p in problems)
+    assert any("ok_total" in p and "conflicting" in p for p in problems)
