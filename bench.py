@@ -359,6 +359,30 @@ def _profile_stats(db):
     return {name: job.profiler.summary() for name, job in db._fused.items()}
 
 
+def _warmup_stats(db, warmup_s):
+    """Warmup decomposition (ISSUE 6): how much of the wall was compile,
+    how many compiles/retraces/growth-replays happened, and what the AOT
+    service did (background compiles, cache hits, interpreted-bridge
+    epochs) — the numbers that prove (or disprove) the warmup wall is
+    gone, recorded into the BENCH json."""
+    events = [e for job in db._fused.values()
+              for e in job.profiler.summary()["compile_events"]]
+    out = {
+        "warmup_s": round(warmup_s, 1),
+        "compile_s": round(sum(e.get("s") or 0 for e in events), 1),
+        "compiles": sum(1 for e in events if e.get("kind") == "compile"),
+        "retraces": sum(1 for e in events if e.get("kind") == "retrace"),
+        "growth_replays": sum(j.growth_replays for j in db._fused.values()),
+        "plan_hashes": {n: j.plan_hash for n, j in db._fused.items()},
+    }
+    try:
+        from risingwave_tpu.device.compile_service import get_service
+        out["aot"] = get_service().summary()
+    except ImportError:
+        pass
+    return out
+
+
 def _q4_db(on, n_events, chunk=None):
     from risingwave_tpu.sql import Database
     chunk = chunk or (Q4_CHUNK if on else 8192)
@@ -368,7 +392,8 @@ def _q4_db(on, n_events, chunk=None):
     db.run(Q4_MV)
     dt = drive(db, n_events, chunk=chunk)
     rows = db.query("SELECT * FROM q4")
-    return n_events / dt, rows, _cap_stats(db), _profile_stats(db)
+    return (n_events / dt, rows, _cap_stats(db), _profile_stats(db),
+            _warmup_stats(db, dt))
 
 
 def stage_q4_device(n_events):
@@ -381,9 +406,10 @@ def stage_q4_device(n_events):
     reported separately (`warmup_s`); cache entries also persist to disk
     (.jax_cache) so later processes skip the compile entirely."""
     t0 = time.perf_counter()
-    _q4_db(True, n_events)
+    _, _, _, _, warm = _q4_db(True, n_events)
     warmup_s = time.perf_counter() - t0
-    eps, rows, caps, prof = _q4_db(True, n_events)
+    warm["warmup_s"] = round(warmup_s, 1)
+    eps, rows, caps, prof, _ = _q4_db(True, n_events)
     cols = nexmark_host_columns(n_events)["bid"]
     oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
     assert len(rows) == len(oracle)
@@ -392,6 +418,7 @@ def stage_q4_device(n_events):
     return {"q4_sql": {
         "device_eps": round(eps), "events": n_events, "groups": len(rows),
         "warmup_s": round(warmup_s, 1),
+        "warmup": warm,
         "capacity": caps,
         "profile": prof,
         "mv_verified": True,
@@ -406,7 +433,7 @@ def stage_q4_device(n_events):
 
 
 def stage_q4_host(n_events):
-    eps, _, _, _ = _q4_db(False, n_events)
+    eps = _q4_db(False, n_events)[0]
     return {"q4_sql_host": {"host_sql_eps": round(eps), "events": n_events}}
 
 
@@ -432,7 +459,8 @@ def _qx_db(on, n_events, capacity):
         "q7": db.query("SELECT * FROM nexmark_q7"),
         "q8": db.query("SELECT * FROM nexmark_q8"),
     }
-    return n_events / dt, out, _cap_stats(db), _profile_stats(db)
+    return (n_events / dt, out, _cap_stats(db), _profile_stats(db),
+            _warmup_stats(db, dt))
 
 
 def stage_qx_device(n_events):
@@ -442,8 +470,9 @@ def stage_qx_device(n_events):
     budget without changing the steady-state story; compiled programs
     persist in the cache across attempts either way."""
     t0 = time.perf_counter()
-    eps, qx, caps, prof = _qx_db(True, n_events, QX_CAPACITY)
+    eps, qx, caps, prof, warm = _qx_db(True, n_events, QX_CAPACITY)
     warmup_s = round(time.perf_counter() - t0, 1)
+    warm["warmup_s"] = warmup_s
     c = nexmark_host_columns(n_events)
     bid, auc, per = c["bid"], c["auction"], c["person"]
     t0 = time.perf_counter()
@@ -466,6 +495,7 @@ def stage_qx_device(n_events):
     return {"q5_q7_q8_sql": {
         "device_eps": round(eps), "events": n_events,
         "warmup_s": round(warmup_s, 1),
+        "warmup": warm,
         "capacity": caps,
         "profile": prof,
         "numpy_batch_eps": {"q5": round(q5_np_eps), "q7": round(q7_np_eps),
@@ -484,7 +514,7 @@ def stage_qx_device(n_events):
 
 
 def stage_qx_host(n_events):
-    eps, _, _, _ = _qx_db(False, n_events, QX_CAPACITY)
+    eps = _qx_db(False, n_events, QX_CAPACITY)[0]
     return {"q5_q7_q8_sql_host": {"host_sql_eps": round(eps),
                                   "events": n_events}}
 
@@ -524,16 +554,21 @@ def _stage_child(name, args, out_path):
 
 
 class Harness:
-    def __init__(self, total_budget):
+    def __init__(self, total_budget, record=True):
         self.deadline = time.monotonic() + total_budget
         self.detail = {}
         self.log = []
         self._printed = False
         self._proc = None               # live stage subprocess, if any
+        # write the round's BENCH record file only for full, uninterrupted
+        # runs — a smoke run or a ctrl-C'd partial must never clobber the
+        # canonical BENCH_rNN.json next to the committed history
+        self.record = record
         signal.signal(signal.SIGTERM, self._on_term)
         signal.signal(signal.SIGINT, self._on_term)
 
     def _on_term(self, signum, frame):
+        self.record = False
         self.log.append(f"signal {signum} — emitting partial results")
         if self._proc is not None and self._proc.is_alive():
             self._proc.kill()          # os._exit skips mp atexit cleanup
@@ -629,6 +664,16 @@ class Harness:
             "vs_baseline": round(value / base, 3) if base else None,
             "detail": d,
         }
+        # record the round's numbers (warmup_s + compile/retrace counts in
+        # the per-stage `warmup` blocks) so regressions diff as files
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r06.json")
+        if out_path and self.record:
+            try:
+                with open(out_path + ".tmp", "w") as f:
+                    json.dump(result, f, indent=1)
+                os.replace(out_path + ".tmp", out_path)
+            except OSError:
+                pass
         print(json.dumps(result), flush=True)
 
 
@@ -636,7 +681,7 @@ def main():
     smoke = "--smoke" in sys.argv
     total = float(os.environ.get("RW_BENCH_BUDGET", "100" if smoke
                                  else "2400"))
-    h = Harness(total)
+    h = Harness(total, record=not smoke)
     if smoke:
         h.run_stage("fused", (10, 65_536), 60)
         h.run_stage("q4_device", (524_288,), 60)

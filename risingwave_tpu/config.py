@@ -59,6 +59,18 @@ class DeviceConfig:
     # timing, and the rw_epoch_profile / rw_fused_node_stats surfaces.
     # Costs a few perf_counter reads per epoch; off removes even that.
     profile: bool = True
+    # AOT compile service (device/compile_service.py): jit compiles of
+    # fused epoch programs move off the barrier hot loop onto a
+    # background worker pool — at CREATE time the plan's shapes (and,
+    # once rates are observed, its predicted growth buckets) compile
+    # ahead while the interpreted path serves the first epochs, and the
+    # compiled executable swaps in at the next barrier. Off restores
+    # inline compiles on first dispatch (the pre-ISSUE-6 behavior).
+    aot_compile: bool = True
+    # max background pre-warm rounds per job for predicted growth-bucket
+    # shapes (the capacity ladder ahead of observed need). 0 disables
+    # bucket pre-warm while keeping CREATE-time AOT.
+    compile_buckets: int = 4
 
 
 @dataclass
@@ -165,7 +177,7 @@ class NodeConfig:
                 if k not in ("capacity", "minmax", "fuse",
                              "mv_persist_every", "predictive_growth",
                              "hbm_budget_mb", "compile_cache_dir",
-                             "profile"):
+                             "profile", "aot_compile", "compile_buckets"):
                     raise ValueError(f"unknown config key [device] {k!r}")
             base = resolve_device(
                 int(mode) if isinstance(mode, str) and mode.isdigit()

@@ -36,6 +36,14 @@ Commands:
                               counters and current per-node capacities
                               (JSON) — diagnose capacity-bound runs
                               without reading bench logs
+    compile-status [JOB]      per-signature AOT compile state of every
+                              fused job (pending / ready / cached /
+                              failed, with capacity bucket and compile
+                              seconds) plus the job's plan-shape hash —
+                              answers "why is this job still warming
+                              up" and proves zero-compile warm starts;
+                              --wait SECS lets in-flight background
+                              compiles land first
 """
 from __future__ import annotations
 
@@ -263,6 +271,42 @@ def cmd_fused_stats(args) -> int:
     return 0
 
 
+def cmd_compile_status(args) -> int:
+    """AOT compile-service state per fused job (the warmup-wall
+    dashboard). Opens a full Database: DDL replay rebuilds the fused
+    programs, recovery presizes them, and CREATE-time pre-warm kicks
+    their shapes onto the background pool — so the report shows exactly
+    what a restarting operator would see: signatures already in the
+    persistent cache load as fast `cached` entries, fresh shapes sit
+    `pending` until their background compile lands."""
+    from ..device.compile_service import get_service
+    from ..sql import Database
+    db = Database(data_dir=args.data_dir, device="auto")
+    if not db._fused:
+        print("no fused device jobs in this data directory")
+        return 0
+    if args.job is not None and args.job not in db._fused:
+        raise SystemExit(f"no fused job {args.job!r} "
+                         f"(have: {', '.join(sorted(db._fused))})")
+    svc = get_service()
+    if args.wait:
+        svc.wait_idle(args.wait)
+    jobs = [args.job] if args.job is not None else sorted(db._fused)
+    out = {}
+    for j in jobs:
+        job = db._fused[j]
+        rows = svc.status(j)
+        out[j] = {
+            "plan_hash": job.plan_hash,
+            "aot": job.compile_service is not None,
+            "signatures": rows,
+            "counts": {st: sum(1 for r in rows if r["state"] == st)
+                       for st in ("pending", "ready", "cached", "failed")},
+        }
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0
+
+
 def cmd_history(args) -> int:
     """Retained manifest versions (time-travel window)."""
     store = _store(args.data_dir)
@@ -304,6 +348,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--top", type=int, default=10,
                     help="slowest epochs to list per job")
     sp.set_defaults(fn=cmd_profile)
+    sp = sub.add_parser("compile-status")
+    sp.add_argument("job", nargs="?", default=None)
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--wait", type=float, default=0.0,
+                    help="seconds to let in-flight background compiles "
+                         "finish before reporting")
+    sp.set_defaults(fn=cmd_compile_status)
     sp = sub.add_parser("backup")
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--dest", required=True)

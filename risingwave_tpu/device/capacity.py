@@ -30,12 +30,45 @@ HEADROOM = 1.05
 # Unbounded sources have no horizon to extrapolate over: grow two pow2
 # steps past the observed need (4x) so each replay buys several doublings.
 UNBOUNDED_STEP = 4
+# Per-epoch-bounded slots (join pair buffers, agg `touched` compaction
+# bounds) reset every epoch: their need does NOT scale with total events,
+# so the linear horizon extrapolation wildly over-shoots them on window
+# queries. They get flat multiplicative headroom instead — the pow2
+# bucket on top makes the effective margin 2-4x.
+EPOCH_HEADROOM = 2.0
 
 
 def bucket(n: int, lo: int = 256) -> int:
     """Smallest pow2 >= n, floored at lo (pow2 buckets bound the number of
     distinct traced shapes per node)."""
     return max(lo, 1 << (max(1, int(n)) - 1).bit_length())
+
+
+def ladder(current: int, predicted: int, rungs: int = 4) -> list:
+    """The pow2 capacity rungs between `current` (exclusive) and
+    `bucket(predicted)` (inclusive) — the shapes worth AOT-compiling
+    ahead of growth. At most `rungs` values, keeping the FIRST step
+    (where a mis-predicted growth lands) and the TOP of the ladder
+    (where predictive growth jumps); middle rungs are the first to go,
+    since cascade-free growth rarely visits them."""
+    hi = bucket(max(int(predicted), 1), lo=1)
+    out = []
+    c = bucket(max(int(current), 1), lo=1)
+    while c < hi:
+        c <<= 1
+        out.append(c)
+    if rungs > 0 and len(out) > rungs:
+        out = out[:1] + out[-(rungs - 1):] if rungs > 1 else out[-1:]
+    return out
+
+
+def project_epoch(need: int, headroom: float = EPOCH_HEADROOM) -> int:
+    """Projection for a per-epoch-bounded slot: flat headroom over the
+    observed per-epoch high-water, never horizon-scaled. 0 when nothing
+    was observed."""
+    if need <= 0:
+        return 0
+    return int(need * headroom)
 
 
 def project(need: int, events_seen: int, horizon: Optional[int],

@@ -1,0 +1,493 @@
+"""Ahead-of-time compile service: the owner of every fused-path compile.
+
+Before this module, jit trace/compile happened INLINE on the epoch hot
+loop: the first barrier after CREATE (and after every capacity growth)
+blocked on tens-of-seconds XLA compiles — the r05 q5/q7/q8 bench spent
+421.7s of warmup that way, and PR 5's profiler could only name it, not
+remove it. This service inverts the lifecycle: compiles become a managed,
+observable, pre-fetchable resource instead of a side effect of dispatch.
+
+Three pillars:
+
+* **Shape bucketing** — node capacities are pow2-bucketed (capacity.py),
+  so every trace-shaping value is a ladder rung; the service keys its
+  executable cache on (node structural signature, mutable-capacity salt,
+  epoch cadence, input avals) — exactly the jit signature — and a growth
+  resize that lands on an already-compiled rung dispatches with ZERO
+  retrace.
+
+* **Background AOT** — `jax.jit(step).lower(avals).compile()` runs on a
+  small daemon worker pool. While an executable is pending, the epoch
+  step runs the INTERPRETED path (`jax.disable_jit()` — eager op-by-op,
+  exact, no compile), so a job comes online at the first barrier and
+  swaps in the compiled executable at the next barrier after the
+  background compile finishes. Input avals for shapes that have never
+  been dispatched (CREATE-time pre-warm, predicted growth buckets) come
+  from an abstract `jax.eval_shape` walk over a cloned node graph.
+
+* **Plan-shape-hash pre-warm** — a compile manifest next to the
+  persistent XLA cache records which key digests (and which plan-shape
+  hashes) were compiled by ANY process; a re-created or restarted job
+  whose signatures appear there is served from the disk cache and its
+  compile events are labeled `cache_hit`. Within one process the
+  executable cache itself is shared, so DROP + re-CREATE (or a second
+  identically-shaped job) performs zero fresh compiles.
+
+Observability: every finished compile lands in the requesting job's
+profiler (`utils/profile.py`) with `bucket`/`aot`/`cache_hit` labels, and
+`risectl compile-status <job>` reports pending/ready/cached per
+signature. `DeviceConfig.aot_compile=False` restores inline compiles.
+"""
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["CompileService", "get_service", "shutdown"]
+
+_WORKERS = max(1, min(4, (os.cpu_count() or 2) - 1))
+MANIFEST_FILE = "compile_manifest.json"
+
+
+def _stable_digest(obj: Any) -> str:
+    """Deterministic short digest of a repr-stable structure (node sigs
+    are tuples of strings/ints/frozen dataclasses — repr is canonical)."""
+    return hashlib.sha1(repr(obj).encode()).hexdigest()[:16]
+
+
+def _avals_of(tree) -> Tuple:
+    """(treedef, ((shape, dtype), ...)) fingerprint of a pytree of arrays
+    OR ShapeDtypeStructs — the part of the jit signature the static salt
+    can't see. Identical for an abstract eval_shape walk and the live
+    arrays it predicts, so pre-warmed entries are dispatch hits."""
+    from jax.tree_util import tree_flatten
+    leaves, treedef = tree_flatten(tree)
+    return treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+
+
+def _sds_of(tree):
+    """ShapeDtypeStruct mirror of a pytree of concrete arrays (what the
+    background thread lowers against — never the live buffers)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+
+
+def clone_nodes(nodes) -> List[Any]:
+    """Shallow-copy a node list so capacity presets for bucket pre-warm
+    never touch the live program (a mutated live node would silently
+    shift `_mut_sig` under the dispatcher's feet)."""
+    out = []
+    for n in nodes:
+        c = copy.copy(n)
+        if hasattr(c, "ms_caps"):
+            c.ms_caps = list(c.ms_caps)
+        out.append(c)
+    return out
+
+
+def abstract_program_avals(nodes, epoch_events: int):
+    """Per-node (state, ins, extra) ShapeDtypeStruct trees from an
+    abstract `jax.eval_shape` walk — the same dataflow FusedProgram.epoch
+    runs, with zero FLOPs and zero HBM. Lets the service lower shapes
+    that have never executed (CREATE-time cold start, predicted growth
+    buckets)."""
+    import jax
+    import jax.numpy as jnp
+    from .fused import MVKeyedNode
+    states = [jax.eval_shape(n.init_state) for n in nodes]
+    outs: List[Any] = []
+    auxes: List[Any] = []
+    per_node = []
+    for i, node in enumerate(nodes):
+        ins = tuple(outs[j] for j in node.inputs)
+        if node.takes_event_lo:
+            extra = jax.ShapeDtypeStruct((), jnp.int64)
+        elif isinstance(node, MVKeyedNode):
+            extra = auxes[node.inputs[0]]
+        else:
+            extra = None
+        st, out, _stats, aux = jax.eval_shape(
+            lambda s, i_, e, _n=node: _n.apply(s, list(i_), e, epoch_events),
+            states[i], ins, extra)
+        per_node.append((states[i], ins, extra))
+        outs.append(out)
+        auxes.append(aux)
+    return per_node
+
+
+class CompileEntry:
+    """One (signature, capacity bucket, avals) executable and its
+    lifecycle: pending -> ready | failed. `jobs` maps job name -> True
+    when this job's request triggered the compile (fresh) / False when
+    the entry was already ready or in flight (cached/shared)."""
+
+    __slots__ = ("key", "digest", "label", "status", "compiled", "seconds",
+                 "bucket", "kind", "cache_hit", "error", "jobs", "sds",
+                 "node", "epoch_events", "salt", "profiler")
+
+    def __init__(self, key, digest, label, node, epoch_events, salt, sds,
+                 kind, profiler):
+        self.key = key
+        self.digest = digest
+        self.label = label
+        self.node = node
+        self.epoch_events = epoch_events
+        self.salt = salt
+        self.sds = sds                  # (state, ins, extra) SDS trees
+        self.status = "pending"
+        self.compiled = None
+        self.seconds = 0.0
+        self.bucket = salt              # the capacity bucket(s) of the trace
+        self.kind = kind                # "compile" | "retrace"
+        self.cache_hit = False
+        self.error: Optional[str] = None
+        self.jobs: Dict[str, bool] = {}
+        self.profiler = profiler
+
+    def state_for(self, job: str) -> str:
+        if self.status != "ready":
+            return self.status
+        return "ready" if self.jobs.get(job) else "cached"
+
+
+class CompileService:
+    """Process-global compile owner for the fused device path. One
+    instance serves every Database in the process — that sharing IS the
+    zero-compile warm start for DROP + re-CREATE and identically-shaped
+    jobs (entries key on structural signatures, never job names)."""
+
+    def __init__(self, workers: int = _WORKERS):
+        self._entries: Dict[Tuple, CompileEntry] = {}
+        self._lock = threading.Lock()
+        self._queue: deque = deque()
+        self._cv = threading.Condition(self._lock)
+        self._workers: List[threading.Thread] = []
+        self._n_workers = max(1, workers)
+        self._stop = False
+        self._inflight = 0
+        # test/diagnostic hook: when set, workers block here before
+        # compiling (lets tests pin the interpreted-bridge window open)
+        self.hold: Optional[threading.Event] = None
+        # counters (bench warmup decomposition / compile-status)
+        self.compiles_done = 0
+        self.compiles_failed = 0
+        self.cache_hits = 0
+        self.eager_steps = 0
+        self.compiled_steps = 0
+        self._manifest: Dict[str, Any] = {}
+        self._manifest_loaded = False
+        self._manifest_dirty = False
+
+    # ---- worker pool ----------------------------------------------------
+    def _ensure_workers(self) -> None:
+        # under _lock
+        self._stop = False
+        while len(self._workers) < self._n_workers:
+            t = threading.Thread(target=self._worker_loop,
+                                 name=f"rw-aot-{len(self._workers)}",
+                                 daemon=True)
+            self._workers.append(t)
+            t.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait(1.0)
+                if self._stop:
+                    return
+                task = self._queue.popleft()
+                self._inflight += 1
+            try:
+                task()
+            except Exception:            # a compile failure must never
+                pass                     # take the worker (or the job) down
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _submit(self, task) -> None:
+        with self._cv:
+            self._ensure_workers()
+            self._queue.append(task)
+            self._cv.notify_all()
+
+    def wait_idle(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued/in-flight compile finished (tests,
+        `risectl compile-status --wait`, session teardown)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._queue or self._inflight:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(0.1 if left is None else min(0.1, left))
+        self._save_manifest()
+        return True
+
+    def shutdown(self, join: bool = True, timeout: float = 30.0) -> None:
+        """Stop the pool (joining in-flight compiles) — the pytest
+        sessionfinish guard against leaked-thread flakes. The service
+        stays usable: the next request re-spawns workers."""
+        if join:
+            self.wait_idle(timeout)
+        with self._cv:
+            self._stop = True
+            self._queue.clear()
+            workers, self._workers = self._workers, []
+            self._cv.notify_all()
+        for t in workers:
+            t.join(timeout)
+        self._save_manifest()
+
+    # ---- keys / manifest ------------------------------------------------
+    @staticmethod
+    def _key(node, epoch_events: int, state, ins, extra) -> Tuple:
+        return (type(node).__name__, node._sig(), node._mut_sig(),
+                epoch_events, _avals_of((state, ins, extra)))
+
+    @staticmethod
+    def _digest(node, epoch_events: int, salt, avals) -> str:
+        return _stable_digest((type(node).__name__, node._sig(), salt,
+                               epoch_events, avals[1]))
+
+    def _manifest_path(self) -> Optional[str]:
+        try:
+            import jax
+            d = jax.config.jax_compilation_cache_dir
+        except AttributeError:
+            return None
+        return os.path.join(d, MANIFEST_FILE) if d else None
+
+    def _load_manifest(self) -> None:
+        if self._manifest_loaded:
+            return
+        self._manifest_loaded = True
+        path = self._manifest_path()
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._manifest = json.load(f)
+            except (OSError, ValueError):
+                self._manifest = {}
+        self._manifest.setdefault("keys", {})
+        self._manifest.setdefault("plans", {})
+
+    def _save_manifest(self) -> None:
+        path = self._manifest_path()
+        if path is None or not self._manifest_dirty:
+            return
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._manifest, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+            self._manifest_dirty = False
+        except OSError:
+            pass                         # manifests are advisory only
+
+    def note_plan(self, plan_hash: str, job: str, labels: List[str]) -> None:
+        with self._lock:
+            self._load_manifest()
+            rec = self._manifest["plans"].setdefault(
+                plan_hash, {"nodes": sorted(set(labels))})
+            rec["last_job"] = job
+            self._manifest_dirty = True
+
+    def plan_known(self, plan_hash: str) -> bool:
+        """True when some earlier process compiled this plan shape (its
+        executables should be persistent-cache hits)."""
+        with self._lock:
+            self._load_manifest()
+            return plan_hash in self._manifest["plans"]
+
+    # ---- the dispatch seam ---------------------------------------------
+    def node_step(self, node, epoch_events: int, state, ins, extra, *,
+                  label: str, job: Optional[str] = None, profiler=None,
+                  kind: Optional[str] = None):
+        """The fused epoch step, compile-service-managed:
+
+        ready  -> call the AOT executable (zero trace, zero compile)
+        pending-> serve this epoch on the interpreted path (disable_jit)
+                  while the background compile proceeds; the swap happens
+                  at the next barrier that finds the entry ready
+        failed -> permanent inline-jit fallback for this signature
+        """
+        import jax
+        key = self._key(node, epoch_events, state, ins, extra)
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                ent = self._request_locked(
+                    key, node, epoch_events, _sds_of((state, ins, extra)),
+                    label=label, job=job, profiler=profiler,
+                    kind=kind or "compile")
+            elif job is not None and job not in ent.jobs:
+                ent.jobs[job] = False    # shared/cached for this job
+        if ent.status == "ready":
+            try:
+                out = ent.compiled(state, ins, extra)
+                with self._lock:
+                    self.compiled_steps += 1
+                return out
+            except Exception as e:       # aval/placement drift: fall back
+                ent.status = "failed"
+                ent.error = f"dispatch: {type(e).__name__}: {e}"
+        if ent.status == "failed":
+            from .fused import _node_step
+            return _node_step(node, epoch_events, state, ins, extra)
+        with self._lock:
+            self.eager_steps += 1
+        with jax.disable_jit():
+            return node.apply(state, list(ins), extra, epoch_events)
+
+    def _request_locked(self, key, node, epoch_events, sds, *, label, job,
+                        profiler, kind) -> CompileEntry:
+        self._load_manifest()
+        digest = self._digest(node, epoch_events, key[2], key[4])
+        ent = CompileEntry(key, digest, label, node, epoch_events, key[2],
+                           sds, kind, profiler)
+        ent.cache_hit = digest in self._manifest["keys"]
+        if job is not None:
+            ent.jobs[job] = True         # this job pays for the compile
+        self._entries[key] = ent
+        self._queue.append(self._compile_task(ent))
+        self._ensure_workers()
+        self._cv.notify_all()
+        return ent
+
+    def _compile_task(self, ent: CompileEntry):
+        def task():
+            if self.hold is not None:
+                ent_hold = self.hold
+                ent_hold.wait()
+            import jax
+            from .fused import _jit_step
+            state_s, ins_s, extra_s = ent.sds
+            t0 = time.perf_counter()
+            try:
+                lowered = _jit_step().lower(
+                    state_s, ins_s, extra_s, node=ent.node,
+                    epoch_events=ent.epoch_events, salt=ent.salt)
+                ent.compiled = lowered.compile()
+            except Exception as e:
+                ent.seconds = time.perf_counter() - t0
+                ent.error = f"{type(e).__name__}: {e}"
+                ent.status = "failed"
+                with self._lock:
+                    self.compiles_failed += 1
+                return
+            ent.seconds = time.perf_counter() - t0
+            ent.status = "ready"
+            with self._lock:
+                # counters are asserted on exactly (zero-compile warm
+                # starts); worker threads race, so never bare +=
+                self.compiles_done += 1
+                if ent.cache_hit:
+                    self.cache_hits += 1
+                self._manifest["keys"][ent.digest] = {
+                    "label": ent.label, "s": round(ent.seconds, 3)}
+                self._manifest_dirty = True
+            if ent.profiler is not None and ent.profiler.enabled:
+                # bucket "()" = capacity rides in the avals, not the salt
+                ent.profiler.compile_event(
+                    ent.label, ent.seconds, kind=ent.kind, aot=True,
+                    bucket=repr(ent.bucket), cache_hit=ent.cache_hit)
+        return task
+
+    # ---- pre-warm -------------------------------------------------------
+    def prewarm_program(self, nodes, epoch_events: int, *, job: str,
+                        profiler=None, plan_hash: Optional[str] = None,
+                        caps: Optional[Dict[int, Dict[str, int]]] = None,
+                        labels: Optional[List[str]] = None) -> None:
+        """Schedule background AOT for a program's node shapes — the
+        current ones (caps=None) or a predicted growth bucket (caps =
+        {node index: {slot: capacity}}). The abstract aval walk AND the
+        lowering both run on the worker pool; the caller returns
+        immediately (CREATE-time kickoff must not block the session)."""
+        cloned = clone_nodes(nodes)
+        for i, c in (caps or {}).items():
+            if 0 <= int(i) < len(cloned):
+                cloned[int(i)].preset_caps(dict(c))
+        if plan_hash is not None:
+            self.note_plan(plan_hash, job,
+                           labels if labels is not None else [])
+
+        def task():
+            if self.hold is not None:
+                self.hold.wait()
+            try:
+                per_node = abstract_program_avals(cloned, epoch_events)
+            except Exception:
+                return                   # unwalkable plan: dispatch-time
+            with self._lock:             # scheduling still covers it
+                for i, (node, (st, ins, extra)) in enumerate(
+                        zip(cloned, per_node)):
+                    key = self._key(node, epoch_events, st, ins, extra)
+                    ent = self._entries.get(key)
+                    if ent is None:
+                        lab = labels[i] if labels and i < len(labels) else \
+                            f"{i}:{type(node).__name__}"
+                        self._request_locked(
+                            key, node, epoch_events, (st, ins, extra),
+                            label=lab, job=job, profiler=profiler,
+                            kind="compile")
+                    elif job not in ent.jobs:
+                        ent.jobs[job] = False
+        self._submit(task)
+
+    # ---- surfaces -------------------------------------------------------
+    def status(self, job: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Per-signature rows for `risectl compile-status`: pending /
+        ready (this job compiled it) / cached (compiled before this job
+        asked) / failed."""
+        with self._lock:
+            ents = [e for e in self._entries.values()
+                    if job is None or job in e.jobs]
+        return [{"label": e.label, "bucket": repr(e.bucket),
+                 "state": e.status if job is None else e.state_for(job),
+                 "kind": e.kind, "s": round(e.seconds, 3),
+                 "cache_hit": e.cache_hit, "error": e.error}
+                for e in sorted(ents, key=lambda e: e.label)]
+
+    def summary(self) -> Dict[str, Any]:
+        with self._lock:
+            pending = sum(1 for e in self._entries.values()
+                          if e.status == "pending")
+        return {"compiles": self.compiles_done,
+                "failed": self.compiles_failed,
+                "cache_hits": self.cache_hits,
+                "pending": pending,
+                "eager_steps": self.eager_steps,
+                "compiled_steps": self.compiled_steps}
+
+
+_SERVICE: Optional[CompileService] = None
+_SERVICE_LOCK = threading.Lock()
+
+
+def get_service() -> CompileService:
+    global _SERVICE
+    with _SERVICE_LOCK:
+        if _SERVICE is None:
+            _SERVICE = CompileService()
+        return _SERVICE
+
+
+def shutdown(join: bool = True, timeout: float = 30.0) -> None:
+    """Join/stop the process-global service's workers (pytest session
+    guard; safe when the service was never used)."""
+    with _SERVICE_LOCK:
+        svc = _SERVICE
+    if svc is not None:
+        svc.shutdown(join=join, timeout=timeout)

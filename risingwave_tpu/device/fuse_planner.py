@@ -27,7 +27,8 @@ from ..core.dtypes import DataType, TypeKind
 from ..expr.expression import Expr, FunctionCall, InputRef, Literal
 from .fused import (AggNode, Delta, FilterNode, FusedJob, FusedProgram,
                     HopNode, JoinNode, MapNode, MVKeyedNode, MVPairNode,
-                    MVPull, Node, PackPlan, SourceNode)
+                    MVPull, Node, PackPlan, SourceNode, node_shape_key,
+                    plan_shape_hash)
 
 NUM = ("num",)
 TS = ("ts",)
@@ -419,15 +420,18 @@ class _NexmarkDesc:
 
 def try_fuse(execu, ns, device_cfg, name: str,
              mv_state_table=None, make_state=None,
-             cap_hints=None) -> Optional[FusedJob]:
+             cap_registry=None) -> Optional[FusedJob]:
     """Lower a planned MV executor tree to a FusedJob, or None.
 
     `execu` is the tree Database._create_mv would hand to Materialize;
     `ns` its namespace (schema + stream key + visibility).
-    `cap_hints` (FusedJob.cap_hints of a previous incarnation) presizes
-    the program's nodes BEFORE state allocation, so a re-created MV with
-    the same plan never re-climbs the capacity growth ladder; hints whose
-    node index/type no longer match the plan are ignored.
+    `cap_registry` maps plan-shape hash -> {node shape key -> caps}
+    (FusedJob.shape_hints of previous incarnations): the program's nodes
+    presize from it BEFORE state allocation, so a re-created MV with the
+    same plan — under ANY name, after ANY planner refactor that keeps
+    the node structurally identical — never re-climbs the capacity
+    growth ladder. Hints match on structural shape keys, never program
+    indices, so a different plan can never inherit them.
     """
     from ..ops import ProjectExecutor
     if device_cfg is None or getattr(device_cfg, "mesh", None) is not None:
@@ -467,16 +471,17 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                       f.capacity))
             pull = MVPull("pair", mv_idx, m.dtypes, m.decoders)
         program = FusedProgram(f.nodes, f.epoch_events or 8192 * 64)
-        for i, hint in (cap_hints or {}).items():
-            i = int(i)
-            # index + type + structural hash must all match: a hint from a
-            # DIFFERENT plan must never presize this one (the hash also
-            # keeps preset capacities to values a budget-governed run of
-            # the SAME plan actually reached)
-            if i < len(program.nodes) \
-                    and type(program.nodes[i]).__name__ == hint.get("type") \
-                    and hash(program.nodes[i]) == hint.get("sig"):
-                program.nodes[i].preset_caps(hint.get("caps", {}))
+        ph = plan_shape_hash(program.nodes, program.epoch_events)
+        hints = (cap_registry or {}).get(ph) or {}
+        if hints:
+            # structural shape keys must match exactly: a hint from a
+            # DIFFERENT plan can never presize this one, and hints keep
+            # preset capacities to values a budget-governed run of the
+            # SAME plan shape actually reached
+            for node in program.nodes:
+                caps = hints.get(node_shape_key(node))
+                if caps:
+                    node.preset_caps(dict(caps))
         job_table = make_state([T.INT64, T.INT64], [0]) if make_state \
             else None
         return FusedJob(name, program, pull, f.max_events,
@@ -489,7 +494,12 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                            "predictive_growth", True),
                         hbm_budget_mb=getattr(device_cfg,
                                               "hbm_budget_mb", 4096),
-                        profile=getattr(device_cfg, "profile", True))
+                        profile=getattr(device_cfg, "profile", True),
+                        aot_compile=getattr(device_cfg, "aot_compile",
+                                            False),
+                        compile_buckets=getattr(device_cfg,
+                                                "compile_buckets", 4),
+                        plan_hash=ph)
     except FuseReject:
         return None
 

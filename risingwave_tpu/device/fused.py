@@ -231,7 +231,22 @@ class Node:
         return {}
 
     def cap_needs(self, stats: Dict[str, int]) -> Dict[str, int]:
-        """slot name -> observed slots needed, from this node's stats."""
+        """slot name -> observed slots needed, from this node's stats.
+        This is the TOTAL need — the overflow check and the correctness
+        floor; the predictor extrapolates the split views below."""
+        return {}
+
+    def cap_needs_cum(self, stats: Dict[str, int]) -> Dict[str, int]:
+        """Cumulative component of the need (entries that accumulate with
+        total events — group counts, join-side rows): the part the
+        predictor may extrapolate linearly over the event horizon."""
+        return self.cap_needs(stats)
+
+    def cap_needs_epoch(self, stats: Dict[str, int]) -> Dict[str, int]:
+        """Per-epoch-bounded component (join pair buffers, agg `touched`
+        compaction bounds): resets every epoch, so horizon extrapolation
+        over-shoots it — the predictor gives it flat headroom instead
+        (capacity.project_epoch)."""
         return {}
 
     def cap_bytes(self) -> Dict[str, int]:
@@ -271,7 +286,11 @@ class Node:
         return type(self) is type(other) and self._sig() == other._sig()
 
 
-def _node_step(node: Node, epoch_events: int, state, ins, extra):
+def _jit_step():
+    """The shared jitted per-node step (lazy singleton). The compile
+    service AOT-lowers through the SAME function so an inline jit call
+    and a background `.lower().compile()` of one signature are the same
+    trace (and the same persistent-cache entry)."""
     import jax
     global _JIT_STEP
     if _JIT_STEP is None:
@@ -279,14 +298,19 @@ def _node_step(node: Node, epoch_events: int, state, ins, extra):
             lambda state, ins, extra, *, node, epoch_events, salt:
             node.apply(state, ins, extra, epoch_events),
             static_argnames=("node", "epoch_events", "salt"))
-    return _JIT_STEP(state, ins, extra, node=node, epoch_events=epoch_events,
-                     salt=node._mut_sig())
+    return _JIT_STEP
+
+
+def _node_step(node: Node, epoch_events: int, state, ins, extra):
+    return _jit_step()(state, ins, extra, node=node,
+                       epoch_events=epoch_events, salt=node._mut_sig())
 
 
 _JIT_STEP = None
 
 
 from .capacity import bucket as _bucket  # noqa: E402  (pow2 sizing)
+from .capacity import ladder as _ladder  # noqa: E402  (pre-warm rungs)
 
 
 class SourceNode(Node):
@@ -536,6 +560,19 @@ class AggNode(Node):
             needs[f"ms{i}"] = stats[f"ms{i}"]
         return needs
 
+    def cap_needs_cum(self, stats):
+        # live groups + multiset entries accumulate across epochs
+        needs = {"main": stats["needed"]}
+        for i in range(len(self.ms_caps)):
+            needs[f"ms{i}"] = stats[f"ms{i}"]
+        return needs
+
+    def cap_needs_epoch(self, stats):
+        # groups TOUCHED in one epoch bound the change-set compaction but
+        # reset at every epoch — window queries touch (and retire) far
+        # more groups per epoch than ever stay live
+        return {"main": stats.get("touched", 0)}
+
     def cap_bytes(self):
         from .minput import MS_SLOT_BYTES
         caps = {"main": 8 * (1 + len(self.spec.dtypes))}
@@ -700,6 +737,15 @@ class JoinNode(Node):
     def cap_needs(self, stats):
         return {"a": stats["need_a"], "b": stats["need_b"],
                 "pairs": stats["need_pairs"]}
+
+    def cap_needs_cum(self, stats):
+        # build sides accumulate rows; the pair buffer does not
+        return {"a": stats["need_a"], "b": stats["need_b"]}
+
+    def cap_needs_epoch(self, stats):
+        # the probe-output pair buffer is re-filled from scratch every
+        # epoch — per-epoch-bounded, never horizon-extrapolated
+        return {"pairs": stats["need_pairs"]}
 
     def cap_bytes(self):
         # pair buffer: two probe outputs carry both sides' payloads + ids
@@ -898,6 +944,31 @@ _CHAINABLE = (SourceNode, MapNode, FilterNode)
 # ---------------------------------------------------------------------------
 
 
+def node_shape_key(node: Node) -> str:
+    """Deterministic digest of a node's structural signature — stable
+    across processes and planner refactors (unlike `hash()`, which is
+    PYTHONHASHSEED-salted for strings, and unlike program indices, which
+    a planner change renumbers). Keys the high-water presize registry
+    AND the AOT compile manifest, so both survive planner refactors
+    together. Nodes whose signatures fall back to `id()` (unknown expr
+    classes) get a per-process key — they lose sharing, never alias."""
+    import hashlib
+    sig = repr((type(node).__name__, node._sig()))
+    return hashlib.sha1(sig.encode()).hexdigest()[:16]
+
+
+def plan_shape_hash(nodes: Sequence[Node], epoch_events: int) -> str:
+    """Structural hash of a fused plan: node signatures (types, exprs,
+    dtypes, pack plans), topology (input edges), and the epoch cadence —
+    everything that shapes the traced programs, and nothing that doesn't
+    (names, program indices). Two CREATEs of identically-shaped jobs
+    collide here by design: that collision is the zero-compile warm
+    start."""
+    import hashlib
+    parts = [(node_shape_key(n), n.inputs) for n in nodes]
+    return hashlib.sha1(repr((parts, epoch_events)).encode()).hexdigest()[:16]
+
+
 @dataclass
 class MVPull:
     """How the host materializes the terminal MV state into SQL rows."""
@@ -936,6 +1007,11 @@ class FusedProgram:
         # epoch profiler (utils/profile.py), attached by the owning
         # FusedJob; None (or disabled) = zero per-node instrumentation
         self.profiler = None
+        # AOT compile service (device/compile_service.py) + owning job
+        # name, attached by FusedJob when DeviceConfig.aot_compile is on;
+        # None = inline jit compiles on the epoch loop (the old path)
+        self.compile_service = None
+        self.job_name: Optional[str] = None
 
     def init_states(self):
         return tuple(n.init_state() for n in self.nodes)
@@ -960,6 +1036,7 @@ class FusedProgram:
         prof = self.profiler
         if prof is not None and not prof.enabled:
             prof = None
+        svc = self.compile_service
         outs: List[Optional[Delta]] = []
         auxes: List[Any] = []
         new_states = list(states)
@@ -975,14 +1052,27 @@ class FusedProgram:
                 extra = None
             if prof is not None:
                 t0 = _time.perf_counter()
-            st, out, s, aux = _node_step(node, self.epoch_events,
-                                         states[i], ins, extra)
-            if prof is not None:
-                dt = _time.perf_counter() - t0
-                kind = prof.pending_compile.pop(i, None)
-                if kind is not None or dt > COMPILE_THRESHOLD_S:
-                    prof.compile_event(self._node_label(i), dt,
-                                       kind=kind or "retrace")
+            if svc is not None:
+                # compile-service path: ready executables dispatch with
+                # zero trace; pending ones are served on the interpreted
+                # bridge while the background compile proceeds (and the
+                # service attributes the compile event, labeled, when it
+                # lands — the step wall here is never a compile)
+                kind = (self.profiler.pending_compile.pop(i, None)
+                        if self.profiler is not None else None)
+                st, out, s, aux = svc.node_step(
+                    node, self.epoch_events, states[i], ins, extra,
+                    label=self._node_label(i), job=self.job_name,
+                    profiler=prof, kind=kind)
+            else:
+                st, out, s, aux = _node_step(node, self.epoch_events,
+                                             states[i], ins, extra)
+                if prof is not None:
+                    dt = _time.perf_counter() - t0
+                    kind = prof.pending_compile.pop(i, None)
+                    if kind is not None or dt > COMPILE_THRESHOLD_S:
+                        prof.compile_event(self._node_label(i), dt,
+                                           kind=kind or "retrace")
             new_states[i] = st
             outs.append(out)
             auxes.append(aux)
@@ -1058,7 +1148,9 @@ class FusedJob:
                  mv_schema_len: Optional[int] = None,
                  persist_every: int = 1,
                  predictive: bool = True, hbm_budget_mb: int = 4096,
-                 profile: bool = True):
+                 profile: bool = True, aot_compile: bool = False,
+                 compile_buckets: int = 4,
+                 plan_hash: Optional[str] = None):
         import jax.numpy as jnp
         from ..utils.profile import JobProfiler
         self.name = name
@@ -1069,6 +1161,24 @@ class FusedJob:
         self.profiler.pending_compile = {
             i: "compile" for i in range(len(program.nodes))}
         program.profiler = self.profiler
+        # structural identity of this plan (node sigs + topology + epoch
+        # cadence): keys the warm-start presize registry and the AOT
+        # compile manifest — survives DROP/re-CREATE, restarts, renames
+        self.plan_hash = plan_hash or plan_shape_hash(program.nodes,
+                                                      program.epoch_events)
+        # AOT compile service: compiles move off the epoch loop onto a
+        # background pool; pending signatures serve on the interpreted
+        # bridge (device/compile_service.py). Off = inline jit compiles.
+        self.compile_service = None
+        self.compile_buckets = max(0, compile_buckets)
+        self._prewarm_rounds = 0
+        self._prewarmed: Dict[Tuple[int, str], int] = {}
+        self._last_prewarm_needs: Optional[Dict] = None
+        if aot_compile:
+            from .compile_service import get_service
+            self.compile_service = get_service()
+            program.compile_service = self.compile_service
+            program.job_name = name
         # node indices predate the chain transform — remap through it
         pull.node_idx = program.remap.get(pull.node_idx, pull.node_idx)
         self.pull = pull
@@ -1167,14 +1277,20 @@ class FusedJob:
             lo_dev = lo_dev + e
             c += e
 
-    def _predict_caps(self, needs: Dict[int, Dict[str, int]]
+    def _predict_caps(self, needs: Dict[int, Dict[str, int]],
+                      needs_cum: Optional[Dict[int, Dict[str, int]]] = None,
+                      needs_epoch: Optional[Dict[int, Dict[str, int]]] = None
                       ) -> Dict[int, Dict[str, int]]:
         """Bucketed capacity targets for EVERY node (cascade-free): each
-        slot is sized from its observed entries-per-event rate extrapolated
-        over max_events, scaled down toward the observed need when the
-        summed projection exceeds the HBM budget (correctness floor: never
-        below need or current)."""
-        from .capacity import project
+        slot's CUMULATIVE component is sized from its observed
+        entries-per-event rate extrapolated over max_events, its
+        PER-EPOCH component (join pair buffers, agg `touched`) gets flat
+        headroom instead of horizon scaling, and everything is scaled
+        down toward the observed need when the summed projection exceeds
+        the HBM budget (correctness floor: never below need or
+        current). Without the split views (legacy callers), the whole
+        need extrapolates — the pre-ISSUE-6 behavior."""
+        from .capacity import project, project_epoch
         if not self.predictive:
             out: Dict[int, Dict[str, int]] = {}
             for i, node in enumerate(self.program.nodes):
@@ -1193,9 +1309,13 @@ class FusedJob:
                 continue
             bpe = node.cap_bytes()
             nd = needs.get(i) or {}
+            ndc = (needs_cum or {}).get(i) if needs_cum is not None else nd
+            nde = (needs_epoch or {}).get(i) or {}
             for s, c in cur.items():
                 n = nd.get(s, 0)
-                p = max(c, project(n, events, self.max_events))
+                cum = (ndc or {}).get(s, 0)
+                p = max(c, n, project(cum, events, self.max_events),
+                        project_epoch(nde.get(s, 0)))
                 plans.append([i, s, n, c, bpe.get(s, 16), p])
         budget = self.hbm_budget_mb << 20
         total = sum(_bucket(p[5]) * p[4] for p in plans)
@@ -1234,15 +1354,24 @@ class FusedJob:
                         f"at node {ni} ({type(self.program.nodes[ni]).__name__}"
                         ") — a column left its statically proven range. "
                         "Re-create this MV with device='off'.")
-            needs = {i: node.cap_needs(self.program.node_stats(i, vec))
-                     for i, node in enumerate(self.program.nodes)}
+            needs, needs_cum, needs_epoch = {}, {}, {}
+            for i, node in enumerate(self.program.nodes):
+                st = self.program.node_stats(i, vec)
+                needs[i] = node.cap_needs(st)
+                needs_cum[i] = node.cap_needs_cum(st)
+                needs_epoch[i] = node.cap_needs_epoch(st)
             overflow = any(
                 needs[i].get(s, 0) > c
                 for i, node in enumerate(self.program.nodes)
                 for s, c in node.cap_current().items())
             if not overflow:
+                # no growth due — but the observed rates now seed the
+                # bucket ladder: pre-compile the predicted growth shapes
+                # in the background so a later overflow lands on a ready
+                # executable instead of a retrace
+                self._prewarm_predicted(needs, needs_cum, needs_epoch)
                 return
-            targets = self._predict_caps(needs)
+            targets = self._predict_caps(needs, needs_cum, needs_epoch)
             snap_states, snap_counter = self.snapshot
             new_states = []
             for i, node in enumerate(self.program.nodes):
@@ -1417,6 +1546,80 @@ class FusedJob:
                                for r in self.mv_state_table.iter_all()}
         self._last_persist = -1     # mirror may be stale: refresh next ckpt
 
+    # ---- AOT pre-warm ----------------------------------------------------
+    def prewarm(self) -> None:
+        """CREATE-time kickoff: schedule background AOT of every node at
+        its CURRENT capacities (post-presize, so warm starts compile the
+        shapes they will actually run). Returns immediately — the first
+        epochs serve on the interpreted bridge until executables land."""
+        svc = self.compile_service
+        if svc is None:
+            return
+        svc.prewarm_program(
+            self.program.nodes, self.program.epoch_events, job=self.name,
+            profiler=self.profiler if self.profiler.enabled else None,
+            plan_hash=self.plan_hash,
+            labels=[self.program._node_label(i)
+                    for i in range(len(self.program.nodes))])
+
+    def _prewarm_predicted(self, needs, needs_cum, needs_epoch) -> None:
+        """Background AOT of the predicted growth buckets: once observed
+        rates exist, the predictor's extrapolation seeds the bucket
+        ladder (`capacity.ladder`) and those shapes compile ahead of any
+        overflow. Two joint shapes per round — the FIRST ladder rung
+        (where a mis-predicted or budget-clamped growth lands) and the
+        predicted TOP bucket (where cascade-free growth jumps). Bounded
+        by `compile_buckets` rounds per job, deduped per (node, slot,
+        bucket), and skipped entirely while observed needs are unchanged
+        (steady state pays one dict compare, not a re-projection)."""
+        svc = self.compile_service
+        if svc is None or not self.predictive \
+                or self._prewarm_rounds >= self.compile_buckets:
+            return
+        if needs == self._last_prewarm_needs:
+            return
+        self._last_prewarm_needs = needs
+        targets = self._predict_caps(needs, needs_cum, needs_epoch)
+        low: Dict[int, Dict[str, int]] = {}
+        high: Dict[int, Dict[str, int]] = {}
+        for i, caps in targets.items():
+            cur = self.program.nodes[i].cap_current()
+            for s, c in caps.items():
+                if c > cur.get(s, 0) and self._prewarmed.get((i, s)) != c:
+                    rungs = _ladder(cur[s], c, rungs=2)  # [first, top]
+                    low.setdefault(i, dict(cur))[s] = rungs[0]
+                    high.setdefault(i, dict(cur))[s] = rungs[-1]
+                    self._prewarmed[(i, s)] = c
+        for caps in [low] if low == high else [low, high]:
+            if not caps or self._prewarm_rounds >= self.compile_buckets:
+                break
+            self._prewarm_rounds += 1
+            svc.prewarm_program(
+                self.program.nodes, self.program.epoch_events,
+                job=self.name,
+                profiler=self.profiler if self.profiler.enabled else None,
+                plan_hash=self.plan_hash, caps=caps,
+                labels=[self.program._node_label(i)
+                        for i in range(len(self.program.nodes))])
+
+    def shape_hints(self) -> Dict[str, Dict[str, int]]:
+        """Per-node capacity high-water keyed by the node's STRUCTURAL
+        shape key (node_shape_key) — the registry form that survives
+        planner refactors and job renames (the plan-shape-hash warm-start
+        registry stores these; cap_hints() keeps the index-keyed view for
+        introspection). Structurally identical nodes (q5's duplicated
+        hop+agg chain) merge by max."""
+        out: Dict[str, Dict[str, int]] = {}
+        for node in self.program.nodes:
+            cur = node.cap_current()
+            if not cur:
+                continue
+            k = node_shape_key(node)
+            prev = out.setdefault(k, {})
+            for s, c in cur.items():
+                prev[s] = max(prev.get(s, 0), c)
+        return out
+
     # ---- profiler / metrics surfaces -------------------------------------
     def _accum_totals(self, vec: np.ndarray) -> None:
         sm = self.program._sum_mask
@@ -1489,12 +1692,12 @@ class FusedJob:
                 "committed_events": self.committed, "nodes": nodes}
 
     def cap_hints(self) -> Dict[int, Dict[str, Any]]:
-        """Per-node capacity snapshot keyed by program node index, in the
-        shape try_fuse(cap_hints=...) consumes — lets a re-created MV with
-        the same plan start at this job's high-water capacities. Each hint
-        carries the node's structural hash (`Node.__hash__` over `_sig`),
-        so a re-created MV whose plan differs does NOT inherit capacities
-        from an unrelated node that merely shares index and type."""
+        """Per-node capacity snapshot keyed by program node index — the
+        INTROSPECTION view (each entry carries the node's structural
+        hash so a reader can tell which plan it belongs to). The
+        warm-start presize path does NOT consume this: `shape_hints()`
+        (keyed by `node_shape_key`) feeds `Database._fused_cap_hw`,
+        which `try_fuse(cap_registry=...)` reads by plan-shape hash."""
         out = {}
         for i, node in enumerate(self.program.nodes):
             cur = node.cap_current()

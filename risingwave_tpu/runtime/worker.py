@@ -26,9 +26,11 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
-from typing import Any, Dict, Iterator, List
+from typing import Any, Callable, Dict, Iterator, List, Optional
 
+from ..config import ROBUSTNESS
 from ..core.schema import Field, Schema
 from ..expr.agg import AggCall
 from ..expr.expression import InputRef
@@ -40,6 +42,56 @@ from .exchange_net import ExchangeServer, MetricsFrame, RemoteInput
 
 declare("worker.crash",
         "hard-kill the worker process mid-stream (os._exit per message)")
+
+
+class HeartbeatTimer:
+    """Timer-driven heartbeat fallback: sends a frame whenever no
+    heartbeat went out within `period` seconds, from a daemon thread.
+
+    The barrier-piggybacked heartbeats (PR 5) only fire when results
+    flow; a coordinator-quiescent period — a long AOT compile on the
+    coordinator, a paused injector, a slow upstream — silences them and
+    the worker reads as WEDGED in rw_worker_liveness even though it is
+    idle and healthy. The timer keeps liveness truthful during quiet
+    windows; `mark()` (called on every piggybacked send) holds it off
+    while traffic already proves liveness. NetChannel.send is
+    lock-protected, so the timer thread and the result stream can share
+    the channel."""
+
+    def __init__(self, send: Callable[[Optional[int]], None],
+                 period: Optional[float] = None):
+        self._send = send
+        self.period = period if period is not None \
+            else max(0.5, ROBUSTNESS.heartbeat_timeout_s / 4.0)
+        self._last = time.monotonic()
+        self._epoch: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rw-heartbeat")
+
+    def mark(self, epoch: Optional[int] = None) -> None:
+        """A heartbeat just went out on the result stream: restart the
+        quiet-window clock."""
+        self._last = time.monotonic()
+        if epoch is not None:
+            self._epoch = epoch
+
+    def start(self) -> "HeartbeatTimer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(min(self.period / 2.0, 1.0)):
+            if time.monotonic() - self._last < self.period:
+                continue
+            try:
+                self._send(self._epoch)
+                self._last = time.monotonic()
+            except (ConnectionError, OSError):
+                return                   # coordinator gone: main loop exits
 
 
 def _schema(cols: List[List[str]]) -> Schema:
@@ -130,12 +182,20 @@ def main(argv: List[str]) -> int:
     # the result stream after every barrier (and once at startup, so
     # liveness covers the backfill/seed window before the first barrier)
     hb_state: Dict = {}
+    hb_lock = threading.Lock()           # timer thread shares dump_delta
 
     def heartbeat(epoch=None):
         nonlocal hb_state
-        delta, hb_state = REGISTRY.dump_delta(hb_state)
-        out.send(MetricsFrame(os.getpid(), time.time(), epoch, delta))
+        with hb_lock:
+            delta, hb_state = REGISTRY.dump_delta(hb_state)
+            out.send(MetricsFrame(os.getpid(), time.time(), epoch, delta))
+        hb_timer.mark(epoch)
 
+    # quiet-window fallback: barrier-piggybacked heartbeats go silent
+    # whenever the coordinator stops feeding barriers (long AOT compiles,
+    # pauses) — the timer keeps liveness frames flowing so an idle worker
+    # never reads as wedged
+    hb_timer = HeartbeatTimer(heartbeat).start()
     heartbeat()
     # Recovery seeding: the coordinator replays shadowed state rows as
     # the first epoch; they rebuild this worker's fragment state but
@@ -174,6 +234,7 @@ def main(argv: List[str]) -> int:
     except (ConnectionError, OSError):
         return 2          # coordinator gone: exit quietly, nothing to save
     finally:
+        hb_timer.stop()
         out.close()
     ok = server.wait_drained()          # RW_DRAIN_DEADLINE_S-configurable
     server.close()

@@ -162,10 +162,13 @@ class Database:
         self._iters: Dict[str, Iterator[Message]] = {}
         # fused device jobs (whole-fragment epoch programs, device/fused.py)
         self._fused: Dict[str, Any] = {}
-        # capacity high-water of DROPPED fused jobs, by name: a re-created
-        # MV with the same plan presizes from its predecessor instead of
-        # re-climbing the growth ladder (try_fuse cap_hints)
-        self._fused_cap_hw: Dict[str, Any] = {}
+        # capacity high-water of DROPPED fused jobs, keyed by PLAN-SHAPE
+        # HASH -> {node shape key -> caps}: a re-created MV with the same
+        # plan shape — under any name — presizes from its predecessor
+        # instead of re-climbing the growth ladder (try_fuse
+        # cap_registry). Structural keys survive planner refactors; they
+        # are the same keys the AOT compile manifest uses.
+        self._fused_cap_hw: Dict[str, Dict[str, Dict[str, int]]] = {}
         self.sink_results: Dict[str, List[Tuple]] = {}
         self.epoch_committed = 0
         self._nexmark_gen = None
@@ -590,7 +593,7 @@ class Database:
             job = try_fuse(execu, ns, self.device, stmt.name,
                            mv_state_table=mv_table,
                            make_state=self._make_state,
-                           cap_hints=self._fused_cap_hw.get(stmt.name))
+                           cap_registry=self._fused_cap_hw)
             if job is not None:
                 for shared, port in self._pending_subs:
                     shared.unsubscribe(port)
@@ -604,6 +607,12 @@ class Database:
                 self._fused[stmt.name] = job
                 job.profiler.attach(self._data_dir)
                 job.recover()      # no-op unless the store has a committed
+                # CREATE-time AOT kickoff: the plan's shapes (post-
+                # presize) compile in the background while the
+                # interpreted path serves the first epochs; identically-
+                # shaped jobs and DROP+re-CREATE find every signature
+                # already compiled (zero-compile warm start)
+                job.prewarm()
                 return "CREATE_MATERIALIZED_VIEW"     # event counter
             # fallback: the plan stayed on the host/per-operator path, so
             # any virtual (never-started) sources it reads must activate
@@ -866,9 +875,15 @@ class Database:
         self._iters.pop(stmt.name, None)
         dropped_job = self._fused.pop(stmt.name, None)
         if dropped_job is not None:
-            # remember where its capacities topped out — a re-created MV
-            # with the same plan starts there (zero growth replays)
-            self._fused_cap_hw[stmt.name] = dropped_job.cap_hints()
+            # remember where its capacities topped out, keyed by plan
+            # shape — a re-created MV with the same plan (any name)
+            # starts there (zero growth replays); structurally identical
+            # entries merge by max
+            reg = self._fused_cap_hw.setdefault(dropped_job.plan_hash, {})
+            for k, caps in dropped_job.shape_hints().items():
+                prev = reg.setdefault(k, {})
+                for s, c in caps.items():
+                    prev[s] = max(prev.get(s, 0), c)
         # release upstream taps, or their buffers grow forever
         for shared, port in (obj.runtime or {}).get("upstream_subs", []):
             shared.unsubscribe(port)

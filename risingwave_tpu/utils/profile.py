@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -53,6 +54,12 @@ class JobProfiler:
         self.enabled = enabled
         self.ring: deque = deque(maxlen=RING)
         self.compiles: deque = deque(maxlen=256)   # (label, kind, seconds)
+        # full compile records incl. bucket/aot/cache_hit labels (the
+        # compile-service events; `compiles` keeps the legacy 3-tuples)
+        self.compile_info: deque = deque(maxlen=256)
+        # events may arrive from compile-service worker threads while the
+        # barrier thread flushes — guard the shared buffers
+        self._ev_lock = threading.Lock()
         self.path: Optional[str] = None
         self._f = None
         self._buf: List[Dict[str, Any]] = []
@@ -96,27 +103,43 @@ class JobProfiler:
                "events": cur["events"], "wall_ms": wall * 1e3,
                "ph_ms": {k: v * 1e3 for k, v in cur["ph"].items()}}
         self.ring.append(rec)
-        self._buf.append(rec)
+        with self._ev_lock:
+            self._buf.append(rec)
         self.epochs += 1
 
     # ---- compile / retrace events ---------------------------------------
     def compile_event(self, label: str, seconds: float,
-                      kind: str = "compile") -> None:
-        self.compiles.append((label, kind, seconds))
-        self._buf.append({"ev": "compile", "job": self.job, "label": label,
-                          "kind": kind, "s": seconds})
+                      kind: str = "compile", bucket: Optional[str] = None,
+                      aot: bool = False, cache_hit: bool = False) -> None:
+        """Record one compile/retrace. `bucket` names the capacity bucket
+        the trace was shaped for, `aot` marks background (compile-service)
+        compiles vs inline ones, `cache_hit` marks executables served
+        from the persistent cache/manifest — together they decompose
+        warmup into named, attributable compiles. Thread-safe: the
+        compile service reports from its worker threads."""
+        rec = {"ev": "compile", "job": self.job, "label": label,
+               "kind": kind, "s": seconds}
+        if bucket is not None:
+            rec["bucket"] = bucket
+        if aot:
+            rec["aot"] = True
+        if cache_hit:
+            rec["cache_hit"] = True
+        with self._ev_lock:
+            self.compiles.append((label, kind, seconds))
+            self.compile_info.append(rec)
+            self._buf.append(rec)
 
     # ---- file sink (flushed at checkpoints) ------------------------------
     def flush(self) -> None:
-        if self.path is None:
-            self._buf.clear()            # unattached: the ring is the record
-            return
-        if not self._buf:
-            return
+        with self._ev_lock:
+            buf, self._buf = self._buf, []
+        if self.path is None or not buf:
+            return                       # unattached: the ring is the record
         try:
             if self._f is None:
                 self._f = open(self.path, "a")
-            for rec in self._buf:
+            for rec in buf:
                 self._f.write(json.dumps(rec) + "\n")
             self._f.flush()
             if os.path.getsize(self.path) > _MAX_FILE_BYTES:
@@ -126,7 +149,6 @@ class JobProfiler:
                 self._f = open(self.path, "a")
         except OSError:
             self.path = None             # profiling must never fail the job
-        self._buf.clear()
 
     # ---- surfaces --------------------------------------------------------
     def rows(self) -> List[Tuple]:
@@ -144,13 +166,17 @@ class JobProfiler:
     def summary(self, top: int = 5) -> Dict[str, Any]:
         """Compact report for bench detail blocks / risectl."""
         slow = sorted(self.ring, key=lambda r: -r["wall_ms"])[:top]
+        with self._ev_lock:              # background compiles may land now
+            compiles = list(self.compiles)
+            compile_info = list(self.compile_info)
         return {
             "epochs": self.epochs,
             "phase_s": {k: round(v, 4) for k, v in self.totals.items()},
             "compile_events": [
-                {"label": lb, "kind": kd, "s": round(s, 3)}
-                for lb, kd, s in self.compiles],
-            "compile_s": round(sum(s for _, _, s in self.compiles), 3),
+                {k: (round(v, 3) if k == "s" else v)
+                 for k, v in rec.items() if k not in ("ev", "job")}
+                for rec in compile_info],
+            "compile_s": round(sum(s for _, _, s in compiles), 3),
             "top_epochs": [
                 {"seq": r["seq"], "wall_ms": round(r["wall_ms"], 3),
                  "ph_ms": {k: round(v, 3) for k, v in r["ph_ms"].items()}}
@@ -189,8 +215,8 @@ def summarize_file(path: str, job: Optional[str] = None,
                 agg["_all"].append(rec)
             elif rec.get("ev") == "compile":
                 agg["compiles"].append(
-                    {"label": rec.get("label"), "kind": rec.get("kind"),
-                     "s": rec.get("s")})
+                    {k: rec[k] for k in ("label", "kind", "s", "bucket",
+                                         "aot", "cache_hit") if k in rec})
     out = {}
     for j, agg in jobs.items():
         slow = sorted(agg.pop("_all"), key=lambda r: -r["wall_ms"])[:top]

@@ -23,13 +23,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def pytest_sessionfinish(session, exitstatus):
-    """CI metrics-naming lint: after the suite has exercised every code
-    path that registers metrics, walk the process-global REGISTRY and
-    fail the run on Prometheus-invalid metric/label names or on a name
-    registered with conflicting label sets (utils/metrics.lint_registry).
-    A collection-only run (no tests executed) has nothing to lint."""
+    """Session-end guards.
+
+    1. AOT thread join: fused tests leave background compile-service
+       workers (and queued compiles) behind; join them so no compile
+       lands mid-teardown and no leaked thread flakes a later plugin
+       (the threads are daemons, but a compile finishing during
+       interpreter shutdown can die inside jax with a noisy traceback).
+    2. CI metrics-naming lint: after the suite has exercised every code
+       path that registers metrics, walk the process-global REGISTRY and
+       fail the run on Prometheus-invalid metric/label names or on a
+       name registered with conflicting label sets
+       (utils/metrics.lint_registry).
+
+    A collection-only run (no tests executed) has nothing to guard."""
     if getattr(session, "testscollected", 0) == 0:
         return
+    try:
+        from risingwave_tpu.device.compile_service import shutdown
+        shutdown(join=True, timeout=60.0)
+    except ImportError:
+        pass
     from risingwave_tpu.utils.metrics import REGISTRY, lint_registry
     problems = lint_registry(REGISTRY)
     if problems:

@@ -1,0 +1,394 @@
+"""ISSUE 6 — AOT compile service: bucketed shapes, background AOT, and
+zero-compile warm starts.
+
+Contracts under test:
+ * bit-identical MV results across a bucket-boundary growth with the
+   service on (the interpreted bridge and the compiled executables are
+   the same computation);
+ * executable swap mid-job at a barrier: epochs served on the
+   interpreted path while compiles are pending, compiled dispatch after
+   they land, results unchanged across the swap;
+ * zero-compile DROP + re-CREATE (and second identically-shaped job),
+   asserted via profiler compile counts AND the service's fresh-compile
+   counter;
+ * the plan-shape hash keys the high-water presize registry, so a
+   re-created plan presizes under ANY name (satellite of PR 4's
+   index+type keying);
+ * the per-epoch-bounded capacity model: `touched`/pair-buffer needs get
+   flat headroom, never horizon extrapolation;
+ * `risectl compile-status` reports pending/ready/cached per signature.
+"""
+import json
+import time
+
+import pytest
+
+from risingwave_tpu.config import DeviceConfig
+from risingwave_tpu.device.capacity import (EPOCH_HEADROOM, bucket, ladder,
+                                            project, project_epoch)
+from risingwave_tpu.sql import Database
+
+N = 5_000
+CHUNK = 32          # fused epoch = 64 * CHUNK = 2048 events
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='{c}')")
+Q4 = ("CREATE MATERIALIZED VIEW {name} AS SELECT auction, count(*) AS c,"
+      " sum(price) AS s, max(price) AS m FROM bid GROUP BY auction")
+
+
+def drive(db, n=N, chunk=CHUNK):
+    for _ in range(n // (64 * chunk) + 3):
+        db.tick()
+
+
+def _svc():
+    from risingwave_tpu.device.compile_service import get_service
+    return get_service()
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    db = Database(device="off")
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db)
+    return sorted(db.query("SELECT * FROM q4"))
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder + per-epoch capacity model (pure math)
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_rungs():
+    # every rung pow2, strictly above current, topped by bucket(predicted)
+    r = ladder(64, 5_000)
+    assert r and all(c & (c - 1) == 0 for c in r)
+    assert all(c > 64 for c in r)
+    assert r[-1] == bucket(5_000, lo=1)
+    assert r == sorted(r)
+    # capped at `rungs`, keeping the first step and the top
+    r = ladder(64, 1 << 20, rungs=3)
+    assert len(r) == 3
+    assert r[0] == 128 and r[-1] == 1 << 20
+    # nothing to pre-compile when the prediction fits the current bucket
+    assert ladder(1024, 900) == []
+    assert ladder(1024, 1024) == []
+
+
+def test_project_epoch_flat_headroom():
+    assert project_epoch(0) == 0
+    assert project_epoch(1000) == int(1000 * EPOCH_HEADROOM)
+    # and NEVER scales with any horizon — unlike project()
+    assert project_epoch(1000) < project(1000, 2_048, 10_000_000)
+
+
+def test_node_level_need_split():
+    """JoinNode pair buffers and agg `touched` are per-epoch-bounded;
+    join sides and live groups are cumulative."""
+    import jax.numpy as jnp
+    from risingwave_tpu.device.fused import JoinNode, PackPlan
+    pack = PackPlan.plan([(0, 1000, 1)])
+    node = JoinNode(0, 1, [0], [0], pack, None, 256, 1024,
+                    [jnp.int64], [jnp.int64])
+    stats = {"need_a": 10, "need_b": 20, "need_pairs": 999,
+             "packbad": 0, "rows_in": 0, "rows_out": 0}
+    assert node.cap_needs(stats) == {"a": 10, "b": 20, "pairs": 999}
+    assert node.cap_needs_cum(stats) == {"a": 10, "b": 20}
+    assert node.cap_needs_epoch(stats) == {"pairs": 999}
+
+
+def test_per_epoch_slot_not_horizon_inflated():
+    """The predictor must size a `touched`-dominated agg from flat
+    headroom, not extrapolate it over the event horizon (the window-query
+    overshoot carried from PR 4)."""
+    from risingwave_tpu.device.fused import AggNode
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=False))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    job = db._fused["q4"]
+    job.counter = 2_048
+    job.max_events = 10_000_000          # long horizon: inflation territory
+    agg_i = next(i for i, n in enumerate(job.program.nodes)
+                 if isinstance(n, AggNode))
+    # few live groups (cumulative=8), one epoch touched 1000 dying groups
+    needs = {agg_i: {"main": 1_000}}
+    cum = {agg_i: {"main": 8}}
+    epoch = {agg_i: {"main": 1_000}}
+    target = job._predict_caps(needs, cum, epoch)[agg_i]["main"]
+    inflated = bucket(project(1_000, 2_048, 10_000_000))
+    assert target >= 1_000                      # correctness floor
+    assert target < inflated / 8, (
+        f"per-epoch `touched` was horizon-extrapolated: {target} "
+        f"(old model: {inflated})")
+    # legacy call shape (no split views) keeps the old extrapolation
+    legacy = job._predict_caps(needs)[agg_i]["main"]
+    assert legacy == inflated
+
+
+# ---------------------------------------------------------------------------
+# plan-shape hash
+# ---------------------------------------------------------------------------
+
+
+def test_plan_shape_hash_stable_across_instances():
+    """Two Databases planning the same SQL produce the same plan-shape
+    hash and node shape keys; a different query differs."""
+    hashes, keysets = [], []
+    for _ in range(2):
+        db = Database(device=DeviceConfig(aot_compile=False))
+        db.run(BID_SRC.format(n=N, c=CHUNK))
+        db.run(Q4.format(name="q4"))
+        from risingwave_tpu.device.fused import node_shape_key
+        job = db._fused["q4"]
+        hashes.append(job.plan_hash)
+        keysets.append(sorted(node_shape_key(n)
+                              for n in job.program.nodes))
+    assert hashes[0] == hashes[1]
+    assert keysets[0] == keysets[1]
+    db = Database(device=DeviceConfig(aot_compile=False))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run("CREATE MATERIALIZED VIEW q4 AS SELECT bidder, count(*) AS c"
+           " FROM bid GROUP BY bidder")
+    assert db._fused["q4"].plan_hash not in hashes
+
+
+# ---------------------------------------------------------------------------
+# background AOT: interpreted bridge, swap at a barrier, bucket growth
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.aot
+def test_interpreted_bridge_then_swap_bit_identical():
+    """With every background compile HELD, the job must come online and
+    serve correct epochs on the interpreted path; after the hold lifts,
+    compiled executables swap in at the next barrier and the final MV is
+    bit-identical to the host path — across a bucket-boundary growth
+    (capacity=64 forces at least one). Uses a max.events no other test
+    shares: the executable cache is process-global, and a plan another
+    test already compiled would be READY despite the hold."""
+    import threading
+    n = N + 192
+    host = Database(device="off")
+    host.run(BID_SRC.format(n=n, c=CHUNK))
+    host.run(Q4.format(name="q4"))
+    drive(host, n=n)
+    oracle = sorted(host.query("SELECT * FROM q4"))
+    svc = _svc()
+    hold = threading.Event()
+    svc.hold = hold
+    try:
+        db = Database(device=DeviceConfig(capacity=64, aot_compile=True))
+        db.run(BID_SRC.format(n=n, c=CHUNK))
+        db.run(Q4.format(name="q4"))
+        job = db._fused["q4"]
+        assert job.compile_service is svc
+        eager0 = svc.eager_steps
+        db.tick()
+        assert svc.eager_steps > eager0, \
+            "held compiles must serve epochs on the interpreted bridge"
+        # mid-bridge queries are served (sync + pull works eagerly);
+        # only ONE tick before this so the bounded source still has
+        # epochs left for the post-swap drive below
+        assert db.query("SELECT count(*) FROM q4")
+    finally:
+        svc.hold = None
+        hold.set()
+    assert svc.wait_idle(120), "background compiles must land"
+    compiled0 = svc.compiled_steps
+    drive(db, n=n)                 # swap happened at a barrier boundary
+    assert svc.compiled_steps > compiled0, \
+        "ready executables must take over dispatch after the swap"
+    assert job.growth_replays >= 1, "test must cross a bucket boundary"
+    assert sorted(db.query("SELECT * FROM q4")) == oracle
+
+
+@pytest.mark.aot
+def test_compile_events_labeled():
+    """Service compiles land in the requesting job's profiler with
+    `aot`/`bucket` labels and the idx:Type:sighash label grammar. Uses a
+    plan shape no other test compiles (distinct max.events changes the
+    source signature) so fresh events are guaranteed despite the shared
+    process-global executable cache."""
+    n = N - 64
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=True))
+    db.run(BID_SRC.format(n=n, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db, n=n)
+    assert _svc().wait_idle(120)
+    job = db._fused["q4"]
+    evs = [r for r in job.profiler.compile_info]
+    assert evs, "AOT compiles must be recorded in the profiler"
+    for rec in evs:
+        assert rec["aot"] is True
+        idx, tname, sig = rec["label"].split(":")
+        assert tname.endswith("Node") and len(sig) == 8
+        assert "bucket" in rec
+    assert db.query("SELECT * FROM q4")
+
+
+# ---------------------------------------------------------------------------
+# zero-compile warm starts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.aot
+def test_zero_compile_drop_recreate(oracle):
+    """DROP + re-CREATE of the same plan performs ZERO fresh compiles
+    (service cache keyed on structural signatures) and zero growth
+    replays (presize registry keyed on the plan-shape hash).
+
+    compile_buckets=0 pins the count to DISPATCH-shaped compiles: the
+    predicted-bucket pre-warm (exercised elsewhere) schedules shapes
+    from stats snapshots whose sync timing differs between the first
+    and second incarnation, which would make the fresh-compile counter
+    nondeterministic."""
+    svc = _svc()
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=True,
+                                      compile_buckets=0))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db)
+    assert db._fused["q4"].growth_replays >= 1
+    assert svc.wait_idle(120)
+    db.run("DROP MATERIALIZED VIEW q4")
+    fresh0 = svc.compiles_done + svc.compiles_failed
+    db.run(Q4.format(name="q4"))
+    job2 = db._fused["q4"]
+    drive(db)
+    assert svc.wait_idle(120)
+    assert svc.compiles_done + svc.compiles_failed == fresh0, \
+        "re-CREATE of an identical plan must not compile anything"
+    assert len(job2.profiler.compiles) == 0, \
+        "zero compile events for the re-created job"
+    assert job2.growth_replays == 0, \
+        "plan-hash presize registry must absorb the growth ladder"
+    assert sorted(db.query("SELECT * FROM q4")) == oracle
+
+
+@pytest.mark.aot
+def test_zero_compile_identically_shaped_second_job(oracle):
+    """A SECOND job with the same plan shape — different name, first one
+    still running — dispatches entirely from the shared executable
+    cache: zero fresh compiles, `cached` in compile-status.
+    (compile_buckets=0 for the same determinism reason as the
+    drop/re-create test.)"""
+    svc = _svc()
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=True,
+                                      compile_buckets=0))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db)
+    assert svc.wait_idle(120)
+    fresh0 = svc.compiles_done + svc.compiles_failed
+    db.run(Q4.format(name="q4_twin"))
+    twin = db._fused["q4_twin"]
+    assert twin.plan_hash == db._fused["q4"].plan_hash
+    drive(db)
+    assert svc.wait_idle(120)
+    assert svc.compiles_done + svc.compiles_failed == fresh0
+    assert len(twin.profiler.compiles) == 0
+    assert sorted(db.query("SELECT * FROM q4_twin")) == oracle
+    states = {r["state"] for r in svc.status("q4_twin")}
+    assert states and states <= {"cached"}, states
+
+
+@pytest.mark.aot
+def test_registry_presize_survives_rename(oracle):
+    """The high-water presize registry keys on the PLAN-SHAPE hash, not
+    the job name: a re-created identical plan under a new name starts at
+    the predecessor's capacities."""
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=True))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db)
+    job1 = db._fused["q4"]
+    assert job1.growth_replays >= 1
+    hints = job1.shape_hints()
+    db.run("DROP MATERIALIZED VIEW q4")
+    db.run(Q4.format(name="renamed"))
+    job2 = db._fused["renamed"]
+    assert job2.plan_hash == job1.plan_hash
+    got = job2.shape_hints()
+    for k, caps in hints.items():
+        for s, c in caps.items():
+            assert got[k][s] >= c, (k, s)
+    drive(db)
+    assert job2.growth_replays == 0
+    assert sorted(db.query("SELECT * FROM renamed")) == oracle
+
+
+def test_different_plan_never_inherits():
+    """A different query under a recycled name gets neither presize
+    hints nor executables (plan hash + structural keys differ)."""
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=True))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db)
+    db.run("DROP MATERIALIZED VIEW q4")
+    db.run("CREATE MATERIALIZED VIEW q4 AS SELECT bidder, count(*) AS c"
+           " FROM bid GROUP BY bidder")
+    for node in db._fused["q4"].program.nodes:
+        for cap in node.cap_current().values():
+            assert cap <= 4 * 64, "stale hint presized a different plan"
+
+
+# ---------------------------------------------------------------------------
+# surfaces: compile-status ctl + service summary
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.aot
+def test_ctl_compile_status(tmp_path, capsys, oracle):
+    from risingwave_tpu import ctl
+    d = str(tmp_path / "data")
+    db = Database(data_dir=d, device=DeviceConfig(capacity=64,
+                                                  aot_compile=True))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    drive(db)
+    assert _svc().wait_idle(120)
+    db.store.close()
+    del db
+    assert ctl.main(["compile-status", "q4", "--data-dir", d,
+                     "--wait", "120"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out) == {"q4"}
+    rep = out["q4"]
+    assert rep["aot"] is True and rep["plan_hash"]
+    assert rep["signatures"], "per-signature rows must be reported"
+    states = {r["state"] for r in rep["signatures"]}
+    assert states <= {"ready", "cached"}, states
+    assert rep["counts"]["pending"] == 0
+    # unknown job: explicit failure
+    with pytest.raises(SystemExit):
+        ctl.main(["compile-status", "nope", "--data-dir", d])
+    capsys.readouterr()
+
+
+@pytest.mark.aot
+def test_service_summary_counters():
+    svc = _svc()
+    s = svc.summary()
+    assert set(s) >= {"compiles", "failed", "cache_hits", "pending",
+                      "eager_steps", "compiled_steps"}
+    assert s["failed"] == 0, \
+        f"background AOT compiles failed during this suite: {svc.status()}"
+
+
+def test_aot_off_restores_inline_compiles(oracle):
+    """DeviceConfig.aot_compile=False keeps the pre-ISSUE-6 lifecycle:
+    no service attached, inline compile events on the epoch loop."""
+    db = Database(device=DeviceConfig(capacity=64, aot_compile=False))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4.format(name="q4"))
+    job = db._fused["q4"]
+    assert job.compile_service is None
+    assert job.program.compile_service is None
+    drive(db)
+    assert sorted(db.query("SELECT * FROM q4")) == oracle
+    assert job.profiler.compiles, "inline path must record its compiles"

@@ -37,7 +37,12 @@ def drive(db, n=N, chunk=CHUNK):
 
 
 def _fused_db(data_dir=None, profile=True):
-    db = Database(device=DeviceConfig(capacity=512, profile=profile),
+    # aot_compile=False pins the INLINE compile lifecycle these tests
+    # assert (synchronous compile events on the epoch loop); the AOT
+    # service's async event contract is covered by
+    # tests/test_compile_service.py
+    db = Database(device=DeviceConfig(capacity=512, profile=profile,
+                                      aot_compile=False),
                   data_dir=data_dir)
     db.run(BID_SRC.format(n=N, c=CHUNK))
     db.run(Q4)
@@ -326,3 +331,51 @@ def test_tracer_emit_rotates(tmp_path, monkeypatch):
     with open(path) as f:
         recs = [json.loads(l) for l in f]
     assert recs[-1]["epoch"] == 5_999 and recs[0]["epoch"] > 0
+
+
+# ---------------------------------------------------------------------------
+# timer-driven worker-side heartbeat (ISSUE 6 satellite: coordinator-
+# quiescent periods — long AOT compiles, paused injectors — must not
+# read as a wedged worker)
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_timer_fires_during_quiet_window():
+    from risingwave_tpu.runtime.worker import HeartbeatTimer
+    sends = []
+    t = HeartbeatTimer(lambda e: sends.append((time.monotonic(), e)),
+                       period=0.05)
+    t.start()
+    try:
+        time.sleep(0.3)
+        assert len(sends) >= 2, \
+            "a quiet worker must keep emitting timer heartbeats"
+    finally:
+        t.stop()
+    n = len(sends)
+    time.sleep(0.15)
+    assert len(sends) == n, "stop() must halt the timer"
+
+
+def test_heartbeat_timer_suppressed_by_traffic():
+    """While barrier-piggybacked heartbeats flow (mark()), the timer
+    stays silent — no duplicate frames on a healthy stream."""
+    from risingwave_tpu.runtime.worker import HeartbeatTimer
+    sends = []
+    t = HeartbeatTimer(lambda e: sends.append(e), period=0.2)
+    t.start()
+    try:
+        end = time.monotonic() + 0.6
+        while time.monotonic() < end:
+            t.mark(epoch=7)
+            time.sleep(0.02)
+        assert sends == [], "traffic-proven liveness must hold the timer"
+    finally:
+        t.stop()
+
+
+def test_heartbeat_timer_default_period_tracks_timeout():
+    from risingwave_tpu.runtime.worker import HeartbeatTimer
+    t = HeartbeatTimer(lambda e: None)
+    assert 0 < t.period < ROBUSTNESS.heartbeat_timeout_s, \
+        "the fallback must beat faster than the wedged threshold"
