@@ -520,6 +520,85 @@ def stage_qx_host(n_events):
 
 
 # ---------------------------------------------------------------------------
+# mesh-shard sweep (ISSUE 7): the same fused SQL on 1 vs 8 chips
+# ---------------------------------------------------------------------------
+
+SHARDS_SWEEP = (1, 8)
+SHARDS_Q4_EVENTS = 2_097_152      # a quarter of the headline scale: the
+                                  # sweep runs FOUR q4 passes (warm +
+                                  # measured per shard count)
+
+
+def _shards_pass(shards, mv_sqls, mv_names, srcs, n_events, chunk,
+                 capacity):
+    """One sweep pass at the given mesh_shards: eps, exchange-stage wall,
+    the shard count the planner actually achieved (falls back to 1 when
+    the platform lacks devices), and sorted MV rows for cross-verify."""
+    from risingwave_tpu.config import DeviceConfig
+    from risingwave_tpu.sql import Database
+    db = Database(device=DeviceConfig(capacity=capacity,
+                                      mesh_shards=shards,
+                                      mv_persist_every=MV_PERSIST_EVERY),
+                  checkpoint_frequency=CKPT_EVERY)
+    for s in srcs:
+        db.run(s.format(n=n_events, c=chunk))
+    for mv in mv_sqls:
+        db.run(mv)
+    dt = drive(db, n_events, chunk=chunk)
+    jobs = db._fused
+    eff = max([j.mesh_shards for j in jobs.values()] or [1])
+    exch = sum(j.profiler.totals.get("exchange", 0.0)
+               for j in jobs.values())
+    rows = {m: sorted(db.query(f"SELECT * FROM {m}")) for m in mv_names}
+    return n_events / dt, exch, eff, rows, _cap_stats(db)
+
+
+def _shards_sweep(key, mv_sqls, mv_names, srcs, n_events, chunk, capacity,
+                  warm_pass):
+    out = {"events": n_events, "note":
+           "same fused SQL, DeviceConfig.mesh_shards swept; device_eps = "
+           "steady state" + (" (second pass, jit-cached)" if warm_pass
+                             else " (single pass incl. warmup)") +
+           "; exchange_s = wall of the in-program all_to_all dispatch "
+           "stage; MV rows cross-verified bit-identical between shard "
+           "counts"}
+    rows_ref = None
+    for shards in SHARDS_SWEEP:
+        if warm_pass:
+            _shards_pass(shards, mv_sqls, mv_names, srcs, n_events, chunk,
+                         capacity)
+        eps, exch, eff, rows, caps = _shards_pass(
+            shards, mv_sqls, mv_names, srcs, n_events, chunk, capacity)
+        if rows_ref is None:
+            rows_ref = rows
+        else:
+            assert rows == rows_ref, "sharded MV diverged from 1-shard"
+        out[str(shards)] = {"device_eps": round(eps),
+                            "exchange_s": round(exch, 2),
+                            "effective_shards": eff,
+                            "capacity": caps}
+        out["mv_verified"] = rows_ref is not None
+    lo, hi = str(SHARDS_SWEEP[0]), str(SHARDS_SWEEP[-1])
+    if out.get(lo, {}).get("device_eps"):
+        out[f"speedup_{hi}v{lo}"] = round(
+            out[hi]["device_eps"] / out[lo]["device_eps"], 3)
+    return {key: out}
+
+
+def stage_shards_q4(n_events):
+    return _shards_sweep("shards_sweep_q4", [Q4_MV], ["q4"], [BID_SRC],
+                        n_events, Q4_CHUNK, 1 << 19, warm_pass=True)
+
+
+def stage_shards_qx(n_events):
+    return _shards_sweep(
+        "shards_sweep_q5_q7_q8", [Q5_MV, Q7_MV, Q8_MV],
+        ["nexmark_q5", "nexmark_q7", "nexmark_q8"],
+        [BID_SRC, AUCTION_SRC, PERSON_SRC],
+        n_events, QX_CHUNK, QX_CAPACITY, warm_pass=False)
+
+
+# ---------------------------------------------------------------------------
 # the un-killable harness
 # ---------------------------------------------------------------------------
 
@@ -529,6 +608,8 @@ _STAGES = {
     "q4_host": stage_q4_host,
     "qx_device": stage_qx_device,
     "qx_host": stage_qx_host,
+    "shards_q4": stage_shards_q4,
+    "shards_qx": stage_shards_qx,
 }
 
 
@@ -542,8 +623,17 @@ def _stage_child(name, args, out_path):
         # (fused: 1.64B vs 984M ev/s, compile 30s vs 229s); q4's
         # 1M-capacity agg measures faster with the variadic-sort forms
         # (1.17M vs 350k ev/s warm). Must be set before jax imports.
-        if name in ("fused", "qx_device"):
+        if name in ("fused", "qx_device", "shards_qx"):
             os.environ["RW_TPU_CHEAP_COMPILE"] = "1"
+        if name.startswith("shards"):
+            # mesh fallback for CPU-only hosts: 8 virtual devices (the
+            # flag is inert when the default platform has real chips);
+            # must land before jax initializes in this child
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
         result = _STAGES[name](*args)
         payload = {"ok": True, "result": result}
     except BaseException as e:  # report, don't propagate — parent decides
@@ -666,7 +756,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r06.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r07.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -680,7 +770,7 @@ class Harness:
 def main():
     smoke = "--smoke" in sys.argv
     total = float(os.environ.get("RW_BENCH_BUDGET", "100" if smoke
-                                 else "2400"))
+                                 else "3400"))
     h = Harness(total, record=not smoke)
     if smoke:
         h.run_stage("fused", (10, 65_536), 60)
@@ -688,6 +778,8 @@ def main():
         h.run_stage("q4_host", (32_768,), 30)
         h.run_stage("qx_device", (262_144,), 60)
         h.run_stage("qx_host", (8_192,), 30)
+        h.run_stage("shards_q4", (262_144,), 90)
+        h.run_stage("shards_qx", (65_536,), 90)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
         # compile of a fused epoch program set is ~200-400s on the remote-
@@ -707,6 +799,12 @@ def main():
                 h.run_stage("q4_device", (Q4_SQL_EVENTS[0],), 300,
                             " — retry (warmer still)")
         h.run_stage("q4_host", (HOST_SQL_EVENTS,), 60)
+        # mesh-shard sweep (ISSUE 7): the SAME fused q4 SQL at 1 vs 8
+        # chips — warm + measured pass per shard count at a quarter of
+        # the headline scale, MVs cross-verified bit-identical
+        if not h.run_stage("shards_q4", (SHARDS_Q4_EVENTS,), 700):
+            h.run_stage("shards_q4", (SHARDS_Q4_EVENTS,), 500,
+                        " — retry (warmer)")
         # warmup + measured pass + three numpy oracles ≈ 650-850s warm
         if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 1200):
             if not h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 900,
@@ -714,6 +812,10 @@ def main():
                 h.run_stage("qx_device", (QX_SQL_EVENTS[0],), 700,
                             " — retry (warmer still)")
         h.run_stage("qx_host", (HOST_QX_EVENTS,), 60)
+        # q5/q7/q8 shard sweep: single pass per shard count (the qx
+        # programs are compile-heavy; the cache from qx_device warms 1-
+        # shard, the 8-shard pass pays its own compiles once)
+        h.run_stage("shards_qx", (QX_SQL_EVENTS[0],), 900)
     h.emit()
 
 

@@ -32,6 +32,15 @@ class DeviceConfig:
     mesh: Optional[Any] = None
     capacity: int = 1024
     minmax: bool = True
+    # mesh-sharded FUSED programs (device/shard_exec.py): eligible fused
+    # MV fragments execute as ONE shard_map'd epoch program over an
+    # n-device 1-D mesh — node state carries a leading shard axis with a
+    # vnode-keyed PartitionSpec, the cross-vnode shuffle for joins/aggs
+    # runs as an in-program all_to_all over ICI, and global stats reduce
+    # via psum/pmax. 1 = today's single-chip fused path, byte-for-byte
+    # unchanged. Distinct from `mesh`, which shards the PER-OPERATOR
+    # host executors (parallel/sharded_*) and disables fusion.
+    mesh_shards: int = 1
     # whole-fragment fusion (device/fuse_planner.py): eligible MV plans
     # become one jitted epoch program. Off forces the per-operator path.
     fuse: bool = True
@@ -174,7 +183,7 @@ class NodeConfig:
         if dev is not None:
             mode = dev.pop("mode", "off")
             for k in dev:
-                if k not in ("capacity", "minmax", "fuse",
+                if k not in ("capacity", "minmax", "fuse", "mesh_shards",
                              "mv_persist_every", "predictive_growth",
                              "hbm_budget_mb", "compile_cache_dir",
                              "profile", "aot_compile", "compile_buckets"):

@@ -278,7 +278,24 @@ def cmd_compile_status(args) -> int:
     their shapes onto the background pool — so the report shows exactly
     what a restarting operator would see: signatures already in the
     persistent cache load as fast `cached` entries, fresh shapes sit
-    `pending` until their background compile lands."""
+    `pending` until their background compile lands.
+
+    --offline skips the Database entirely and reads the
+    `compile_manifest.json` mirror the service writes into the data dir
+    at every save: which plan shapes and signatures were ever compiled
+    (and their cost), straight from a DEAD directory — no process, no
+    jax import, no recompiles."""
+    if args.offline:
+        from ..device.compile_service import offline_report, read_manifest
+        m = read_manifest(args.data_dir)
+        if m is None:
+            print("no compile manifest (directory has no "
+                  "compile_manifest.json mirror — the data dir predates "
+                  "manifest mirroring, or never ran with aot_compile on; "
+                  "RW_COMPILE_CACHE_DIR names the cache-dir fallback)")
+            return 1
+        print(json.dumps(offline_report(m), indent=2, sort_keys=True))
+        return 0
     from ..device.compile_service import get_service
     from ..sql import Database
     db = Database(data_dir=args.data_dir, device="auto")
@@ -354,6 +371,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--wait", type=float, default=0.0,
                     help="seconds to let in-flight background compiles "
                          "finish before reporting")
+    sp.add_argument("--offline", action="store_true",
+                    help="read the data dir's compile_manifest.json "
+                         "mirror instead of opening a Database (works "
+                         "on a dead directory)")
     sp.set_defaults(fn=cmd_compile_status)
     sp = sub.add_parser("backup")
     sp.add_argument("--data-dir", required=True)

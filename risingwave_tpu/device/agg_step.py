@@ -258,6 +258,23 @@ def epoch_core_full(spec: DeviceAggSpec, state: DeviceAggState,
             (needed, tuple(ms_needed)), ch)
 
 
+def local_epoch_step(spec: DeviceAggSpec, state: DeviceAggState,
+                     keys: jax.Array, signs: jax.Array, mask: jax.Array,
+                     inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """One epoch's LOCAL aggregation step over the rows this program
+    instance owns. On a single chip that is every row; under mesh
+    sharding (`device/shard_exec.py`) it is the shard's exchange-routed
+    rows. The step is closed under vnode partitioning: groups partition
+    by the vnode of their packed key, every row of a group reaches the
+    group's owning shard (in global event order — the exchange flatten
+    is source-major over contiguous event blocks), and count/sum/min/max
+    reductions touch no cross-group state — so running it per shard is
+    bit-identical to the global step, and the returned capacity needs
+    are per-shard needs the pmax'd stats contract reports as the fleet
+    high-water."""
+    return epoch_core_full(spec, state, keys, signs, mask, inputs)
+
+
 @partial(jax.jit, static_argnames=("spec",))
 def agg_epoch_step_full(spec: DeviceAggSpec, state: DeviceAggState,
                         keys: jax.Array, signs: jax.Array, mask: jax.Array,

@@ -91,6 +91,19 @@ def project(need: int, events_seen: int, horizon: Optional[int],
     return need * UNBOUNDED_STEP
 
 
+def exchange_cap(epoch_events: int, n_shards: int, lo: int = 256) -> int:
+    """Initial per-(source, dest) send-bucket capacity of the in-program
+    ICI exchange (`device/shard_exec.py`): a shard holds 1/n of the
+    epoch's rows and, under uniform key hashing, sends 1/n of those to
+    each destination — so the expected bucket fill is events/n^2. 2x
+    headroom plus the pow2 bucket covers moderate skew; a genuinely hot
+    destination overflows the "exch" stat once and the normal
+    grow+replay path resizes it (per-epoch-bounded, flat headroom). The
+    floor keeps degenerate cadences from thrashing growth."""
+    per_dest = max(1, epoch_events // max(1, n_shards * n_shards))
+    return bucket(2 * per_dest, lo=lo)
+
+
 def node_hbm_bytes(node) -> int:
     """Allocated HBM bytes of one node's declared capacity slots (the
     declarative interface: cap_current x cap_bytes). 0 for stateless

@@ -49,7 +49,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["CompileService", "get_service", "shutdown"]
+__all__ = ["CompileService", "get_service", "shutdown", "read_manifest",
+           "offline_report"]
 
 _WORKERS = max(1, min(4, (os.cpu_count() or 2) - 1))
 MANIFEST_FILE = "compile_manifest.json"
@@ -71,12 +72,25 @@ def _avals_of(tree) -> Tuple:
     return treedef, tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
 
 
-def _sds_of(tree):
+def _sds_of(tree, mesh=None):
     """ShapeDtypeStruct mirror of a pytree of concrete arrays (what the
-    background thread lowers against — never the live buffers)."""
+    background thread lowers against — never the live buffers). For a
+    mesh-sharded signature the leaves' NamedShardings ride along — a
+    plain SDS would lower a single-device layout the mesh-placed epoch
+    arrays could never feed."""
     import jax
-    return jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+    if mesh is None:
+        return jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree)
+    from jax.sharding import NamedSharding
+
+    def sds(l):
+        sh = getattr(l, "sharding", None)
+        return jax.ShapeDtypeStruct(
+            l.shape, l.dtype,
+            sharding=sh if isinstance(sh, NamedSharding) else None)
+
+    return jax.tree_util.tree_map(sds, tree)
 
 
 def clone_nodes(nodes) -> List[Any]:
@@ -92,15 +106,26 @@ def clone_nodes(nodes) -> List[Any]:
     return out
 
 
-def abstract_program_avals(nodes, epoch_events: int):
+def abstract_program_avals(nodes, epoch_events: int, mesh=None):
     """Per-node (state, ins, extra) ShapeDtypeStruct trees from an
     abstract `jax.eval_shape` walk — the same dataflow FusedProgram.epoch
     runs, with zero FLOPs and zero HBM. Lets the service lower shapes
     that have never executed (CREATE-time cold start, predicted growth
-    buckets)."""
+    buckets). With a mesh, the walk mirrors the SHARDED dataflow: states
+    carry the leading shard axis, exchanged inputs take the routed
+    [n_shards * exch]-row shape, and every sharded leaf carries its
+    NamedSharding so the lowered executables match live dispatch.
+
+    Returns the per-node (state, ins, extra) aval trees. The in-program
+    exchange stages are NOT lowered here — they are small programs that
+    jit inline on first dispatch (`shard_exec._exchange_jit`) and land in
+    the persistent XLA cache like any other trace; only the per-node
+    epoch steps are compile-service-managed."""
     import jax
     import jax.numpy as jnp
     from .fused import MVKeyedNode
+    if mesh is not None:
+        return _abstract_sharded_avals(nodes, epoch_events, mesh)
     states = [jax.eval_shape(n.init_state) for n in nodes]
     outs: List[Any] = []
     auxes: List[Any] = []
@@ -122,6 +147,52 @@ def abstract_program_avals(nodes, epoch_events: int):
     return per_node
 
 
+def _abstract_sharded_avals(nodes, epoch_events: int, mesh):
+    """The sharded mirror of `abstract_program_avals`: lift each node's
+    local state to [n_shards, ...], route exchange inputs through the
+    shape-faithful abstract exchange, and walk the per-shard steps."""
+    import jax
+    import jax.numpy as jnp
+    from .fused import MVKeyedNode
+    from .shard_exec import exchange_apply, sds_sharded, sharded_apply
+    n = mesh.devices.size
+
+    def lift_sds(tree):
+        return jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct((n,) + tuple(s.shape), s.dtype),
+            tree)
+
+    states = [sds_sharded(lift_sds(jax.eval_shape(node.init_state)), mesh)
+              for node in nodes]
+    outs: List[Any] = []
+    auxes: List[Any] = []
+    per_node = []
+    for i, node in enumerate(nodes):
+        ins = [outs[j] for j in node.inputs]
+        if node.exch is not None:
+            for xi, ex in enumerate(node.shard_spec().exchanges):
+                routed = jax.eval_shape(
+                    lambda d, _x=xi: exchange_apply(mesh, node, _x, d,
+                                                    abstract=True)[0],
+                    ins[ex.input])
+                ins[ex.input] = sds_sharded(routed, mesh)
+        ins = tuple(ins)
+        if node.takes_event_lo:
+            extra = jax.ShapeDtypeStruct((), jnp.int64)
+        elif isinstance(node, MVKeyedNode):
+            extra = auxes[node.inputs[0]]
+        else:
+            extra = None
+        st, out, _stats, aux = jax.eval_shape(
+            lambda s, i_, e, _n=node: sharded_apply(
+                mesh, _n, epoch_events, s, tuple(i_), e, abstract=True),
+            states[i], ins, extra)
+        per_node.append((states[i], ins, extra))
+        outs.append(sds_sharded(out, mesh))
+        auxes.append(sds_sharded(aux, mesh))
+    return per_node
+
+
 class CompileEntry:
     """One (signature, capacity bucket, avals) executable and its
     lifecycle: pending -> ready | failed. `jobs` maps job name -> True
@@ -130,10 +201,10 @@ class CompileEntry:
 
     __slots__ = ("key", "digest", "label", "status", "compiled", "seconds",
                  "bucket", "kind", "cache_hit", "error", "jobs", "sds",
-                 "node", "epoch_events", "salt", "profiler")
+                 "node", "epoch_events", "salt", "profiler", "mesh")
 
     def __init__(self, key, digest, label, node, epoch_events, salt, sds,
-                 kind, profiler):
+                 kind, profiler, mesh=None):
         self.key = key
         self.digest = digest
         self.label = label
@@ -150,6 +221,7 @@ class CompileEntry:
         self.error: Optional[str] = None
         self.jobs: Dict[str, bool] = {}
         self.profiler = profiler
+        self.mesh = mesh                # device mesh of a sharded trace
 
     def state_for(self, job: str) -> str:
         if self.status != "ready":
@@ -180,10 +252,15 @@ class CompileService:
         self.compiles_failed = 0
         self.cache_hits = 0
         self.eager_steps = 0
+        self.inline_steps = 0
         self.compiled_steps = 0
         self._manifest: Dict[str, Any] = {}
         self._manifest_loaded = False
         self._manifest_dirty = False
+        # data directories that get a copy of the compile manifest on
+        # every save: `risectl compile-status --offline` reads it from a
+        # DEAD data dir, no live process or XLA cache dir needed
+        self._mirror_dirs: set = set()
 
     # ---- worker pool ----------------------------------------------------
     def _ensure_workers(self) -> None:
@@ -251,14 +328,24 @@ class CompileService:
 
     # ---- keys / manifest ------------------------------------------------
     @staticmethod
-    def _key(node, epoch_events: int, state, ins, extra) -> Tuple:
+    def _key(node, epoch_events: int, state, ins, extra, mesh=None) -> Tuple:
+        from .shard_exec import mesh_fingerprint
         return (type(node).__name__, node._sig(), node._mut_sig(),
-                epoch_events, _avals_of((state, ins, extra)))
+                epoch_events, mesh_fingerprint(mesh),
+                _avals_of((state, ins, extra)))
 
     @staticmethod
-    def _digest(node, epoch_events: int, salt, avals) -> str:
+    def _digest(node, epoch_events: int, salt, meshfp, avals) -> str:
+        # the mesh fingerprint keys sharded executables apart from
+        # single-chip ones (and 4-chip from 8-chip): "(plan hash, mesh
+        # shape)" at the per-signature grain. meshfp=None (single-chip)
+        # keeps the pre-mesh tuple shape so persistent manifest digests
+        # from older releases stay valid across the upgrade
+        if meshfp is None:
+            return _stable_digest((type(node).__name__, node._sig(), salt,
+                                   epoch_events, avals[1]))
         return _stable_digest((type(node).__name__, node._sig(), salt,
-                               epoch_events, avals[1]))
+                               epoch_events, meshfp, avals[1]))
 
     def _manifest_path(self) -> Optional[str]:
         try:
@@ -282,19 +369,39 @@ class CompileService:
         self._manifest.setdefault("keys", {})
         self._manifest.setdefault("plans", {})
 
+    def attach_dir(self, data_dir: str) -> None:
+        """Mirror the compile manifest into this data directory (written
+        at every save), so a dead data dir still answers `risectl
+        compile-status --offline` — the PR 6 residual."""
+        with self._lock:
+            self._load_manifest()
+            self._mirror_dirs.add(data_dir)
+            self._manifest_dirty = True
+        # flush immediately: a warm-started job (zero fresh compiles, so
+        # no per-compile flush ever fires) must still leave its dir's
+        # mirror readable if the process dies before idle/shutdown
+        self._save_manifest()
+
     def _save_manifest(self) -> None:
-        path = self._manifest_path()
-        if path is None or not self._manifest_dirty:
-            return
-        try:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._manifest, f, indent=1, sort_keys=True)
-            os.replace(tmp, path)
+        # the writes happen under the lock too: a save that serialized an
+        # older manifest must not land AFTER a newer one (worker threads
+        # flush per compile) — the files are tiny, the hold is cheap
+        with self._lock:
+            if not self._manifest_dirty:
+                return
+            blob = json.dumps(self._manifest, indent=1, sort_keys=True)
+            paths = [p for p in [self._manifest_path()] if p] + \
+                [os.path.join(d, MANIFEST_FILE) for d in self._mirror_dirs]
             self._manifest_dirty = False
-        except OSError:
-            pass                         # manifests are advisory only
+            for path in paths:
+                try:
+                    os.makedirs(os.path.dirname(path), exist_ok=True)
+                    tmp = path + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.write(blob)
+                    os.replace(tmp, path)
+                except OSError:
+                    pass                 # manifests are advisory only
 
     def note_plan(self, plan_hash: str, job: str, labels: List[str]) -> None:
         with self._lock:
@@ -314,7 +421,7 @@ class CompileService:
     # ---- the dispatch seam ---------------------------------------------
     def node_step(self, node, epoch_events: int, state, ins, extra, *,
                   label: str, job: Optional[str] = None, profiler=None,
-                  kind: Optional[str] = None):
+                  kind: Optional[str] = None, mesh=None):
         """The fused epoch step, compile-service-managed:
 
         ready  -> call the AOT executable (zero trace, zero compile)
@@ -322,16 +429,23 @@ class CompileService:
                   while the background compile proceeds; the swap happens
                   at the next barrier that finds the entry ready
         failed -> permanent inline-jit fallback for this signature
+
+        `mesh` selects the shard_map'd step (device/shard_exec.py): the
+        executable is lowered through `sharded_jit_step`, keyed apart by
+        the mesh fingerprint. Sharded signatures never take the
+        interpreted bridge — pending means the inline-jit step (one
+        blocking compile through the same trace the AOT worker lowers).
         """
         import jax
-        key = self._key(node, epoch_events, state, ins, extra)
+        key = self._key(node, epoch_events, state, ins, extra, mesh)
         with self._lock:
             ent = self._entries.get(key)
             if ent is None:
                 ent = self._request_locked(
-                    key, node, epoch_events, _sds_of((state, ins, extra)),
+                    key, node, epoch_events,
+                    _sds_of((state, ins, extra), mesh),
                     label=label, job=job, profiler=profiler,
-                    kind=kind or "compile")
+                    kind=kind or "compile", mesh=mesh)
             elif job is not None and job not in ent.jobs:
                 ent.jobs[job] = False    # shared/cached for this job
         if ent.status == "ready":
@@ -344,19 +458,38 @@ class CompileService:
                 ent.status = "failed"
                 ent.error = f"dispatch: {type(e).__name__}: {e}"
         if ent.status == "failed":
+            if mesh is not None:
+                from .shard_exec import sharded_node_step
+                return sharded_node_step(mesh, node, epoch_events, state,
+                                         ins, extra)
             from .fused import _node_step
             return _node_step(node, epoch_events, state, ins, extra)
+        if mesh is not None:
+            # No eager bridge for sharded signatures: op-by-op eager
+            # dispatch re-enters the shard_map machinery per PRIMITIVE
+            # (tens of seconds per epoch on an 8-way mesh — worse than
+            # any compile it would hide), so the non-blocking-warmup
+            # trade the bridge makes for single-chip programs is a loss
+            # here. Take the inline-jit step instead: it blocks ONCE on
+            # a compile of the same `sharded_jit_step` trace the AOT
+            # worker lowers through, and every later epoch of this
+            # signature hits that jit cache even before the swap.
+            with self._lock:
+                self.inline_steps += 1
+            from .shard_exec import sharded_node_step
+            return sharded_node_step(mesh, node, epoch_events, state,
+                                     ins, extra)
         with self._lock:
             self.eager_steps += 1
         with jax.disable_jit():
             return node.apply(state, list(ins), extra, epoch_events)
 
     def _request_locked(self, key, node, epoch_events, sds, *, label, job,
-                        profiler, kind) -> CompileEntry:
+                        profiler, kind, mesh=None) -> CompileEntry:
         self._load_manifest()
-        digest = self._digest(node, epoch_events, key[2], key[4])
+        digest = self._digest(node, epoch_events, key[2], key[4], key[5])
         ent = CompileEntry(key, digest, label, node, epoch_events, key[2],
-                           sds, kind, profiler)
+                           sds, kind, profiler, mesh=mesh)
         ent.cache_hit = digest in self._manifest["keys"]
         if job is not None:
             ent.jobs[job] = True         # this job pays for the compile
@@ -376,7 +509,12 @@ class CompileService:
             state_s, ins_s, extra_s = ent.sds
             t0 = time.perf_counter()
             try:
-                lowered = _jit_step().lower(
+                if ent.mesh is not None:
+                    from .shard_exec import sharded_jit_step
+                    step = sharded_jit_step(ent.mesh)
+                else:
+                    step = _jit_step()
+                lowered = step.lower(
                     state_s, ins_s, extra_s, node=ent.node,
                     epoch_events=ent.epoch_events, salt=ent.salt)
                 ent.compiled = lowered.compile()
@@ -395,9 +533,14 @@ class CompileService:
                 self.compiles_done += 1
                 if ent.cache_hit:
                     self.cache_hits += 1
-                self._manifest["keys"][ent.digest] = {
-                    "label": ent.label, "s": round(ent.seconds, 3)}
+                rec = {"label": ent.label, "s": round(ent.seconds, 3)}
+                if ent.mesh is not None:
+                    rec["shards"] = int(ent.mesh.devices.size)
+                self._manifest["keys"][ent.digest] = rec
                 self._manifest_dirty = True
+            # flush now (cheap, small json): a process that dies mid-run
+            # still leaves its mirror manifests readable offline
+            self._save_manifest()
             if ent.profiler is not None and ent.profiler.enabled:
                 # bucket "()" = capacity rides in the avals, not the salt
                 ent.profiler.compile_event(
@@ -409,11 +552,14 @@ class CompileService:
     def prewarm_program(self, nodes, epoch_events: int, *, job: str,
                         profiler=None, plan_hash: Optional[str] = None,
                         caps: Optional[Dict[int, Dict[str, int]]] = None,
-                        labels: Optional[List[str]] = None) -> None:
+                        labels: Optional[List[str]] = None,
+                        mesh=None) -> None:
         """Schedule background AOT for a program's node shapes — the
         current ones (caps=None) or a predicted growth bucket (caps =
-        {node index: {slot: capacity}}). The abstract aval walk AND the
-        lowering both run on the worker pool; the caller returns
+        {node index: {slot: capacity}}). With a mesh, the walk and the
+        lowering both take the sharded path, so warm starts of
+        mesh-sharded jobs are zero-compile too. The abstract aval walk
+        AND the lowering both run on the worker pool; the caller returns
         immediately (CREATE-time kickoff must not block the session)."""
         cloned = clone_nodes(nodes)
         for i, c in (caps or {}).items():
@@ -427,13 +573,15 @@ class CompileService:
             if self.hold is not None:
                 self.hold.wait()
             try:
-                per_node = abstract_program_avals(cloned, epoch_events)
+                per_node = abstract_program_avals(cloned, epoch_events,
+                                                  mesh)
             except Exception:
                 return                   # unwalkable plan: dispatch-time
             with self._lock:             # scheduling still covers it
                 for i, (node, (st, ins, extra)) in enumerate(
                         zip(cloned, per_node)):
-                    key = self._key(node, epoch_events, st, ins, extra)
+                    key = self._key(node, epoch_events, st, ins, extra,
+                                    mesh)
                     ent = self._entries.get(key)
                     if ent is None:
                         lab = labels[i] if labels and i < len(labels) else \
@@ -441,7 +589,7 @@ class CompileService:
                         self._request_locked(
                             key, node, epoch_events, (st, ins, extra),
                             label=lab, job=job, profiler=profiler,
-                            kind="compile")
+                            kind="compile", mesh=mesh)
                     elif job not in ent.jobs:
                         ent.jobs[job] = False
         self._submit(task)
@@ -457,6 +605,8 @@ class CompileService:
         return [{"label": e.label, "bucket": repr(e.bucket),
                  "state": e.status if job is None else e.state_for(job),
                  "kind": e.kind, "s": round(e.seconds, 3),
+                 "shards": (int(e.mesh.devices.size)
+                            if e.mesh is not None else 1),
                  "cache_hit": e.cache_hit, "error": e.error}
                 for e in sorted(ents, key=lambda e: e.label)]
 
@@ -469,7 +619,57 @@ class CompileService:
                 "cache_hits": self.cache_hits,
                 "pending": pending,
                 "eager_steps": self.eager_steps,
+                "inline_steps": self.inline_steps,
                 "compiled_steps": self.compiled_steps}
+
+
+# ---------------------------------------------------------------------------
+# offline manifest reading (risectl compile-status --offline)
+# ---------------------------------------------------------------------------
+
+
+def read_manifest(data_dir: Optional[str] = None) -> Optional[Dict]:
+    """Load a compile manifest WITHOUT a live process: prefer the data
+    dir's mirror copy (written by `attach_dir` at every save), fall back
+    to the persistent-cache dir named by RW_COMPILE_CACHE_DIR. Returns
+    None when neither exists — the dir predates manifest mirroring or
+    never ran with AOT on."""
+    candidates = []
+    if data_dir:
+        candidates.append(os.path.join(data_dir, MANIFEST_FILE))
+    env = os.environ.get("RW_COMPILE_CACHE_DIR")
+    if env:
+        candidates.append(os.path.join(env, MANIFEST_FILE))
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            continue
+        m.setdefault("keys", {})
+        m.setdefault("plans", {})
+        m["_path"] = path
+        return m
+    return None
+
+
+def offline_report(manifest: Dict) -> Dict[str, Any]:
+    """Dead-data-dir compile-status: which plan shapes and signatures
+    were ever compiled (their executables are persistent-cache hits for
+    the next process), and what the compiles cost."""
+    keys = manifest.get("keys", {})
+    return {
+        "manifest": manifest.get("_path"),
+        "plans": manifest.get("plans", {}),
+        "signatures": len(keys),
+        "sharded_signatures": sum(1 for v in keys.values()
+                                  if v.get("shards", 1) > 1),
+        "compile_seconds": round(sum(v.get("s") or 0
+                                     for v in keys.values()), 3),
+        "keys": keys,
+    }
 
 
 _SERVICE: Optional[CompileService] = None

@@ -470,8 +470,24 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                       _side_dtypes(m.dtypes),
                                       f.capacity))
             pull = MVPull("pair", mv_idx, m.dtypes, m.decoders)
-        program = FusedProgram(f.nodes, f.epoch_events or 8192 * 64)
-        ph = plan_shape_hash(program.nodes, program.epoch_events)
+        ee = f.epoch_events or 8192 * 64
+        mesh = _fused_mesh(device_cfg, ee)
+        if mesh is not None:
+            # arm the declarative exchange stages: every node whose
+            # shard_spec names exchange inputs (aggs route on the group
+            # key, joins on both join keys) gets its [n_shards, exch]
+            # send bucket sized from the epoch cadence; overflow rides
+            # the "exch" stat into the normal grow+replay path
+            from .capacity import exchange_cap
+            n = mesh.devices.size
+            cap0 = exchange_cap(ee, n)
+            for node in f.nodes:
+                if node.shard_spec().exchanges:
+                    node.enable_exchange(
+                        cap0, slot_bytes=8 * n * _exchange_row_width(node))
+        program = FusedProgram(f.nodes, ee, mesh=mesh)
+        ph = plan_shape_hash(program.nodes, program.epoch_events,
+                             mesh.devices.size if mesh is not None else 1)
         hints = (cap_registry or {}).get(ph) or {}
         if hints:
             # structural shape keys must match exactly: a hint from a
@@ -502,6 +518,43 @@ def try_fuse(execu, ns, device_cfg, name: str,
                         plan_hash=ph)
     except FuseReject:
         return None
+
+
+def _fused_mesh(device_cfg, epoch_events: int):
+    """The 1-D device mesh a fused program shards over, or None for the
+    single-chip path. `DeviceConfig.mesh_shards` opts in; the epoch
+    cadence must split evenly into contiguous per-shard event blocks,
+    and the platform must actually have the devices (mesh.make_mesh
+    falls back to virtual CPU devices under
+    --xla_force_host_platform_device_count, the tier-1 test substrate).
+    Any miss degrades silently to one chip — sharding is an execution
+    detail, never an eligibility cliff."""
+    n = max(1, int(getattr(device_cfg, "mesh_shards", 1) or 1))
+    if n <= 1 or epoch_events % n != 0:
+        return None
+    from ..parallel.mesh import make_mesh
+    try:
+        return make_mesh(n)
+    except (ValueError, RuntimeError):
+        return None
+
+
+def _exchange_row_width(node) -> int:
+    """Arrays one exchanged row actually buffers (shard_exec
+    `_exchange_local`: the exchange's declared ref columns — or every
+    input column when undeclared — plus sign, plus pk when carried),
+    worst case across the node's exchange stages. Budget math only."""
+    widths = []
+    for ex in node.shard_spec().exchanges:
+        if ex.ref_idx is not None:
+            w = len(ex.ref_idx)
+        elif isinstance(node, JoinNode):
+            # a join side's input delta carries exactly its val columns
+            w = (len(node.l_val_dtypes), len(node.r_val_dtypes))[ex.input]
+        else:
+            w = 3
+        widths.append(w + 1 + (1 if ex.carry_pk else 0))
+    return max(widths, default=4)
 
 
 def _side_dtypes(dts: Sequence[DataType]):

@@ -84,6 +84,37 @@ _register_delta()
 NUM = ("num",)
 
 
+@dataclass(frozen=True)
+class ShardExchange:
+    """One input of a node that must be vnode-routed before the node's
+    per-shard local step can run: rows of `inputs[input]` whose key
+    (packed from `key_idx` with the node's PackPlan) hashes to another
+    shard's vnode block travel over the in-program ICI exchange
+    (`shard_exec.exchange_delta`). `carry_pk` keeps the delta's row
+    identity through the shuffle (joins net pairs by it). `ref_idx`
+    names the input columns the node actually reads (None = all): only
+    those are buffered and shipped over ICI — the routed delta zero-
+    fills the rest, which the node by declaration never touches."""
+    input: int
+    key_idx: Tuple[int, ...]
+    carry_pk: bool = False
+    ref_idx: Optional[Tuple[int, ...]] = None
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """A node's declarative mesh-sharding contract (the ROADMAP-
+    anticipated fuse-planner refactor): `state` says how the node's
+    device state partitions over the shard axis — "local" (stateless, or
+    per-shard private) vs "vnode" (keyed by the vnode of its group/join/
+    pk key, the contiguous-block layout of `parallel/mesh.py`) — and
+    `exchanges` names the inputs that need the cross-vnode shuffle
+    first. The planner and `shard_exec` consume this; nothing here is
+    specific to any one node class."""
+    state: str = "local"
+    exchanges: Tuple[ShardExchange, ...] = ()
+
+
 def _nrows(mask):
     """Device row count of a boolean mask (profiler stats: one scalar in
     the existing stats vector, no extra sync)."""
@@ -214,11 +245,48 @@ class Node:
     # subset of stat_names that accumulate across epochs by SUM (row-flow
     # counters); everything else accumulates by MAX (capacity needs,
     # violation flags). The job's stats accumulator honors this split.
+    # Under mesh sharding the same split picks the in-program collective:
+    # psum for sums, pmax for high-water needs (shard_exec.sharded_apply).
     stat_sums: Tuple[str, ...] = ()
     takes_event_lo: bool = False
+    # mesh sharding (device/shard_exec.py): per-(source,dest) send-bucket
+    # capacity of the in-program all_to_all exchange. None = this node
+    # runs un-exchanged (stateless nodes, or a single-chip program);
+    # stateful Agg/Join nodes get it via enable_exchange when the owning
+    # FusedProgram has a mesh. A real capacity slot ("exch"): observed
+    # per-epoch bucket high-water rides the stats vector and the normal
+    # grow+replay path resizes it.
+    exch: Optional[int] = None
+    # HBM bytes per exch slot (budget math): one buffered row across the
+    # n_shards destination buckets; the planner sets the exact per-row
+    # width when it arms the exchange (enable_exchange caller).
+    exch_bytes: int = 256
 
     def init_state(self):
         return None
+
+    # ---- mesh sharding (declarative; device/shard_exec.py executes) ----
+    def shard_spec(self) -> ShardSpec:
+        """How this node shards over the device mesh. Default: stateless/
+        local — runs per shard over whatever rows arrive, no exchange.
+        Stateful keyed nodes override with state="vnode" (+ exchanges)."""
+        return ShardSpec()
+
+    def enable_exchange(self, cap: int,
+                        slot_bytes: Optional[int] = None) -> None:
+        """Arm the in-program exchange stage for this node's flagged
+        inputs (planner-called, once, before the program is built): the
+        [n_shards, exch] send-bucket capacity becomes a real capacity
+        slot whose per-epoch high-water ("exch", appended to stat_names)
+        rides the stats vector through the normal grow+replay path.
+        `slot_bytes` is the planner's estimate of one buffered row's HBM
+        width across all destination buckets (budget math)."""
+        assert self.shard_spec().exchanges, "node has no exchange stage"
+        if self.exch is None:
+            self.stat_names = tuple(self.stat_names) + ("exch",)
+        self.exch = int(cap)
+        if slot_bytes is not None:
+            self.exch_bytes = int(slot_bytes)
 
     # ---- capacity lifecycle (FusedJob.sync / recover drive these) -------
     # Capacity is declarative: a node names its capacity slots and reports
@@ -539,6 +607,17 @@ class AggNode(Node):
                                 + ["packbad", "rows_in", "rows_out"])
         self.stat_sums = ("rows_in", "rows_out")
 
+    def shard_spec(self):
+        # state partitions by the vnode of the packed group key; the one
+        # input shuffles rows to their group's owning shard first. Only
+        # the columns apply() reads (group key + agg args) ship over ICI
+        refs = sorted(set(self.group_idx)
+                      | {c.arg.index for c in self.calls
+                         if c.arg is not None})
+        return ShardSpec("vnode",
+                         (ShardExchange(0, tuple(self.group_idx),
+                                        ref_idx=tuple(refs)),))
+
     def init_state(self):
         from .agg_step import DeviceAggState
         from .minput import ms_make
@@ -549,6 +628,8 @@ class AggNode(Node):
         caps = {"main": self.capacity}
         for i, c in enumerate(self.ms_caps):
             caps[f"ms{i}"] = c
+        if self.exch is not None:
+            caps["exch"] = self.exch
         return caps
 
     def cap_needs(self, stats):
@@ -558,6 +639,8 @@ class AggNode(Node):
         needs = {"main": max(stats["needed"], stats.get("touched", 0))}
         for i in range(len(self.ms_caps)):
             needs[f"ms{i}"] = stats[f"ms{i}"]
+        if self.exch is not None:
+            needs["exch"] = stats.get("exch", 0)
         return needs
 
     def cap_needs_cum(self, stats):
@@ -570,25 +653,35 @@ class AggNode(Node):
     def cap_needs_epoch(self, stats):
         # groups TOUCHED in one epoch bound the change-set compaction but
         # reset at every epoch — window queries touch (and retire) far
-        # more groups per epoch than ever stay live
-        return {"main": stats.get("touched", 0)}
+        # more groups per epoch than ever stay live. The exchange send
+        # bucket re-fills from scratch every epoch too.
+        needs = {"main": stats.get("touched", 0)}
+        if self.exch is not None:
+            needs["exch"] = stats.get("exch", 0)
+        return needs
 
     def cap_bytes(self):
         from .minput import MS_SLOT_BYTES
         caps = {"main": 8 * (1 + len(self.spec.dtypes))}
         for i in range(len(self.ms_caps)):
             caps[f"ms{i}"] = MS_SLOT_BYTES
+        if self.exch is not None:
+            caps["exch"] = self.exch_bytes
         return caps
 
     def preset_caps(self, caps):
         self.capacity = max(self.capacity, caps.get("main", 0))
         for i in range(len(self.ms_caps)):
             self.ms_caps[i] = max(self.ms_caps[i], caps.get(f"ms{i}", 0))
+        if self.exch is not None:
+            self.exch = max(self.exch, caps.get("exch", 0))
 
     def cap_resize(self, state, caps):
         from .agg_step import DeviceAggState
         from .minput import ms_grow
         from .sorted_state import grow_state
+        if self.exch is not None and caps.get("exch", 0) > self.exch:
+            self.exch = caps["exch"]   # jit-static: _mut_sig salts the trace
         main = state.main
         if caps.get("main", 0) > main.capacity:
             self.capacity = caps["main"]
@@ -623,11 +716,16 @@ class AggNode(Node):
                 self.pack, self.pk_pack, self.spec, self.emit_out)
 
     def _mut_sig(self):
-        return (self.capacity,)   # grow() mutates it; it shapes `bound`
+        # grow mutates both; capacity shapes `bound`, exch the exchange.
+        # exch=None (single-chip) keeps the pre-mesh salt shape so
+        # persistent manifest digests from older releases stay valid
+        if self.exch is None:
+            return (self.capacity,)
+        return (self.capacity, self.exch)
 
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
-        from .agg_step import epoch_core_full
+        from .agg_step import local_epoch_step
         d = ins[0]
         gcols = [d.cols[i] for i in self.group_idx]
         packbad = self.pack.check(gcols, d.mask & (d.sign != 0))
@@ -640,7 +738,7 @@ class AggNode(Node):
             else:
                 inputs.append((d.cols[c.arg.index],
                                jnp.ones(keys.shape, bool)))
-        new_state, _needed, ch = epoch_core_full(
+        new_state, _needed, ch = local_epoch_step(
             self.spec, state, keys, d.sign, d.mask, tuple(inputs))
         needed, ms_needed = _needed
         rows_in = _nrows(d.mask & (d.sign != 0))
@@ -726,17 +824,31 @@ class JoinNode(Node):
                            "rows_in", "rows_out")
         self.stat_sums = ("rows_in", "rows_out")
 
+    def shard_spec(self):
+        # both build sides partition by the vnode of the packed join key;
+        # both input deltas shuffle first, keeping row identity (pair
+        # netting needs each side's pk through the exchange)
+        return ShardSpec("vnode",
+                         (ShardExchange(0, tuple(self.l_keys), True),
+                          ShardExchange(1, tuple(self.r_keys), True)))
+
     def init_state(self):
         from .join_step import make_side
         return (make_side(self.cap_a, self.l_val_dtypes),
                 make_side(self.cap_b, self.r_val_dtypes))
 
     def cap_current(self):
-        return {"a": self.cap_a, "b": self.cap_b, "pairs": self.m}
+        caps = {"a": self.cap_a, "b": self.cap_b, "pairs": self.m}
+        if self.exch is not None:
+            caps["exch"] = self.exch
+        return caps
 
     def cap_needs(self, stats):
-        return {"a": stats["need_a"], "b": stats["need_b"],
-                "pairs": stats["need_pairs"]}
+        needs = {"a": stats["need_a"], "b": stats["need_b"],
+                 "pairs": stats["need_pairs"]}
+        if self.exch is not None:
+            needs["exch"] = stats.get("exch", 0)
+        return needs
 
     def cap_needs_cum(self, stats):
         # build sides accumulate rows; the pair buffer does not
@@ -744,24 +856,35 @@ class JoinNode(Node):
 
     def cap_needs_epoch(self, stats):
         # the probe-output pair buffer is re-filled from scratch every
-        # epoch — per-epoch-bounded, never horizon-extrapolated
-        return {"pairs": stats["need_pairs"]}
+        # epoch — per-epoch-bounded, never horizon-extrapolated; same for
+        # the exchange send bucket
+        needs = {"pairs": stats["need_pairs"]}
+        if self.exch is not None:
+            needs["exch"] = stats.get("exch", 0)
+        return needs
 
     def cap_bytes(self):
         # pair buffer: two probe outputs carry both sides' payloads + ids
         pair = 16 * (3 + len(self.l_val_dtypes) + len(self.r_val_dtypes))
-        return {"a": 8 * (2 + len(self.l_val_dtypes)),
+        caps = {"a": 8 * (2 + len(self.l_val_dtypes)),
                 "b": 8 * (2 + len(self.r_val_dtypes)),
                 "pairs": pair}
+        if self.exch is not None:
+            caps["exch"] = self.exch_bytes
+        return caps
 
     def preset_caps(self, caps):
         self.cap_a = max(self.cap_a, caps.get("a", 0))
         self.cap_b = max(self.cap_b, caps.get("b", 0))
         self.m = max(self.m, caps.get("pairs", 0))
         self.capacity = max(self.cap_a, self.cap_b)
+        if self.exch is not None:
+            self.exch = max(self.exch, caps.get("exch", 0))
 
     def cap_resize(self, state, caps):
         from .join_step import grow_side
+        if self.exch is not None and caps.get("exch", 0) > self.exch:
+            self.exch = caps["exch"]   # jit-static: _mut_sig salts the trace
         a, b = state
         if caps.get("a", 0) > a.jk.shape[0]:
             self.cap_a = caps["a"]
@@ -781,11 +904,15 @@ class JoinNode(Node):
                 tuple(str(d) for d in self.r_val_dtypes))
 
     def _mut_sig(self):
-        return (self.m,)              # grow() mutates the pair capacity
+        # grow mutates the pair capacity and the exchange bucket capacity
+        # (exch=None single-chip keeps the pre-mesh salt shape — see AggNode)
+        if self.exch is None:
+            return (self.m,)
+        return (self.m, self.exch)
 
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
-        from .join_step import batch_reduce_rows, join_core
+        from .join_step import local_join_step
         A, B = ins
         packbad = jnp.zeros((), jnp.int64)
         sides = []
@@ -799,18 +926,11 @@ class JoinNode(Node):
         a, b = state
         (ajk, apk, asg, amk, avals) = sides[0]
         (bjk, bpk, bsg, bmk, bvals) = sides[1]
-        new_a, new_b, o1, o2, needed = join_core(
+        # per-shard local step under mesh sharding, the whole step on one
+        # chip: probe + merge + cross-delta pair netting (join_step)
+        new_a, new_b, njk, npk, nsign, nvals, needed = local_join_step(
             a, b, ajk, apk, asg, amk, avals, bjk, bpk, bsg, bmk, bvals,
             self.m)
-        # ---- net identical pairs across the epoch's pair set ------------
-        cat = lambda k: jnp.concatenate([o1[k], o2[k]])
-        catv = lambda k, i: jnp.concatenate([o1[k][i], o2[k][i]])
-        sign = cat("sign")
-        mask = cat("mask") & (sign != 0)
-        pvals = [catv("a_vals", i) for i in range(len(avals))] \
-            + [catv("b_vals", i) for i in range(len(bvals))]
-        njk, npk, nsign, nvals = batch_reduce_rows(
-            cat("a_pk"), cat("b_pk"), sign, mask, pvals)
         omask = nsign != 0
         ocols = list(nvals)
         if self.cond is not None:
@@ -836,6 +956,12 @@ class MVKeyedNode(Node):
         self.capacity = capacity
         self.stat_names = ("needed", "rows_in")
         self.stat_sums = ("rows_in",)
+
+    def shard_spec(self):
+        # co-partitioned with its agg (the change set arrives already on
+        # the group key's owning shard) — exchange-free, as NoShuffle
+        # dictates for Materialize over an agg
+        return ShardSpec("vnode")
 
     def init_state(self):
         from .materialize import make_mv_state
@@ -894,6 +1020,12 @@ class MVPairNode(Node):
         self.capacity = capacity
         self.stat_names = ("needed", "rows_in")
         self.stat_sums = ("rows_in",)
+
+    def shard_spec(self):
+        # co-partitioned with its join: a pair lives on the shard owning
+        # its join key's vnode block, and pair identity (left pk, right
+        # pk) is globally unique — exchange-free
+        return ShardSpec("vnode")
 
     def init_state(self):
         from .join_step import make_side
@@ -957,15 +1089,20 @@ def node_shape_key(node: Node) -> str:
     return hashlib.sha1(sig.encode()).hexdigest()[:16]
 
 
-def plan_shape_hash(nodes: Sequence[Node], epoch_events: int) -> str:
+def plan_shape_hash(nodes: Sequence[Node], epoch_events: int,
+                    mesh_shards: int = 1) -> str:
     """Structural hash of a fused plan: node signatures (types, exprs,
-    dtypes, pack plans), topology (input edges), and the epoch cadence —
-    everything that shapes the traced programs, and nothing that doesn't
-    (names, program indices). Two CREATEs of identically-shaped jobs
-    collide here by design: that collision is the zero-compile warm
-    start."""
+    dtypes, pack plans), topology (input edges), the epoch cadence, and
+    the mesh shard count — everything that shapes the traced programs,
+    and nothing that doesn't (names, program indices). Two CREATEs of
+    identically-shaped jobs collide here by design: that collision is
+    the zero-compile warm start. An n-shard and a 1-shard plan never
+    collide — their executables, state layouts, and capacity high-water
+    marks are per-shard vs global quantities."""
     import hashlib
     parts = [(node_shape_key(n), n.inputs) for n in nodes]
+    if mesh_shards > 1:
+        parts.append(("mesh_shards", mesh_shards))
     return hashlib.sha1(repr((parts, epoch_events)).encode()).hexdigest()[:16]
 
 
@@ -982,9 +1119,19 @@ class MVPull:
 
 
 class FusedProgram:
-    def __init__(self, nodes: List[Node], epoch_events: int):
+    def __init__(self, nodes: List[Node], epoch_events: int, mesh=None):
         self.nodes, self.remap = _chain_nodes(nodes)
         self.epoch_events = epoch_events
+        # device mesh for shard_map'd execution (device/shard_exec.py);
+        # None = the single-chip path, byte-for-byte the pre-mesh program
+        self.mesh = mesh
+        if mesh is not None:
+            assert epoch_events % mesh.devices.size == 0, \
+                "epoch cadence must divide evenly into mesh shards"
+        # wall seconds the LAST epoch() spent dispatching exchange
+        # programs (the ICI shuffle stage) — FusedJob splits it out of
+        # the dispatch phase so ICI cost is attributable
+        self.last_exchange_s = 0.0
         # an agg whose only consumers are terminal MV appliers never needs
         # its change-delta stream (they read the aux change set instead)
         delta_consumed: Dict[int, bool] = {}
@@ -1014,7 +1161,23 @@ class FusedProgram:
         self.job_name: Optional[str] = None
 
     def init_states(self):
-        return tuple(n.init_state() for n in self.nodes)
+        states = tuple(n.init_state() for n in self.nodes)
+        if self.mesh is not None:
+            # every node's local state gains the leading shard axis and
+            # lands mesh-sharded (identical empty shards -> broadcast)
+            from .shard_exec import lift_tree
+            states = tuple(lift_tree(s, self.mesh) for s in states)
+        return states
+
+    def resize_state(self, i: int, state, caps):
+        """Grow node i's state to `caps` — through the shard axis when
+        the program is mesh-sharded (per-shard capacities; every shard
+        grows to the pmax'd high-water need)."""
+        node = self.nodes[i]
+        if self.mesh is not None:
+            from .shard_exec import sharded_resize
+            return sharded_resize(node, state, caps, self.mesh)
+        return node.cap_resize(state, caps)
 
     def _node_label(self, i: int) -> str:
         """Compile-event label: program position + structural signature —
@@ -1037,12 +1200,31 @@ class FusedProgram:
         if prof is not None and not prof.enabled:
             prof = None
         svc = self.compile_service
+        mesh = self.mesh
         outs: List[Optional[Delta]] = []
         auxes: List[Any] = []
         new_states = list(states)
         stats: List[Any] = []
+        exchange_s = 0.0
         for i, node in enumerate(self.nodes):
-            ins = tuple(outs[j] for j in node.inputs)
+            ins = [outs[j] for j in node.inputs]
+            exch_need = None
+            if mesh is not None and node.exch is not None:
+                # in-program ICI shuffle: route each flagged input's rows
+                # to the shard owning their key's vnode block. Timed so
+                # the profiler can split "exchange" out of "dispatch"
+                # (dispatch is async — this wall is enqueue cost, the
+                # device-side ICI time lands in device_sync like all
+                # device compute)
+                from .shard_exec import exchange_delta
+                t0x = _time.perf_counter()
+                for xi, ex in enumerate(node.shard_spec().exchanges):
+                    ins[ex.input], need = exchange_delta(
+                        mesh, node, xi, ins[ex.input])
+                    exch_need = need if exch_need is None \
+                        else jnp.maximum(exch_need, need)
+                exchange_s += _time.perf_counter() - t0x
+            ins = tuple(ins)
             if node.takes_event_lo:
                 extra = jnp.int64(event_lo) if not hasattr(
                     event_lo, 'dtype') else event_lo
@@ -1063,10 +1245,16 @@ class FusedProgram:
                 st, out, s, aux = svc.node_step(
                     node, self.epoch_events, states[i], ins, extra,
                     label=self._node_label(i), job=self.job_name,
-                    profiler=prof, kind=kind)
+                    profiler=prof, kind=kind, mesh=mesh)
             else:
-                st, out, s, aux = _node_step(node, self.epoch_events,
-                                             states[i], ins, extra)
+                if mesh is not None:
+                    from .shard_exec import sharded_node_step
+                    st, out, s, aux = sharded_node_step(
+                        mesh, node, self.epoch_events, states[i], ins,
+                        extra)
+                else:
+                    st, out, s, aux = _node_step(node, self.epoch_events,
+                                                 states[i], ins, extra)
                 if prof is not None:
                     dt = _time.perf_counter() - t0
                     kind = prof.pending_compile.pop(i, None)
@@ -1076,7 +1264,13 @@ class FusedProgram:
             new_states[i] = st
             outs.append(out)
             auxes.append(aux)
+            if exch_need is not None:
+                # the "exch" stat (appended to the node's stat_names by
+                # enable_exchange) is produced by the exchange stage, not
+                # the node's apply — splice it in here
+                s = list(s) + [exch_need]
             stats.extend(s)
+        self.last_exchange_s = exchange_s
         vec = jnp.stack(stats) if stats \
             else jnp.zeros((1,), jnp.int64)
         return tuple(new_states), vec
@@ -1155,17 +1349,22 @@ class FusedJob:
         from ..utils.profile import JobProfiler
         self.name = name
         self.program = program
+        self.mesh_shards = (program.mesh.devices.size
+                            if program.mesh is not None else 1)
         # epoch-timeline profiler: phase-split spans + compile events
         # (utils/profile.py). Every node's first step is a cold compile.
-        self.profiler = JobProfiler(name, enabled=profile)
+        self.profiler = JobProfiler(name, enabled=profile,
+                                    shards=self.mesh_shards)
         self.profiler.pending_compile = {
             i: "compile" for i in range(len(program.nodes))}
         program.profiler = self.profiler
         # structural identity of this plan (node sigs + topology + epoch
-        # cadence): keys the warm-start presize registry and the AOT
-        # compile manifest — survives DROP/re-CREATE, restarts, renames
+        # cadence + mesh shards): keys the warm-start presize registry
+        # and the AOT compile manifest — survives DROP/re-CREATE,
+        # restarts, renames
         self.plan_hash = plan_hash or plan_shape_hash(program.nodes,
-                                                      program.epoch_events)
+                                                      program.epoch_events,
+                                                      self.mesh_shards)
         # AOT compile service: compiles move off the epoch loop onto a
         # background pool; pending signatures serve on the interpreted
         # bridge (device/compile_service.py). Off = inline jit compiles.
@@ -1211,6 +1410,13 @@ class FusedJob:
         self.snapshot = (self.states, 0)
         self._zero_stats = jnp.zeros((max(1, len(program.stat_layout)),),
                                      jnp.int64)
+        if program.mesh is not None:
+            # sharded epochs emit mesh-replicated stat scalars; the
+            # accumulator must live on the same device set
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._zero_stats = jax.device_put(
+                self._zero_stats, NamedSharding(program.mesh, P()))
         self.stats_acc = self._zero_stats
         self._step = program.step_fn()
         self._persisted: Dict[Tuple, Tuple] = {}
@@ -1247,7 +1453,14 @@ class FusedJob:
             self.states, self.stats_acc = self._step(
                 self.states, lo, self.stats_acc)
             if prof is not None:
-                prof.phase("dispatch", _time.perf_counter() - t0)
+                dt = _time.perf_counter() - t0
+                # the ICI shuffle's enqueue wall is its own phase so the
+                # exchange stage is attributable; it was measured inside
+                # the dispatch window, so subtract to keep phases disjoint
+                ex = min(self.program.last_exchange_s, dt)
+                if ex > 0.0:
+                    prof.phase("exchange", ex)
+                prof.phase("dispatch", dt - ex)
             self.counter += self.program.epoch_events
         if barrier.is_checkpoint:
             self._checkpoint(barrier.epoch.curr)
@@ -1385,8 +1598,8 @@ class FusedJob:
                     # the profiler attributes that wall to compile, not
                     # steady-state dispatch
                     self.profiler.pending_compile[i] = "retrace"
-                    new_states.append(node.cap_resize(snap_states[i],
-                                                      grown))
+                    new_states.append(self.program.resize_state(
+                        i, snap_states[i], grown))
                 else:
                     new_states.append(snap_states[i])
             self.growth_replays += 1
@@ -1453,11 +1666,19 @@ class FusedJob:
     # ---- MV materialization --------------------------------------------
     def _pull_rows(self) -> List[Tuple]:
         import jax
+        mesh = self.program.mesh
         if self.pull.kind == "keyed":
             from .materialize import mv_rows
             st = self.states[self.pull.node_idx]
             dts = [c.acc_dtype for c in self.pull.agg.spec.calls]
-            keys, cols, nulls = mv_rows(st, dts)
+            if mesh is not None:
+                # per-shard sorted runs merge by ascending packed key —
+                # keys are globally unique (each lives on its vnode's
+                # shard), so the merged order IS the 1-shard order
+                from .shard_exec import merge_keyed_pull
+                keys, cols, nulls = merge_keyed_pull(st, mesh, dts)
+            else:
+                keys, cols, nulls = mv_rows(st, dts)
             gcols_np = _np_unpack(self.pull.agg.pack, keys)
             out_cols = []
             for pos, (kind, j) in enumerate(self.pull.out_map):
@@ -1469,9 +1690,13 @@ class FusedJob:
             n = len(keys)
         else:
             side = self.states[self.pull.node_idx]
-            n = int(side.count)
-            vals = jax.device_get([v[:n] if hasattr(v, "shape") else v
-                                   for v in side.vals])
+            if mesh is not None:
+                from .shard_exec import merge_pair_pull
+                n, vals = merge_pair_pull(side, mesh)
+            else:
+                n = int(side.count)
+                vals = jax.device_get([v[:n] if hasattr(v, "shape") else v
+                                       for v in side.vals])
             out_cols = [_format_col(self.pull.dtypes[i],
                                     self.pull.decoders[i],
                                     np.asarray(vals[i]), None)
@@ -1558,7 +1783,7 @@ class FusedJob:
         svc.prewarm_program(
             self.program.nodes, self.program.epoch_events, job=self.name,
             profiler=self.profiler if self.profiler.enabled else None,
-            plan_hash=self.plan_hash,
+            plan_hash=self.plan_hash, mesh=self.program.mesh,
             labels=[self.program._node_label(i)
                     for i in range(len(self.program.nodes))])
 
@@ -1599,6 +1824,7 @@ class FusedJob:
                 job=self.name,
                 profiler=self.profiler if self.profiler.enabled else None,
                 plan_hash=self.plan_hash, caps=caps,
+                mesh=self.program.mesh,
                 labels=[self.program._node_label(i)
                         for i in range(len(self.program.nodes))])
 
@@ -1629,23 +1855,29 @@ class FusedJob:
                                      np.maximum(self._stat_totals, vec))
 
     def _export_hbm_gauges(self) -> None:
-        """rw_hbm_bytes{job,node} + budget utilization: the HBM footprint
-        the capacity lifecycle actually allocated, checkpoint-fresh."""
+        """rw_hbm_bytes{job,node,shards} + budget utilization: the HBM
+        footprint the capacity lifecycle actually allocated, checkpoint-
+        fresh. Bytes are PER SHARD (capacities are per-shard and the
+        budget is per-chip HBM); the `shards` label says how many chips
+        each carry that footprint."""
         from ..utils.metrics import REGISTRY
         from .capacity import node_hbm_bytes
+        shards = str(self.mesh_shards)
         g = REGISTRY.gauge("rw_hbm_bytes",
-                           "fused per-node device state bytes",
-                           labels=("job", "node"))
+                           "fused per-node device state bytes (per shard)",
+                           labels=("job", "node", "shards"))
         total = 0
         for i, node in enumerate(self.program.nodes):
             if not node.cap_current():
                 continue
             nbytes = node_hbm_bytes(node)
-            g.labels(self.name, f"{i}:{type(node).__name__}").set(nbytes)
+            g.labels(self.name, f"{i}:{type(node).__name__}",
+                     shards).set(nbytes)
             total += nbytes
         REGISTRY.gauge("rw_hbm_budget_utilization",
-                       "fused job HBM footprint over hbm_budget_mb",
-                       labels=("job",)).labels(self.name).set(
+                       "fused job per-chip HBM footprint over hbm_budget_mb",
+                       labels=("job", "shards")).labels(self.name,
+                                                        shards).set(
             total / float(self.hbm_budget_mb << 20))
 
     def node_report(self) -> List[Tuple]:

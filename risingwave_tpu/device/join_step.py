@@ -184,6 +184,34 @@ def join_epoch_step(a: JoinSide, b: JoinSide,
                      b_jk, b_pk, b_sign, b_mask, b_vals, m)
 
 
+def local_join_step(a: JoinSide, b: JoinSide,
+                    a_jk, a_pk, a_sign, a_mask, a_vals,
+                    b_jk, b_pk, b_sign, b_mask, b_vals, m: int):
+    """One epoch's LOCAL join step: join_core plus cross-delta pair
+    netting (the r02 pair-resurrection fix) over the rows this program
+    instance owns. On a single chip that is every row; under mesh
+    sharding (`device/shard_exec.py`) it is the shard's exchange-routed
+    rows — the step is closed under vnode partitioning because every row
+    of one join key lands on the key's owning shard, so probe, merge,
+    and netting each see exactly the rows they would have seen globally.
+
+    Returns (new_a, new_b, njk, npk, nsign, nvals, needed): netted
+    unique pairs keyed by (left pk, right pk), payload columns
+    last-write-wins, plus the capacity-need stats of join_core."""
+    new_a, new_b, o1, o2, needed = join_core(
+        a, b, a_jk, a_pk, a_sign, a_mask, a_vals,
+        b_jk, b_pk, b_sign, b_mask, b_vals, m)
+    cat = lambda k: jnp.concatenate([o1[k], o2[k]])
+    catv = lambda k, i: jnp.concatenate([o1[k][i], o2[k][i]])
+    sign = cat("sign")
+    mask = cat("mask") & (sign != 0)
+    pvals = [catv("a_vals", i) for i in range(len(a_vals))] \
+        + [catv("b_vals", i) for i in range(len(b_vals))]
+    njk, npk, nsign, nvals = batch_reduce_rows(
+        cat("a_pk"), cat("b_pk"), sign, mask, pvals)
+    return new_a, new_b, njk, npk, nsign, nvals, needed
+
+
 class DeviceHashJoin:
     """Host wrapper: epoch buffering + state/pair-capacity growth."""
 

@@ -1,0 +1,415 @@
+"""Mesh-sharded execution of fused epoch programs: one job, all chips.
+
+This is the scale lever the ROADMAP names: a `FusedJob` whose node state
+arrays carry a leading SHARD axis (`parallel/mesh.py` `SHARD_AXIS`,
+vnode-keyed `PartitionSpec`) and whose per-node epoch steps run as
+`shard_map`'d programs over the 1-D device mesh. The paper's north star
+(`psum`/`ppermute` exchange over ICI with vnode-sharded state) maps here
+as:
+
+* **State partitioning** — every stateful node's arrays gain a leading
+  `[n_shards, ...]` axis; shard s owns the contiguous vnode block
+  `vnode_block_bounds(n)[s] : [s+1]` of group/join keys, the same
+  contiguous-block layout the host-side sharded operators and rescale
+  use (a shard's key range stays compact for the sorted-run state).
+
+* **In-program exchange** — the cross-vnode shuffle joins/aggs need
+  (rows whose key hashes to another shard's vnode block) is an
+  `all_to_all` bucket exchange INSIDE the traced program: each shard
+  CRC32-hashes its rows to vnodes, buckets them into a
+  `[n_shards, exch]` send buffer, and the collective swaps buckets over
+  ICI — no host socket frames, no host round trip. "Global Hash Tables
+  Strike Back!" motivates exactly this local-bucket-then-merge shape.
+  WHICH inputs exchange on WHICH key columns is declared by the node
+  (`Node.shard_spec`, the fuse-planner refactor), not hardcoded here.
+
+* **psum'd global stats** — each node's stats scalars reduce in-program:
+  row-flow counters by `psum`, capacity needs / violation flags by
+  `pmax` (the per-shard HIGH-WATER is what sizes per-shard capacity),
+  so the job-level stats accumulator and the whole capacity lifecycle
+  (overflow detection, predictive growth, cascade-free replay) work
+  UNCHANGED on sharded programs.
+
+* **Exchange capacity** — the `[n_shards, exch]` send bucket is a real
+  capacity slot ("exch") on Agg/Join nodes: bucket overflow is detected
+  by the `exch` stat (max bucket count, pmax'd), and the normal
+  grow+replay path resizes it (per-epoch-bounded — flat headroom, never
+  horizon-extrapolated). Rows dropped by an overflowing epoch are
+  discarded with that epoch's state by the replay, so correctness is
+  never at the mercy of the initial guess.
+
+Semantics: sharding is an execution detail. Keys are partitioned, all
+arithmetic is over int64/f64 values whose per-key row order is preserved
+by the exchange (source shards cover contiguous event-id blocks and the
+bucket flatten is src-major, so each key sees its rows in event order,
+the same order the single-chip sort produces with jax's stable sorts) —
+an n-shard run is bit-identical to the 1-shard run, asserted by
+tests/test_mesh_fused.py.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.vnode import VNODE_COUNT
+from ..parallel.mesh import SHARD_AXIS, shard_of_vnode, state_sharding
+from ..parallel.mesh import shard_map as _shard_map
+
+
+def mesh_fingerprint(mesh) -> Optional[Tuple]:
+    """Hashable, process-stable identity of a mesh for dispatch keys:
+    axis layout + the member device ids (two meshes over different
+    device sets must never share an executable)."""
+    if mesh is None:
+        return None
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+# ---------------------------------------------------------------------------
+# state lifting: local pytree <-> [n_shards, ...] mesh-sharded pytree
+# ---------------------------------------------------------------------------
+
+
+def lift_tree(tree, mesh):
+    """Broadcast every leaf of a local state pytree to [n_shards, ...]
+    and place it sharded on the mesh (vnode-keyed PartitionSpec on the
+    leading axis). Initial states are identical empty shards, so a
+    broadcast IS the correct per-shard initialization."""
+    import jax
+    n = mesh.devices.size
+    sh = state_sharding(mesh)
+
+    def lift(x):
+        a = np.asarray(x)
+        return jax.device_put(
+            np.broadcast_to(a[None], (n,) + a.shape).copy(), sh)
+
+    return jax.tree_util.tree_map(lift, tree)
+
+
+def _drop(tree):
+    """shard_map local view [1, ...] -> the node-local [...] pytree."""
+    import jax
+    return jax.tree_util.tree_map(lambda x: x[0], tree)
+
+
+def _lift1(tree):
+    """Node-local [...] pytree -> shard_map local output [1, ...]."""
+    import jax
+    return jax.tree_util.tree_map(lambda x: x[None], tree)
+
+
+def _spec_sharded(tree):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(lambda _: P(SHARD_AXIS), tree)
+
+
+def _spec_replicated(tree):
+    import jax
+    from jax.sharding import PartitionSpec as P
+    return jax.tree_util.tree_map(lambda _: P(), tree)
+
+
+def sds_sharded(tree, mesh):
+    """ShapeDtypeStruct mirror of a [n_shards, ...] pytree with the mesh
+    sharding attached — what the AOT compile service lowers sharded
+    signatures against (a plain SDS would lower a single-device layout
+    and the executable would reject the mesh-placed epoch arrays)."""
+    import jax
+    sh = state_sharding(mesh)
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=sh), tree)
+
+
+def sharded_resize(node, state, caps, mesh):
+    """Apply a node's LOCAL `cap_resize` across the shard axis: vmap maps
+    the axis-0 pads of grow_state/ms_grow/grow_side onto axis 1 of the
+    lifted arrays (the node's attribute updates happen once, at trace),
+    then re-place on the mesh. Rare path — only growth replays come here.
+    """
+    import jax
+    if state is None or not jax.tree_util.tree_leaves(state):
+        node.cap_resize(state, caps)       # attr-only (e.g. exch) update
+        return state
+    new = jax.vmap(lambda st: node.cap_resize(st, caps))(state)
+    sh = state_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), new)
+
+
+# ---------------------------------------------------------------------------
+# the in-program bucket exchange (all_to_all over ICI)
+# ---------------------------------------------------------------------------
+
+
+def _exchange_local(mesh, node, xi: int, d, abstract: bool):
+    """Shard-local body: hash rows to their owning shard's vnode block,
+    bucket into the [n_shards, exch] send buffer, all_to_all, flatten.
+    The routing key columns and whether row identity rides along come
+    from the node's declarative shard spec (`Node.shard_spec`).
+    `abstract=True` is the shape-faithful mirror used for AOT aval walks
+    (collectives replaced by shape-identities; needs no mesh axis)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.vnode import compute_vnodes_jnp
+    from .fused import Delta
+    n = mesh.devices.size
+    exch = node.exch
+    ex = node.shard_spec().exchanges[xi]
+    key = node.pack.pack([d.cols[i] for i in ex.key_idx])
+    vn = compute_vnodes_jnp(key, VNODE_COUNT)
+    dest = shard_of_vnode(vn.astype(jnp.int64), n, VNODE_COUNT
+                          ).astype(jnp.int32)
+    live = d.mask & (d.sign != 0)
+    # only the columns the node declares it reads ship over ICI; the
+    # routed delta zero-fills the rest (never touched by declaration)
+    ncols = len(d.cols)
+    refs = list(ex.ref_idx) if ex.ref_idx is not None else list(range(ncols))
+    arrays: List[Any] = [d.cols[i] for i in refs] \
+        + [jnp.where(live, d.sign, 0).astype(jnp.int32)]
+    if ex.carry_pk:
+        arrays.append(d.pk)
+    onehot = (dest[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]) \
+        & live[None, :]
+    counts = jnp.sum(onehot, axis=1)
+    # max bucket fill = the "exch" capacity stat; > exch means rows were
+    # dropped this epoch -> sync detects overflow, grows, replays
+    need = jnp.max(counts).astype(jnp.int64)
+    pos = jnp.cumsum(onehot, axis=1) - 1
+    posr = jnp.take_along_axis(pos, dest[None, :].astype(jnp.int32),
+                               axis=0)[0]
+    rdest = jnp.where(live, dest, n)      # OOB rows drop out of the set
+    bufs = []
+    for a in arrays:
+        buf = jnp.zeros((n, exch), dtype=a.dtype)
+        bufs.append(buf.at[rdest, posr].set(a, mode="drop"))
+    if abstract:
+        recv = bufs                        # all_to_all is shape-preserving
+    else:
+        recv = [jax.lax.all_to_all(b, SHARD_AXIS, split_axis=0,
+                                   concat_axis=0, tiled=False)
+                for b in bufs]
+        need = jax.lax.pmax(need, SHARD_AXIS)
+    rb = n * exch
+    rs = [r.reshape(rb) for r in recv]
+    sign = rs[len(refs)]
+    at = {c: k for k, c in enumerate(refs)}
+    cols = [rs[at[i]] if i in at else jnp.zeros(rb, dtype=d.cols[i].dtype)
+            for i in range(ncols)]
+    out = Delta(cols, sign, sign != 0,
+                pk=rs[len(refs) + 1] if ex.carry_pk else None)
+    return out, need
+
+
+def exchange_apply(mesh, node, xi: int, delta, abstract: bool = False):
+    """Global-view exchange of one input delta: route every live row to
+    the shard owning its key's vnode block. Returns (routed delta with
+    [n_shards, n_shards * exch] rows per shard, max-bucket-fill stat)."""
+    import jax
+
+    if abstract:
+        import jax.numpy as jnp
+        n = mesh.devices.size
+        out, need = _exchange_local(mesh, node, xi, _drop(delta), True)
+        lift = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t)
+        return lift(out), need
+
+    def local(d):
+        out, need = _exchange_local(mesh, node, xi, _drop(d), False)
+        return _lift1(out), need
+
+    # specs need only the output TREE STRUCTURE (one P(shard) per leaf);
+    # the abstract body mirrors it exactly
+    out_sds = jax.eval_shape(
+        lambda d: _exchange_local(mesh, node, xi, _drop(d), True), delta)
+    fn = _shard_map(local, mesh=mesh,
+                    in_specs=(_spec_sharded(delta),),
+                    out_specs=(_spec_sharded(out_sds[0]),
+                               _spec_replicated(out_sds[1])),
+                    check_rep=False)
+    return fn(delta)
+
+
+_EXCH_JIT = {}
+
+
+def _exchange_jit(mesh):
+    import jax
+    fn = _EXCH_JIT.get(mesh)
+    if fn is None:
+        fn = jax.jit(
+            lambda delta, *, node, xi, salt:
+            exchange_apply(mesh, node, xi, delta),
+            static_argnames=("node", "xi", "salt"))
+        _EXCH_JIT[mesh] = fn
+    return fn
+
+
+def exchange_delta(mesh, node, xi: int, delta):
+    """Jitted exchange dispatch (cached per mesh; static on the node's
+    structural signature + mutable-capacity salt, so an `exch` growth
+    re-traces exactly this small program and nothing else)."""
+    return _exchange_jit(mesh)(delta, node=node, xi=xi,
+                               salt=node._mut_sig())
+
+
+# ---------------------------------------------------------------------------
+# the sharded per-node epoch step
+# ---------------------------------------------------------------------------
+
+
+def sharded_apply(mesh, node, epoch_events: int, state, ins, extra,
+                  abstract: bool = False):
+    """`Node.apply` over the mesh: shard-local step + in-program stat
+    reduction. Source-rooted nodes generate their contiguous slice of
+    the epoch's event-id range (`event_lo + shard * epoch_events/n` —
+    the pack-time routing of source events to shards); every other node
+    consumes its already-owned (or exchange-routed) rows. Stats reduce
+    in-program: `psum` for row-flow counters (`Node.stat_sums`), `pmax`
+    for capacity needs and violation flags — so the host-side capacity
+    lifecycle sees per-shard high-water needs and sizes PER-SHARD
+    capacities."""
+    import jax
+    import jax.numpy as jnp
+    from .fused import MVKeyedNode
+    n = mesh.devices.size
+    ev_local = epoch_events // n if node.takes_event_lo else epoch_events
+    names = node.stat_names
+    sums = set(node.stat_sums)
+
+    def local_body(state, ins, extra, abst: bool):
+        lst = _drop(state)
+        lins = [(_drop(d) if d is not None else None) for d in ins]
+        ex = extra
+        if node.takes_event_lo and not abst:
+            ex = extra + jax.lax.axis_index(SHARD_AXIS).astype(
+                jnp.int64) * ev_local
+        elif isinstance(node, MVKeyedNode):
+            ex = _drop(extra)
+        st, out, stats, aux = node.apply(lst, lins, ex, ev_local)
+        if abst:
+            red = list(stats)
+        else:
+            red = [jax.lax.psum(s, SHARD_AXIS) if names[i] in sums
+                   else jax.lax.pmax(s, SHARD_AXIS)
+                   for i, s in enumerate(stats)]
+        return st, out, red, aux
+
+    if abstract:
+        st, out, red, aux = local_body(state, ins, extra, True)
+        lift = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t)
+        return lift(st), lift(out), red, lift(aux)
+
+    def local(state, ins, extra):
+        st, out, red, aux = local_body(state, ins, extra, False)
+        return _lift1(st), _lift1(out), red, _lift1(aux)
+
+    if node.takes_event_lo:
+        from jax.sharding import PartitionSpec as P
+        espec = P()
+    elif isinstance(node, MVKeyedNode):
+        espec = _spec_sharded(extra)
+    else:
+        espec = None
+    st_s, out_s, red_s, aux_s = jax.eval_shape(
+        lambda s, i_, e: local_body(s, tuple(i_), e, True),
+        state, ins, extra)
+    out_specs = (_spec_sharded(st_s), _spec_sharded(out_s),
+                 _spec_replicated(red_s), _spec_sharded(aux_s))
+    fn = _shard_map(local, mesh=mesh,
+                    in_specs=(_spec_sharded(state), _spec_sharded(ins),
+                              espec),
+                    out_specs=out_specs, check_rep=False)
+    return fn(state, ins, extra)
+
+
+_STEP_JIT = {}
+
+
+def sharded_jit_step(mesh):
+    """The shared jitted sharded per-node step, one per mesh (the exact
+    analog of fused._jit_step): the compile service AOT-lowers through
+    the SAME function, so inline dispatch and background
+    `.lower().compile()` of one signature share a trace."""
+    import jax
+    fn = _STEP_JIT.get(mesh)
+    if fn is None:
+        fn = jax.jit(
+            lambda state, ins, extra, *, node, epoch_events, salt:
+            sharded_apply(mesh, node, epoch_events, state, ins, extra),
+            static_argnames=("node", "epoch_events", "salt"))
+        _STEP_JIT[mesh] = fn
+    return fn
+
+
+def sharded_node_step(mesh, node, epoch_events: int, state, ins, extra):
+    return sharded_jit_step(mesh)(state, ins, extra, node=node,
+                                  epoch_events=epoch_events,
+                                  salt=node._mut_sig())
+
+
+# ---------------------------------------------------------------------------
+# host pull: merge per-shard sorted runs back into the single-chip order
+# ---------------------------------------------------------------------------
+
+
+def merge_keyed_pull(states, mesh, col_dtypes):
+    """Gather a sharded keyed-MV state: all shards' live prefixes in one
+    batched pull, merged by ascending packed key — keys are globally
+    unique (each lives on its vnode's shard) and every shard's run is
+    already sorted, so the merge reproduces the 1-shard `mv_rows` order
+    exactly (bit-identity)."""
+    import jax
+    n = mesh.devices.size
+    nc = len(col_dtypes)
+    counts = [int(c) for c in np.asarray(jax.device_get(states.count))]
+    # one batched transfer for all shards' live prefixes — per-shard
+    # mv_rows pulls would pay n_shards * (1 + 2 * n_cols) host syncs
+    # (RTTs on a tunnel) for every SELECT (see merge_pair_pull)
+    pulled = jax.device_get(
+        [[states.keys[s, :counts[s]]]
+         + [states.vals[1 + 2 * i][s, :counts[s]] for i in range(nc)]
+         + [states.vals[2 + 2 * i][s, :counts[s]] for i in range(nc)]
+         for s in range(n)])
+    all_keys = [np.asarray(p[0]) for p in pulled]
+    all_cols = [[np.asarray(c) for c in p[1:1 + nc]] for p in pulled]
+    all_nulls = [[np.asarray(u) for u in p[1 + nc:]] for p in pulled]
+    keys = np.concatenate(all_keys)
+    order = np.argsort(keys, kind="stable")
+    cols = [np.concatenate([c[i] for c in all_cols])[order]
+            for i in range(len(col_dtypes))]
+    nulls = [np.concatenate([u[i] for u in all_nulls])[order]
+             for i in range(len(col_dtypes))]
+    return keys[order], cols, nulls
+
+
+def merge_pair_pull(side, mesh):
+    """Gather a sharded pair-MV JoinSide: per-shard live prefixes merged
+    by (jk, pk) — the sort key of the single-chip sorted multimap, and a
+    globally unique pair identity, so the merged order is bit-identical
+    to the 1-shard pull."""
+    import jax
+    n = mesh.devices.size
+    # counts first, then per-shard LIVE prefixes only — a grown pair
+    # capacity must not make every SELECT transfer n_shards x capacity
+    # padded rows for each column
+    counts = [int(c) for c in np.asarray(jax.device_get(side.count))]
+    # one batched transfer for all shards' prefixes — per-slice gets
+    # would pay n_shards * (2 + n_cols) host syncs (RTTs on a tunnel)
+    # for every SELECT
+    pulled = jax.device_get(
+        [[side.jk[s, :counts[s]], side.pk[s, :counts[s]]]
+         + [v[s, :counts[s]] for v in side.vals] for s in range(n)])
+    jks = [np.asarray(p[0]) for p in pulled]
+    pks = [np.asarray(p[1]) for p in pulled]
+    vals = [[np.asarray(p[2 + i]) for p in pulled]
+            for i in range(len(side.vals))]
+    jk = np.concatenate(jks)
+    pk = np.concatenate(pks)
+    order = np.lexsort((pk, jk))
+    return (jk[order].shape[0],
+            [np.concatenate(v)[order] for v in vals])

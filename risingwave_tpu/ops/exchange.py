@@ -138,10 +138,13 @@ class DispatchExecutor:
         self.key_indices = list(key_indices)
         self.vnode_count = vnode_count
         n = len(outputs)
-        # contiguous vnode blocks, same map as parallel/mesh.py
-        self.vnode_to_out = np.minimum(
-            (np.arange(vnode_count, dtype=np.int64) * n) // vnode_count,
-            n - 1).astype(np.int32)
+        # contiguous vnode blocks — THE map (parallel/mesh.py), not an
+        # inlined copy: host exchange and device shard planes must agree
+        # on block boundaries even when n doesn't divide vnode_count
+        from ..parallel.mesh import shard_of_vnode
+        self.vnode_to_out = shard_of_vnode(
+            np.arange(vnode_count, dtype=np.int64), n,
+            vnode_count).astype(np.int32)
         self._rr = 0
         self._iter: Optional[Iterator[Message]] = None
         # last barrier fanned out + an optional observer: the

@@ -61,8 +61,15 @@ def vnode_block_bounds(n_shards: int, vnode_count: int = VNODE_COUNT
 
 
 def shard_of_vnode(vnodes, n_shards: int, vnode_count: int = VNODE_COUNT):
-    """Works on numpy or jnp arrays (pure arithmetic, jit-safe)."""
-    return (vnodes * n_shards) // vnode_count
+    """Owning shard of each vnode — the exact inverse of
+    `vnode_block_bounds`: shard k owns [bounds[k], bounds[k+1]), i.e.
+    the largest k with (k*vnode_count)//n_shards <= v. The naive
+    `(v*n)//vnode_count` disagrees at block boundaries whenever n_shards
+    does not divide vnode_count (vnode 85 of 256 under 3 shards sits in
+    block 1 but floor(85*3/256)=0), silently splitting a block across
+    two shards. Works on numpy or jnp arrays (pure int arithmetic,
+    jit-safe)."""
+    return ((vnodes + 1) * n_shards - 1) // vnode_count
 
 
 def state_sharding(mesh: Mesh) -> NamedSharding:

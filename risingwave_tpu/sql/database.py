@@ -190,6 +190,11 @@ class Database:
             return "off"
         mode = ("mesh:%d" % self.device.mesh.devices.size
                 if self.device.mesh is not None else "single")
+        ms = getattr(self.device, "mesh_shards", 1) or 1
+        if self.device.mesh is None and ms > 1:
+            # mesh-sharded FUSED programs: state layouts are per-shard,
+            # so a reopen must shard identically
+            mode += ":fshard%d" % ms
         return mode + (":minmax" if self.device.minmax else "")
 
     @staticmethod
@@ -213,7 +218,10 @@ class Database:
         if minmax:
             parts = parts[:-1]
         if parts[0] == "single":
-            return DeviceConfig(minmax=minmax)
+            ms = 1
+            if len(parts) > 1 and parts[1].startswith("fshard"):
+                ms = int(parts[1][len("fshard"):])
+            return DeviceConfig(minmax=minmax, mesh_shards=ms)
         from ..parallel import make_mesh
         return DeviceConfig(mesh=make_mesh(int(parts[1])), minmax=minmax)
 
@@ -606,6 +614,11 @@ class Database:
                 self.catalog.create(obj)
                 self._fused[stmt.name] = job
                 job.profiler.attach(self._data_dir)
+                if job.compile_service is not None and self._data_dir:
+                    # mirror the compile manifest into the data dir so
+                    # `risectl compile-status --offline` reads it from a
+                    # dead directory (no live process, no cache dir)
+                    job.compile_service.attach_dir(self._data_dir)
                 job.recover()      # no-op unless the store has a committed
                 # CREATE-time AOT kickoff: the plan's shapes (post-
                 # presize) compile in the background while the

@@ -87,8 +87,10 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
     # is in epoch_profile.jsonl / `risectl profile`)
     "rw_epoch_profile": (
         Schema.of(("job", T.VARCHAR), ("seq", T.INT64),
-                  ("events", T.INT64), ("host_pack_ms", T.FLOAT64),
-                  ("dispatch_ms", T.FLOAT64), ("device_sync_ms", T.FLOAT64),
+                  ("events", T.INT64), ("shards", T.INT64),
+                  ("host_pack_ms", T.FLOAT64),
+                  ("dispatch_ms", T.FLOAT64), ("exchange_ms", T.FLOAT64),
+                  ("device_sync_ms", T.FLOAT64),
                   ("commit_ms", T.FLOAT64), ("wall_ms", T.FLOAT64)),
         lambda db: _epoch_profile(db)),
     # per-node attribution from the on-device stats vector: row flow,

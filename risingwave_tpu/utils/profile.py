@@ -7,10 +7,18 @@ Each epoch of a `FusedJob` is one phase-split span:
 
   host_pack    — building the epoch's host-side inputs (event cursor)
   dispatch     — the async per-node jit dispatch loop (no device sync)
+  exchange     — dispatching the in-program ICI shuffle of mesh-sharded
+                 programs (device/shard_exec.py); 0 on single-chip jobs.
+                 Split out of `dispatch` so the all_to_all stage's cost
+                 is attributable per shard count
   device_sync  — blocking on the device (`jax.device_get` of stats_acc at
                  a checkpoint/SELECT — covers ALL device compute enqueued
                  since the last sync, growth replays included)
   commit       — MV mirror diff + job-state-table rows at a checkpoint
+
+Every span and row carries the job's `shards` dimension (device mesh
+size; 1 = single chip) so phase timings from sharded and unsharded runs
+never aggregate silently.
 
 Non-checkpoint epochs only carry host_pack+dispatch (their device work is
 paid for by the next sync — that asymmetry is the async-dispatch design,
@@ -36,7 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 PROFILE_FILE = "epoch_profile.jsonl"
 _MAX_FILE_BYTES = 4 << 20
-PHASES = ("host_pack", "dispatch", "device_sync", "commit")
+PHASES = ("host_pack", "dispatch", "exchange", "device_sync", "commit")
 # a per-node step call slower than this is recorded as a compile/retrace
 # even when the profiler did not expect one (catches shape changes that
 # arrived through a path growth accounting doesn't flag)
@@ -49,9 +57,13 @@ class JobProfiler:
     `enabled` is False; callers guard their own perf_counter reads on
     `enabled` so a disabled profiler costs one attribute load per epoch."""
 
-    def __init__(self, job: str, enabled: bool = True):
+    def __init__(self, job: str, enabled: bool = True, shards: int = 1):
         self.job = job
         self.enabled = enabled
+        # device mesh size of the job's fused program (1 = single chip):
+        # a dimension on every span so sharded/unsharded timings are
+        # never conflated
+        self.shards = shards
         self.ring: deque = deque(maxlen=RING)
         self.compiles: deque = deque(maxlen=256)   # (label, kind, seconds)
         # full compile records incl. bucket/aot/cache_hit labels (the
@@ -100,7 +112,8 @@ class JobProfiler:
         self._cur = None
         wall = time.perf_counter() - cur.pop("t0")
         rec = {"ev": "epoch", "job": self.job, "seq": cur["seq"],
-               "events": cur["events"], "wall_ms": wall * 1e3,
+               "events": cur["events"], "shards": self.shards,
+               "wall_ms": wall * 1e3,
                "ph_ms": {k: v * 1e3 for k, v in cur["ph"].items()}}
         self.ring.append(rec)
         with self._ev_lock:
@@ -152,13 +165,15 @@ class JobProfiler:
 
     # ---- surfaces --------------------------------------------------------
     def rows(self) -> List[Tuple]:
-        """rw_epoch_profile rows: (job, seq, events, host_pack_ms,
-        dispatch_ms, device_sync_ms, commit_ms, wall_ms)."""
+        """rw_epoch_profile rows: (job, seq, events, shards, host_pack_ms,
+        dispatch_ms, exchange_ms, device_sync_ms, commit_ms, wall_ms)."""
         out = []
         for r in self.ring:
             ph = r["ph_ms"]
             out.append((self.job, r["seq"], r["events"],
+                        r.get("shards", 1),
                         ph.get("host_pack", 0.0), ph.get("dispatch", 0.0),
+                        ph.get("exchange", 0.0),
                         ph.get("device_sync", 0.0), ph.get("commit", 0.0),
                         r["wall_ms"]))
         return out

@@ -21,6 +21,34 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pytest
+
+MESH_DEVICES = 8
+
+
+def pytest_collection_modifyitems(config, items):
+    """`mesh`-marked tests need the 8 virtual CPU devices forced above;
+    if jax initialized before the XLA flag landed (or the platform
+    overrode it), skip them instead of failing on make_mesh."""
+    if len(jax.devices()) >= MESH_DEVICES:
+        return
+    skip = pytest.mark.skip(reason=f"needs {MESH_DEVICES} devices, have "
+                                   f"{len(jax.devices())} (XLA_FLAGS="
+                                   "--xla_force_host_platform_device_"
+                                   "count did not take)")
+    for item in items:
+        if "mesh" in item.keywords:
+            item.add_marker(skip)
+
+
+@pytest.fixture
+def mesh8():
+    """The 8-shard 1-D device mesh the sharded fused path runs over in
+    tier-1 (virtual CPU devices; `parallel/mesh.make_mesh` falls back to
+    them on real-TPU hosts with fewer local chips)."""
+    from risingwave_tpu.parallel.mesh import make_mesh
+    return make_mesh(MESH_DEVICES)
+
 
 def pytest_sessionfinish(session, exitstatus):
     """Session-end guards.

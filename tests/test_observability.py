@@ -61,9 +61,10 @@ def test_epoch_profile_rows_and_phase_sums(tmp_path):
     db = _fused_db(str(tmp_path / "d"))
     rows = db.query("SELECT * FROM rw_epoch_profile")
     assert rows, "a fused run must produce epoch profile rows"
-    for job, seq, events, hp, disp, sync, commit, wall in rows:
+    for job, seq, events, shards, hp, disp, exch, sync, commit, wall in rows:
         assert job == "q4"
-        phases = hp + disp + sync + commit
+        assert shards == 1 and exch == 0.0   # single-chip job
+        phases = hp + disp + exch + sync + commit
         # phase splits must account for the measured wall (the acceptance
         # bound is 10%; sub-ms epochs get an epsilon for timer noise)
         assert phases <= wall * 1.001 + 0.05
@@ -99,7 +100,7 @@ def test_fused_node_stats_table(tmp_path):
     from risingwave_tpu.utils.metrics import REGISTRY
     text = REGISTRY.expose()
     assert 'rw_hbm_bytes{job="q4"' in text
-    assert 'rw_hbm_budget_utilization{job="q4"}' in text
+    assert 'rw_hbm_budget_utilization{job="q4",shards="1"}' in text
 
 
 def test_profile_file_and_risectl(tmp_path, capsys):
