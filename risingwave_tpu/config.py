@@ -125,8 +125,24 @@ class RobustnessConfig:
     # metrics plane: a worker whose last heartbeat frame (piggybacked on
     # its result stream) is older than this is flagged WEDGED in
     # rw_worker_liveness / worker_liveness — alive-but-stuck detection
-    # ahead of the spawn/drain deadlines (it observes; it never kills)
+    # ahead of the spawn/drain deadlines (detection is passive for
+    # unsupervised sets; supervised sets ACT on it, see wedge_kill_factor)
     heartbeat_timeout_s: float = 60.0
+    # wedge reaper (supervised sets only): a worker whose heartbeat age
+    # exceeds heartbeat_timeout_s * wedge_kill_factor while its process
+    # is still alive is SIGKILLed and routed through the same in-place
+    # respawn path as a dead worker (bounded attempts, then escalation).
+    # <= 0 disables reaping (observe-only, the pre-supervision-v2
+    # behavior).
+    wedge_kill_factor: float = 3.0
+    # supervised stateful respawn refresh mode: True (default) seeds the
+    # respawned worker with state as of its last DELIVERED epoch
+    # (un-applying the retained crash-window input), replays the window,
+    # and emits a per-epoch NET DIFF vs the seed snapshot — exact, no
+    # duplicate rows downstream. False restores the v1 full owned-group
+    # refresh (live-shadow seed + re-INSERT of every owned group), which
+    # relies on materialize-by-pk / sink dedupe to reconcile.
+    incremental_refresh: bool = True
 
     @classmethod
     def from_env(cls) -> "RobustnessConfig":
@@ -138,7 +154,16 @@ class RobustnessConfig:
             if raw is not None:
                 kind = type(getattr(cfg, f.name))
                 try:
-                    setattr(cfg, f.name, kind(raw))
+                    if kind is bool:
+                        low = raw.strip().lower()
+                        if low in ("t", "true", "1", "on", "yes"):
+                            setattr(cfg, f.name, True)
+                        elif low in ("f", "false", "0", "off", "no"):
+                            setattr(cfg, f.name, False)
+                        else:
+                            raise ValueError
+                    else:
+                        setattr(cfg, f.name, kind(raw))
                 except ValueError:
                     raise ValueError(
                         f"bad {var}={raw!r}: expected {kind.__name__}"

@@ -152,10 +152,24 @@ class SinkExecutor(Executor):
     row). Current-epoch rows are logged at their checkpoint barrier (they
     become durable in the same store commit as everything else); log
     epochs already durable — at or below the store's committed epoch —
-    deliver to the file at the NEXT checkpoint and are then deleted."""
+    deliver to the file at the NEXT checkpoint and are then deleted.
+
+    Refresh dedupe (`pk_indices`): a supervised worker respawn's v1
+    full refresh re-INSERTs every owned group — the MV reconciles by pk,
+    but the change stream carries duplicate `+` records straight into
+    the sink. With a pk, the sink keeps a per-pk mirror of what it has
+    delivered and reconciles at its boundary: a `+` identical to the
+    mirrored row is dropped (the duplicate), a `+` for a pk holding a
+    DIFFERENT row becomes a `-old`/`+new` repair pair, and a `-` for a
+    pk the mirror holds retracts the mirrored row (robust to refresh
+    artifacts). Rows for unseen pks always pass — a recovered
+    coordinator starts with an empty mirror and must not eat the legit
+    deltas that follow. Appended-only streams and pk-less shapes skip
+    the mirror entirely."""
 
     def __init__(self, input: Executor, sink: FileSink,
                  log_table: Optional[StateTable] = None,
+                 pk_indices: Optional[List[int]] = None,
                  name: str = "Sink"):
         super().__init__(input.schema, name)
         self.input = input
@@ -163,6 +177,31 @@ class SinkExecutor(Executor):
         self.log_table = log_table
         self._pending: List[Tuple[int, Tuple]] = []
         self._dtypes = [f.dtype for f in input.schema.fields]
+        self.pk_indices = list(pk_indices) if pk_indices else None
+        self._mirror: dict = {}
+        self.dedupe = bool(self.pk_indices) and not input.append_only
+
+    def _reconcile(self, sign: int, row: Tuple) -> List[Tuple[int, Tuple]]:
+        """Map one change through the delivered-row mirror; returns the
+        (sign, row) pairs that actually go to the log/file."""
+        pk = tuple(row[i] for i in self.pk_indices)
+        held = self._mirror.get(pk)
+        if sign > 0:
+            if held == row:
+                from ..utils.metrics import REGISTRY
+                REGISTRY.counter(
+                    "sink_dedupe_dropped_total",
+                    "duplicate refresh records dropped at the sink "
+                    "boundary").inc()
+                return []
+            self._mirror[pk] = row
+            if held is not None:        # refresh with a changed value
+                return [(-1, held), (1, row)]
+            return [(1, row)]
+        if held is not None:
+            del self._mirror[pk]
+            return [(-1, held)]
+        return [(-1, row)]              # unseen pk: trust upstream
 
     def deliver_durable(self) -> None:
         """Ship every log epoch that the store has made durable. Called by
@@ -194,7 +233,11 @@ class SinkExecutor(Executor):
             if isinstance(msg, StreamChunk):
                 if msg.cardinality:
                     for op, row in msg.compact().op_rows():
-                        self._pending.append((op.sign, row))
+                        if self.dedupe:
+                            self._pending.extend(
+                                self._reconcile(op.sign, row))
+                        else:
+                            self._pending.append((op.sign, row))
             elif isinstance(msg, Barrier) and msg.is_checkpoint:
                 epoch = msg.epoch.curr
                 if self.log_table is None:

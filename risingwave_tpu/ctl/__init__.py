@@ -31,7 +31,12 @@ Commands:
     failpoints [--spec S]     list declared fault-injection points and
                               which the spec (default: $RW_FAILPOINTS)
                               arms; --arm validates a spec and prints
-                              the export line to arm a process tree
+                              the export line to arm a process tree;
+                              --ledger [FILE] prints a recorded fire
+                              ledger — (ordinal, point, thread, hit) per
+                              fire, the exact-replay record a chaos run
+                              writes under RW_FAILPOINT_LEDGER (no FILE:
+                              the live in-process ledger)
     fused-stats               per-fused-job growth/replay/retrace
                               counters and current per-node capacities
                               (JSON) — diagnose capacity-bound runs
@@ -231,6 +236,24 @@ def cmd_failpoints(args) -> int:
     import risingwave_tpu.runtime.remote_fragments  # noqa: F401
     import risingwave_tpu.runtime.worker  # noqa: F401
     import risingwave_tpu.state.hummock  # noqa: F401
+    if args.ledger is not None:
+        try:
+            entries = fp.load_ledger(args.ledger) if args.ledger \
+                else fp.ledger()
+        except OSError as e:
+            raise SystemExit(f"cannot read ledger {args.ledger!r}: {e}")
+        except ValueError as e:
+            raise SystemExit(f"bad ledger {args.ledger!r}: {e}")
+        if not entries:
+            print("ledger is empty (no failpoint fired"
+                  + (f" in {args.ledger}" if args.ledger else "") + ")")
+            return 0
+        print(f"{'ordinal':>7s}  {'point':28s} {'thread':20s} hit")
+        for o, point, thread, hit in entries:
+            print(f"{o:7d}  {point:28s} {thread:20s} {hit}")
+        print(f"-- {len(entries)} fires; re-arm exactly with "
+              f"{fp.LEDGER_ENV}=<this file>")
+        return 0
     spec = args.arm if args.arm is not None else args.spec
     try:
         points = {p.name: p for p in fp.parse_spec(spec or "")}
@@ -387,6 +410,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--spec", default=os.environ.get("RW_FAILPOINTS", ""))
     sp.add_argument("--arm", default=None,
                     help="validate a spec and print the export line")
+    sp.add_argument("--ledger", nargs="?", const="", default=None,
+                    metavar="FILE",
+                    help="print a recorded fire ledger (omit FILE for "
+                         "the live in-process ledger)")
     sp.set_defaults(fn=cmd_failpoints)
     args = p.parse_args(argv)
     return args.fn(args)

@@ -21,12 +21,19 @@ Failure handling has two tiers:
   `FragmentSupervisor` respawns JUST the dead fragment in place —
   stateless partial-agg workers get the retained input epoch(s) replayed
   (their outputs are epoch-atomic, so nothing is lost or double-counted);
-  stateful owned-group agg workers are re-seeded from the coordinator
-  shadow table and re-emit a full refresh of their groups (the MV applies
-  by pk, so the refresh reconciles any change the dead worker never
-  delivered). Bounded attempts per slot, then the supervisor escalates to
-  the unsupervised `RemoteWorkerDied` path — graceful degradation, never
-  a hang. Two-input join fragments escalate immediately (open item).
+  stateful fragments (owned-group aggs AND two-input hash joins) are
+  re-seeded from the coordinator shadow table(s) rolled back to the last
+  epoch the dead worker DELIVERED (the retained crash-window input is
+  un-applied from the live shadow), then the window is replayed: joins
+  regenerate their undelivered output deltas exactly; aggs emit a
+  per-epoch net diff vs the seed snapshot (the incremental refresh).
+  Bounded attempts per slot, then the supervisor escalates to the
+  unsupervised `RemoteWorkerDied` path — graceful degradation, never a
+  hang. The supervisor also ACTS on wedged workers: a slot whose
+  heartbeat age exceeds `RW_HEARTBEAT_TIMEOUT_S * wedge_kill_factor`
+  while the process is still alive is SIGKILLed and routed through the
+  same respawn path (`supervisor_wedged_reaped_total`, liveness state
+  `reaping`).
 """
 from __future__ import annotations
 
@@ -40,11 +47,12 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..config import ROBUSTNESS
 from ..core.chunk import Op, StreamChunk
+from ..core.epoch import EpochPair
 from ..core.vnode import compute_vnodes
 from ..ops import DispatchExecutor, MergeExecutor
 from ..ops.exchange import ThreadedChannel
 from ..ops.executor import Executor
-from ..ops.message import Barrier
+from ..ops.message import Barrier, BarrierKind
 from ..utils.failpoint import declare, failpoint
 from ..utils.metrics import REGISTRY
 from .exchange_net import ExchangeServer, MetricsFrame, RemoteInput
@@ -160,9 +168,12 @@ class FragmentSupervisor:
     `GlobalBarrierWorker::recovery`, scoped to one fragment so one dead
     worker does not restart the world.
 
-    Detection: the worker's result channel aborted, or its process
-    exited non-zero before delivering EOS (both the merge idle loop and
-    the Database heartbeat sweep land here via `check_alive`).
+    Detection: the worker's result channel aborted, its process exited
+    non-zero before delivering EOS, or — the wedge reaper — the process
+    is alive but its heartbeat age blew past
+    `heartbeat_timeout_s * wedge_kill_factor` (both the merge idle loop
+    and the Database heartbeat sweep land here via `check_alive`; a
+    wedged worker is SIGKILLed first, then recovered like a dead one).
 
     Recovery per fragment kind:
     * stateless `partial_hash_agg` — respawn seed-free and replay the
@@ -170,12 +181,22 @@ class FragmentSupervisor:
       (partials flush at the barrier; the drain releases results only on
       their barrier), so at the moment of death NOTHING of an
       in-flight epoch was delivered and replaying it is exactly-once.
-    * stateful `hash_agg` — respawn re-seeded from the coordinator
-      shadow table (outputs suppressed until the re-injected in-flight
-      barrier), then the worker emits a full refresh of its owned
-      groups; the MV materializes by pk, so the refresh reconciles any
-      change the dead worker never managed to deliver.
-    * two-input joins — escalate to full recovery (open item).
+    * stateful `hash_agg` / two-input `hash_join` — respawn re-seeded
+      from the coordinator shadow table(s) ROLLED BACK to the worker's
+      last delivered epoch (the retained, undelivered input window is
+      un-applied from the live shadow), then the window — data AND
+      barriers, on every input side — replays into the fresh worker.
+      A synthetic seed barrier separates seed from replay: the worker
+      swallows it, snapshots (aggs), and from there regenerates the
+      undelivered window exactly — joins as verbatim re-derived deltas,
+      aggs as a per-epoch net diff vs the snapshot (the INCREMENTAL
+      refresh: only groups whose value changed in the window are
+      emitted, retractions included). With
+      `ROBUSTNESS.incremental_refresh=False` (or when the retained
+      window and the shadow disagree) aggs fall back to the v1 full
+      owned-group refresh, and the coordinator diffs its per-worker
+      last-delivered output map against the live shadow to emit
+      retractions for groups fully retracted inside the crash window.
 
     Bounded attempts per worker slot with exponential backoff; past the
     bound (or on any non-recoverable shape) it raises `RemoteWorkerDied`
@@ -185,23 +206,43 @@ class FragmentSupervisor:
         self.rset = rset
         self.attempts = [0] * len(rset.workers)
         self.respawns = 0
+        self.reaped = 0
         self._escalated: Optional[RemoteWorkerDied] = None
 
     def check(self) -> None:
         if self._escalated is not None:
             raise self._escalated
         s = self.rset
+        factor = ROBUSTNESS.wedge_kill_factor
         for i in range(len(s.workers)):
             ch, w = s.channels[i], s.workers[i]
             rc = w.proc.poll()
-            if getattr(ch, "aborted", False) \
-                    or (rc is not None and rc != 0 and not ch.closed):
-                self._recover(i)
+            dead = getattr(ch, "aborted", False) \
+                or (rc is not None and rc != 0 and not ch.closed)
+            wedged = (not dead and rc is None and not ch.closed
+                      and factor > 0
+                      and time.time() - s.heartbeats[i]
+                      > ROBUSTNESS.heartbeat_timeout_s * factor)
+            if wedged:
+                # alive-but-stuck past the kill window: reap it, then
+                # recover through the exact same path as a crash (same
+                # attempt bound, same escalation)
+                s._reaping[i] = True
+                self.reaped += 1
+                REGISTRY.counter(
+                    "supervisor_wedged_reaped_total",
+                    "wedged workers SIGKILLed by the supervisor").inc()
+                w.proc.kill()
+            if dead or wedged:
+                try:
+                    self._recover(i)
+                finally:
+                    s._reaping[i] = False
 
-    def _escalate(self, msg: str) -> None:
+    def _escalate(self, msg: str, reason: str) -> None:
         REGISTRY.counter("supervisor_escalations_total",
-                         "supervised fragments handed to full recovery"
-                         ).inc()
+                         "supervised fragments handed to full recovery",
+                         labels=("reason",)).labels(reason).inc()
         err = RemoteWorkerDied(
             msg + " (escalating: restart the job — DDL replay rebuilds "
             "and replays the fragments)")
@@ -212,19 +253,16 @@ class FragmentSupervisor:
         s = self.rset
         w = s.workers[i]
         ch_out = s.channels[i]
-        if len(s.dispatchers) > 1:
-            self._escalate(
-                f"worker pid={w.proc.pid} of a two-input join fragment "
-                "died; in-place respawn covers single-input fragments")
-        disp = s.dispatchers[0]
-        lb = disp.last_barrier
+        n_in = len(s.dispatchers)
+        lb = s.dispatchers[0].last_barrier
         if lb is not None and lb.is_stop():
             self._escalate(
-                f"worker pid={w.proc.pid} died during job stop")
+                f"worker pid={w.proc.pid} died during job stop", "stop")
         if self.attempts[i] >= max(1, ROBUSTNESS.respawn_attempts):
             self._escalate(
                 f"worker slot {i} kept dying "
-                f"({self.attempts[i]} respawns exhausted)")
+                f"({self.attempts[i]} respawns exhausted)",
+                "respawns_exhausted")
         self.attempts[i] += 1
         # quiesce the old worker: reap the process, wait out its drain
         # thread (the dead socket errors it out promptly) so nothing can
@@ -234,54 +272,56 @@ class FragmentSupervisor:
         try:
             w.proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            self._escalate(f"worker pid={w.proc.pid} is unkillable")
+            self._escalate(f"worker pid={w.proc.pid} is unkillable",
+                           "unkillable")
         if w.drain_thread is not None:
             w.drain_thread.join(timeout=10)
             if w.drain_thread.is_alive():
-                self._escalate("old result drain did not stop")
+                self._escalate("old result drain did not stop",
+                               "drain_stuck")
         time.sleep(min(1.0, ROBUSTNESS.respawn_backoff_s
                        * (2 ** (self.attempts[i] - 1))))
-        # fresh input channel under a fresh id: the old id stays claimed
-        # on the server, so a half-dead predecessor can never splice
-        # itself into the successor's stream
+        # fresh input channel(s) under fresh ids: the old ids stay
+        # claimed on the server, so a half-dead predecessor can never
+        # splice itself into the successor's stream
         old_plan = s.plans[i]
-        old_cid = old_plan["in_channel"]
-        old_in = s.in_channels[0][i]
-        new_cid = s.alloc_cid()
-        new_in = s.server.register(new_cid, s.in_dtypes[0],
-                                   retain_epochs=old_in.retain_epochs)
+        old_cids = [old_plan["in_channel"]]
+        if n_in == 2:
+            old_cids.append(old_plan["in_channel_r"])
+        old_ins = [s.in_channels[side][i] for side in range(n_in)]
         plan = dict(old_plan)
-        plan["in_channel"] = new_cid
-        seeding = s.kind == "stateful"
-        if seeding:
-            plan["suppress_first_epoch"] = True
-            plan["refresh_after_seed"] = True
-        try:
-            nw = _spawn_worker(plan)
-        except RemoteWorkerDied as e:
-            self._escalate(str(e))
-        nw.last_epoch = w.last_epoch
+        for key in ("suppress_first_epoch", "seed_barrier",
+                    "refresh_after_seed", "diff_refresh_until"):
+            plan.pop(key, None)
+        new_ins = []
+        for side in range(n_in):
+            cid = s.alloc_cid()
+            new_ins.append(s.server.register(
+                cid, s.in_dtypes[side],
+                retain_epochs=old_ins[side].retain_epochs))
+            plan["in_channel" if side == 0 else "in_channel_r"] = cid
+        nw = None
         last = -1 if w.last_epoch is None else w.last_epoch
-        if seeding:
-            for chunk in s.seed_chunks(0, i):
-                new_in.send(chunk)
-            # every dispatched barrier the dead worker never delivered —
-            # possibly SEVERAL: a dead worker's buffered result epochs
-            # keep alignment advancing past its death, so the gap is a
-            # window, not one barrier. Re-injecting them (in order) lets
-            # alignment complete epoch by epoch; the first one also
-            # flips the worker's post-seed output suppression off.
-            for b in s.missed_barriers(last):
-                new_in.send(b)
+        seeding = s.kind in ("stateful", "join")
+        if not seeding:
+            # stateless: seed-free respawn + retained-window replay
+            try:
+                nw = _spawn_worker(plan)
+            except RemoteWorkerDied as e:
+                self._escalate(str(e), "spawn_failed")
+            for msg in old_ins[0].replay_for(last):
+                new_ins[0].send(msg)
         else:
-            for msg in old_in.replay_for(last):
-                new_in.send(msg)
+            nw = self._respawn_stateful(i, plan, old_ins, new_ins, last)
+        nw.last_epoch = w.last_epoch
         # swap into the live topology (we run on the merge thread, so the
-        # dispatcher is quiescent during the swap)
-        disp.outputs[i] = new_in
-        s.in_channels[0][i] = new_in
+        # dispatchers are quiescent during the swap)
+        for side in range(n_in):
+            s.dispatchers[side].outputs[i] = new_ins[side]
+            s.in_channels[side][i] = new_ins[side]
         s.plans[i] = plan
-        s.server.unregister(old_cid)
+        for cid in old_cids:
+            s.server.unregister(cid)
         # reset the result channel in place: whole delivered epochs in
         # its buffer stay valid (the epoch-atomic drain never leaves a
         # partial tail); the generation bump makes any straggling writes
@@ -300,6 +340,135 @@ class FragmentSupervisor:
                          "in-place worker respawns", labels=("kind",)
                          ).labels(s.kind).inc()
 
+    def _respawn_stateful(self, i: int, plan: Dict, old_ins, new_ins,
+                          last: int) -> _WorkerHandle:
+        """Respawn a stateful (owned-group agg or two-input join) worker.
+
+        Incremental (default): seed every input side with the shadow
+        rolled back to epoch `last` (un-apply the retained undelivered
+        window), mark the end of the seed with a synthetic swallowed
+        barrier, then replay the window verbatim — the worker re-derives
+        the undelivered deltas exactly (joins), or emits them as
+        per-epoch net diffs vs its seed snapshot (aggs).
+
+        Fallback (knob off, or shadow/window mismatch): v1 protocol —
+        live-shadow seed, missed barriers only, full owned-group refresh,
+        plus coordinator-side retractions for groups that vanished
+        entirely inside the crash window (aggs only; a join respawn has
+        no refresh to lean on, so a mismatch escalates)."""
+        s = self.rset
+        n_in = len(s.dispatchers)
+        replays = [old_ins[side].replay_for(last) for side in range(n_in)]
+        if last < 0:
+            # never delivered a barrier: the retained window IS the
+            # complete input stream (trims only happen on delivery) —
+            # replay it verbatim under the original plan flags, incl.
+            # any CREATE-time seed suppression. No shadow roll-back, no
+            # refresh: the successor re-derives everything exactly.
+            if s.plans[i].get("suppress_first_epoch"):
+                plan["suppress_first_epoch"] = True
+            try:
+                nw = _spawn_worker(plan)
+            except RemoteWorkerDied as e:
+                self._escalate(str(e), "spawn_failed")
+            self._send_window(i, new_ins, replays)
+            return nw
+        seeds = None
+        if ROBUSTNESS.incremental_refresh:
+            seeds = []
+            for side in range(n_in):
+                rows = s.seed_rows(side, i)
+                asof = s.unapply_window(side, rows, replays[side])
+                if asof is None:
+                    seeds = None
+                    break
+                seeds.append(asof)
+        if seeds is None and s.kind == "join":
+            self._escalate(
+                f"join worker slot {i}: retained input window does not "
+                "roll back cleanly against the shadow tables (duplicate "
+                "un-keyed rows?); a join respawn cannot refresh its way "
+                "out", "shadow_mismatch")
+        plan["suppress_first_epoch"] = True
+        if seeds is not None:
+            plan["seed_barrier"] = True
+            if s.kind == "stateful":
+                # the worker diffs vs its seed snapshot at every replayed
+                # barrier up to the last retained one; later epochs are
+                # fresh data and stream exact deltas natively
+                hi = max((m.epoch.curr for m in replays[0]
+                          if isinstance(m, Barrier)), default=None)
+                if hi is not None:
+                    plan["diff_refresh_until"] = hi
+        else:
+            plan["refresh_after_seed"] = True
+        try:
+            nw = _spawn_worker(plan)
+        except RemoteWorkerDied as e:
+            self._escalate(str(e), "spawn_failed")
+        if seeds is not None:
+            # epoch `last` state, then the end-of-seed marker, then the
+            # undelivered window (data + real barriers) — per side
+            seed_b = Barrier(EpochPair(max(last, 0), 0),
+                             BarrierKind.BARRIER)
+            for side in range(n_in):
+                for chunk in _chunks_from_rows(s.in_dtypes[side],
+                                               seeds[side]):
+                    new_ins[side].send(chunk)
+                    s.heartbeats[i] = time.time()   # seed replay progress
+                new_ins[side].send(seed_b)
+            self._send_window(i, new_ins, replays)
+        else:
+            rows0 = s.seed_rows(0, i)
+            for chunk in _chunks_from_rows(s.in_dtypes[0], rows0):
+                new_ins[0].send(chunk)
+                s.heartbeats[i] = time.time()
+            # every dispatched barrier the dead worker never delivered —
+            # possibly SEVERAL: a dead worker's buffered result epochs
+            # keep alignment advancing past its death, so the gap is a
+            # window, not one barrier. Re-injecting them (in order) lets
+            # alignment complete epoch by epoch; the first one also
+            # flips the worker's post-seed output suppression off.
+            for b in replays[0]:
+                if isinstance(b, Barrier):
+                    new_ins[0].send(b)
+            # full refresh re-INSERTs surviving groups; groups fully
+            # retracted inside the crash window have nothing left to
+            # refresh, so the coordinator retracts them from its
+            # last-delivered output map
+            s.retract_vanished(i, seed_rows=rows0)
+        return nw
+
+    def _send_window(self, i: int, new_ins, replays) -> None:
+        """Replay the retained undelivered window into the fresh
+        channels, EPOCH-INTERLEAVED across input sides: a two-input
+        worker consumes side 0 up to its barrier before touching side 1,
+        so shipping one side's whole multi-epoch window first could fill
+        its channel past capacity while the worker waits on the other
+        side. Stamps the slot heartbeat as it goes — a big window must
+        not read as a wedge."""
+        s = self.rset
+        iters = [iter(r) for r in replays]
+        done = [False] * len(iters)
+        while not all(done):
+            for side, it in enumerate(iters):
+                if done[side]:
+                    continue
+                for msg in it:
+                    new_ins[side].send(msg)
+                    s.heartbeats[i] = time.time()
+                    if isinstance(msg, Barrier):
+                        break
+                else:
+                    done[side] = True
+
+
+def _chunks_from_rows(dtypes, rows, op: Op = Op.INSERT,
+                      batch: int = 4096) -> Iterator[StreamChunk]:
+    for lo in range(0, len(rows), batch):
+        yield StreamChunk.from_rows(
+            dtypes, [(op, tuple(r)) for r in rows[lo:lo + batch]])
+
 
 class _RemoteSetBase:
     """Shared coordinator plumbing for a set of worker fragments: the
@@ -311,9 +480,11 @@ class _RemoteSetBase:
     worker), `in_dtypes` (per side), `out_schema`, then call
     `_finish_init(supervise)`."""
 
-    kind = "partial"                   # "partial" | "stateful"
+    kind = "partial"                   # "partial" | "stateful" | "join"
+    frag_kind = "partial_hash_agg"
     seed_tables: Optional[List[Any]] = None
     seed_strips: Sequence[int] = ()
+    group_count = 0                    # output group-key width (hash_agg)
 
     def _finish_init(self, supervise: bool) -> None:
         self._next_cid = 1 + max(
@@ -324,32 +495,22 @@ class _RemoteSetBase:
         # these) — the substrate of worker_liveness / rw_worker_liveness
         self.heartbeats = [time.time()] * len(self.workers)
         self._wedged = [False] * len(self.workers)
+        self._reaping = [False] * len(self.workers)
+        # per-slot last-delivered output map (supervised owned-group
+        # aggs): group key -> last output row released downstream. The
+        # coordinator-side diff surface of the v1 fallback refresh —
+        # groups fully retracted inside a crash window are retracted
+        # from here, because neither the respawned worker (no seed rows)
+        # nor the full refresh (nothing to re-insert) can.
+        self.delivered: List[Dict[Tuple, Tuple]] = \
+            [dict() for _ in self.workers]
         self.supervisor = FragmentSupervisor(self) if supervise else None
-        # dispatched-barrier log (supervised single-input sets): the
-        # respawn protocol replays every barrier a dead worker never
-        # delivered; trimmed as the drains confirm delivery
-        self.barrier_log: List[Barrier] = []
-        if self.supervisor is not None and len(self.dispatchers) == 1:
-            self.dispatchers[0].on_barrier = self._log_barrier
         self._start_drains()
 
     def alloc_cid(self) -> int:
         cid = self._next_cid
         self._next_cid += 1
         return cid
-
-    def _log_barrier(self, b: Barrier) -> None:
-        """Dispatcher hook (merge/main thread): record the fan-out and
-        age out barriers every worker has delivered results for."""
-        self.barrier_log.append(b)
-        low = min((-1 if w.last_epoch is None else w.last_epoch)
-                  for w in self.workers)
-        self.barrier_log = [x for x in self.barrier_log
-                            if x.epoch.curr > low]
-
-    def missed_barriers(self, last_delivered_epoch: int) -> List[Barrier]:
-        return [b for b in self.barrier_log
-                if b.epoch.curr > last_delivered_epoch]
 
     # ---- result side ----------------------------------------------------
     def _start_drains(self) -> None:
@@ -405,6 +566,8 @@ class _RemoteSetBase:
                         # joined by the consumer thread during recovery
                         buf.append(msg)
                         ch.send_batch(buf)
+                        if self.kind == "stateful" and self.group_count:
+                            self._fold_delivered(i, buf)
                         buf = []
                     else:
                         ch.send(msg)
@@ -439,7 +602,9 @@ class _RemoteSetBase:
         out = []
         for i, w in enumerate(self.workers):
             age = now - self.heartbeats[i]
-            if w.proc.poll() is not None:
+            if self._reaping[i]:
+                state = "reaping"        # wedge reaper mid-kill/respawn
+            elif w.proc.poll() is not None:
                 state = "dead"
             elif age > ROBUSTNESS.heartbeat_timeout_s:
                 state = "wedged?"
@@ -480,18 +645,19 @@ class _RemoteSetBase:
                     "replays the fragments)")
 
     # ---- seeds (stateful sets) -----------------------------------------
-    def seed_chunks(self, side: int, i: int) -> Iterator[StreamChunk]:
-        """Worker i's partition of the coordinator shadow table, as
-        INSERT chunks — exactly the rows the hash dispatcher would have
-        routed to it (same vnode map, so respawn ownership matches)."""
+    def seed_rows(self, side: int, i: int) -> List[Tuple]:
+        """Worker i's partition of the coordinator shadow table —
+        exactly the rows the hash dispatcher would have routed to it
+        (same vnode map, so respawn ownership matches)."""
         table = self.seed_tables[side] if self.seed_tables else None
         if table is None:
-            return
+            return []
         strip = self.seed_strips[side] if self.seed_strips else 0
         rows = [tuple(r)[:-strip] if strip else tuple(r)
                 for r in table.iter_all()]
         disp = self.dispatchers[side]
         dtypes = self.in_dtypes[side]
+        out: List[Tuple] = []
         for lo in range(0, len(rows), 4096):
             chunk = StreamChunk.from_rows(
                 dtypes, [(Op.INSERT, r) for r in rows[lo:lo + 4096]])
@@ -499,8 +665,92 @@ class _RemoteSetBase:
                 [chunk.columns[j] for j in disp.key_indices],
                 vnode_count=disp.vnode_count)
             vis = disp.vnode_to_out[vn] == i
-            if vis.any():
-                yield StreamChunk(chunk.ops, chunk.columns, vis)
+            out.extend(r for r, keep in zip(rows[lo:lo + 4096], vis)
+                       if keep)
+        return out
+
+    def _seed_key(self, side: int):
+        """Row-identity key function of a shadow side: the shadow
+        table's pk (the carried stream key for aggs; the whole pre-pad
+        row for join sides), evaluated on STRIPPED rows."""
+        table = self.seed_tables[side]
+        pk = list(table.pk_indices)
+        return lambda row: tuple(row[j] for j in pk)
+
+    def unapply_window(self, side: int, rows: List[Tuple],
+                       window: List[Any]) -> Optional[List[Tuple]]:
+        """Roll the live shadow partition back to the state BEFORE the
+        retained undelivered window: walk the window's chunks in reverse,
+        removing its inserts and restoring its deletes. Returns None when
+        the window and the shadow disagree (an insert to un-apply that
+        the shadow never had, or a delete whose row is still present) —
+        the caller falls back or escalates rather than seeding a worker
+        from inconsistent state."""
+        key = self._seed_key(side)
+        d: Dict[Tuple, Tuple] = {key(r): r for r in rows}
+        for msg in reversed(window):
+            if not isinstance(msg, StreamChunk):
+                continue
+            for op, row in reversed(list(msg.compact().op_rows())):
+                k = key(row)
+                if op.is_insert:
+                    if k not in d:
+                        return None
+                    del d[k]
+                else:
+                    if k in d:
+                        return None
+                    d[k] = tuple(row)
+        return list(d.values())
+
+    def retract_vanished(self, i: int,
+                         seed_rows: Optional[List[Tuple]] = None) -> None:
+        """v1 fallback only: groups the dead worker had DELIVERED that
+        no longer exist in the live shadow were fully retracted inside
+        the crash window — the respawned worker has no seed rows for
+        them, the full refresh re-inserts nothing, and the MV would keep
+        the stale row forever. The coordinator knows both sides of the
+        diff (its last-delivered output map vs the live shadow), so it
+        emits the retraction itself, straight into the worker's result
+        channel (merge forwards chunks freely; materialize deletes by
+        pk). `seed_rows` lets the caller reuse an already-materialized
+        partition scan."""
+        if self.frag_kind != "hash_agg" or not self.group_count:
+            return
+        if seed_rows is None:
+            seed_rows = self.seed_rows(0, i)
+        gidx = self.plans[i]["fragment"]["group_indices"]
+        alive = {tuple(r[j] for j in gidx) for r in seed_rows}
+        dmap = self.delivered[i]
+        gone = [g for g in dmap if g not in alive]
+        if not gone:
+            return
+        rows = [dmap.pop(g) for g in gone]
+        ch = self.channels[i]
+        for chunk in _chunks_from_rows(
+                [f.dtype for f in self.out_schema.fields], rows,
+                op=Op.DELETE):
+            ch.send_batch([chunk])
+        REGISTRY.counter(
+            "supervisor_refresh_retractions_total",
+            "coordinator-emitted retractions for groups fully retracted "
+            "inside a crash window").inc(len(rows))
+
+    def _fold_delivered(self, i: int, batch: List[Any]) -> None:
+        """Fold a released (delivered) epoch batch into the per-slot
+        last-delivered output map — runs on the drain thread, read by
+        the supervisor only after that thread is joined."""
+        ng = self.group_count
+        dmap = self.delivered[i]
+        for msg in batch:
+            if not isinstance(msg, StreamChunk):
+                continue
+            for op, row in msg.compact().op_rows():
+                g = tuple(row[:ng])
+                if op.is_insert:
+                    dmap[g] = tuple(row)
+                else:
+                    dmap.pop(g, None)
 
     # ---- lifecycle ------------------------------------------------------
     def shutdown(self) -> None:
@@ -622,11 +872,19 @@ class RemoteStatefulSet(_RemoteSetBase):
         self.server = ExchangeServer()
         n_in = len(inputs)
         assert n_in in (1, 2) and len(key_indices_list) == n_in
+        self.frag_kind = fragment["kind"]
+        self.kind = "join" if self.frag_kind == "hash_join" else "stateful"
+        self.group_count = len(fragment.get("group_indices", ()))
         self.seed_tables = list(seed_tables) if seed_tables else None
         self.seed_strips = list(seed_strips) or [0] * n_in
-        # channel ids: input 0 -> 0..k-1, input 1 -> k..2k-1
+        # channel ids: input 0 -> 0..k-1, input 1 -> k..2k-1.
+        # Supervised sets retain undelivered input epochs per channel:
+        # the respawn protocol rolls the shadow back by the retained
+        # window and replays it, so retention is what makes stateful
+        # in-place recovery exactly-once.
         chans = [[self.server.register(i * k + j,
-                                       inputs[i].schema.dtypes)
+                                       inputs[i].schema.dtypes,
+                                       retain_epochs=supervise)
                   for j in range(k)] for i in range(n_in)]
         self.in_channels = chans
         self.in_dtypes = [list(e.schema.dtypes) for e in inputs]
@@ -651,6 +909,13 @@ class RemoteStatefulSet(_RemoteSetBase):
                 p["in_schema_r"] = [[f.name, f.dtype.kind.value]
                                     for f in inputs[1].schema.fields]
                 p["append_only_r"] = inputs[1].append_only
+            if supervise and self.frag_kind == "hash_join":
+                # epoch-atomic join output: the worker buffers emitted
+                # rows and flushes them at the barrier (like the partial
+                # agg flush), so nothing of an in-flight epoch ever
+                # crosses the wire — the invariant the replay/re-seed
+                # machinery needs to cover two-input fragments
+                p["epoch_atomic"] = True
             self.plans.append(p)
         self.workers: List[_WorkerHandle] = []
         for p in self.plans:
@@ -731,9 +996,12 @@ def make_remote_join(lexec: Executor, rexec: Executor, lkeys, rkeys,
     """Hash join across k worker processes: both inputs hash-dispatch on
     the join key, each worker owns its key space and runs the FULL
     stateful HashJoinExecutor; the coordinator shadows both sides and
-    seeds fresh workers on recovery. (In-place supervision escalates for
-    two-input fragments — the supervisor can't yet reconcile join output
-    emitted per-chunk; `FragmentSupervisor` docstring.)"""
+    seeds fresh workers on recovery. Supervised join workers respawn IN
+    PLACE: output is epoch-atomic (worker-side barrier flush), so the
+    supervisor can seed a successor from both-side shadows rolled back
+    to the last delivered epoch and replay the retained window on both
+    dispatchers — the undelivered join deltas re-derive exactly
+    (`FragmentSupervisor` docstring)."""
     # shadow tables reuse the join-state layout (row + degree column);
     # the tee pads the degree, seeds strip it
     lseed = [tuple(r)[:-1] for r in left_state.iter_all()] \

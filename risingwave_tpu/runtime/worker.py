@@ -137,9 +137,11 @@ def build_fragment(plan: Dict[str, Any], upstream, upstream2=None) -> Any:
 
 def _refresh_chunks(execu) -> Iterator[Any]:
     """Full current output of an owned-group agg fragment, as INSERT
-    chunks — the post-respawn reconciliation stream. The coordinator's
-    MV applies changes by pk, so re-inserting every owned group's row
-    heals whatever the dead predecessor emitted-but-never-delivered."""
+    chunks — the v1 post-respawn reconciliation stream. The
+    coordinator's MV applies changes by pk, so re-inserting every owned
+    group's row heals whatever the dead predecessor
+    emitted-but-never-delivered (duplicate `+` records downstream are
+    the price; the sink boundary dedupes them)."""
     from ..core.chunk import Op, StreamChunk
     groups = getattr(execu, "groups", None)
     if groups is None:
@@ -150,6 +152,40 @@ def _refresh_chunks(execu) -> Iterator[Any]:
         yield StreamChunk.from_rows(
             execu.schema.dtypes,
             [(Op.INSERT, r) for r in rows[lo:lo + 4096]])
+
+
+def _group_snapshot(execu) -> Optional[Dict]:
+    """Current owned-group output rows keyed by group — the seed
+    snapshot the incremental refresh diffs against."""
+    groups = getattr(execu, "groups", None)
+    if groups is None:
+        return None
+    return {tuple(k): tuple(k) + tuple(g.output())
+            for k, g in groups.items() if g.row_count > 0}
+
+
+def _diff_chunks(execu, snapshot: Dict) -> Iterator[Any]:
+    """Net change of the agg state vs a prior snapshot, as retractable
+    chunks — the INCREMENTAL refresh: only groups whose value differs
+    from the snapshot are emitted (changed groups as U-/U+ pairs, new
+    groups as inserts, vanished groups as exact retractions), so the
+    stream is ⊆ changed groups and the downstream changelog stays
+    duplicate-free."""
+    from ..core.chunk import Op, StreamChunk
+    cur = _group_snapshot(execu) or {}
+    pairs = []
+    for k, row in cur.items():
+        old = snapshot.get(k)
+        if old is None:
+            pairs.append((Op.INSERT, row))
+        elif old != row:
+            pairs += [(Op.UPDATE_DELETE, old), (Op.UPDATE_INSERT, row)]
+    for k, row in snapshot.items():
+        if k not in cur:
+            pairs.append((Op.DELETE, row))
+    for lo in range(0, len(pairs), 4096):
+        yield StreamChunk.from_rows(execu.schema.dtypes,
+                                    pairs[lo:lo + 4096])
 
 
 def main(argv: List[str]) -> int:
@@ -202,11 +238,34 @@ def main(argv: List[str]) -> int:
     # their OUTPUTS are already in the downstream MV's recovered
     # snapshot, so everything before the first barrier is swallowed.
     suppress = plan.get("suppress_first_epoch", False)
-    # Supervised respawn additionally asks for a one-shot full refresh
-    # of the rebuilt state right after the first barrier (see
-    # _refresh_chunks) — the seed swallow above hides any changes the
-    # dead predecessor never delivered, and the refresh re-states them.
+    # Supervised respawn v2: the seed ends at a SYNTHETIC barrier the
+    # worker swallows (it never reaches the coordinator — downstream
+    # alignment already passed that epoch). At the swallow point an agg
+    # fragment snapshots its seed state; the retained crash window then
+    # replays, and every real barrier up to `diff_refresh_until` emits
+    # the NET DIFF vs the snapshot instead of the suppressed raw deltas
+    # — the incremental refresh (⊆ changed groups, retractions exact).
+    # Joins skip the diff: their replayed deltas re-derive verbatim.
+    seed_barrier = plan.get("seed_barrier", False)
+    diff_until = plan.get("diff_refresh_until")
+    # v1 fallback: one-shot full refresh right after the first barrier
+    # (see _refresh_chunks) — the seed swallow above hides any changes
+    # the dead predecessor never delivered, and the refresh re-states
+    # them (by-pk reconciliation downstream).
     refresh = plan.get("refresh_after_seed", False)
+    # epoch-atomic output (supervised joins): buffer data/watermarks and
+    # flush at the barrier, so a crash mid-epoch leaves NOTHING of that
+    # epoch on the wire — the same invariant the agg partial flush gives
+    # single-input fragments
+    epoch_atomic = plan.get("epoch_atomic", False)
+    m_refresh = REGISTRY.counter(
+        "worker_refresh_rows_total",
+        "rows emitted by post-respawn refreshes",
+        labels=("fragment", "mode"))
+    diff_mode = False
+    snapshot: Optional[Dict] = None
+    obuf: List[Any] = []
+    n_sup = 0
     from ..core.chunk import StreamChunk as _Chunk
     from ..ops.message import Barrier as _B
     try:
@@ -215,22 +274,66 @@ def main(argv: List[str]) -> int:
                 os._exit(3)             # hard death, like SIGKILL
             if suppress:
                 if not isinstance(msg, _B):
+                    n_sup += 1
+                    if n_sup % 64 == 0:
+                        # long seed/replay ingestion produces no result
+                        # frames; stamp liveness from inside the replay
+                        # loop so the wedge reaper never mistakes a big
+                        # seed for a stall
+                        heartbeat()
                     continue
                 suppress = False
+                if seed_barrier:
+                    # synthetic end-of-seed marker: swallow it; from
+                    # here on the stream is the replayed crash window
+                    snapshot = _group_snapshot(execu)
+                    diff_mode = diff_until is not None \
+                        and snapshot is not None
+                    heartbeat()
+                    continue
                 out.send(msg)
                 m_epochs.inc()
                 heartbeat(msg.epoch.curr)
                 if refresh:
+                    n = 0
                     for chunk in _refresh_chunks(execu):
                         out.send(chunk)
+                        n += int(chunk.cardinality)
+                    m_refresh.labels(kind, "full").inc(n)
                     refresh = False
                 continue
-            out.send(msg)
             if isinstance(msg, _B):
+                if diff_mode:
+                    n = 0
+                    for chunk in _diff_chunks(execu, snapshot):
+                        out.send(chunk)
+                        n += int(chunk.cardinality)
+                        m_chunks.inc()
+                    m_refresh.labels(kind, "diff").inc(n)
+                    if msg.epoch.curr >= diff_until:
+                        diff_mode = False
+                    else:
+                        snapshot = _group_snapshot(execu)
+                elif obuf:
+                    for m2 in obuf:     # epoch-atomic flush
+                        out.send(m2)
+                        if isinstance(m2, _Chunk):
+                            m_chunks.inc()
+                    obuf = []
+                out.send(msg)
                 m_epochs.inc()
                 heartbeat(msg.epoch.curr)
-            elif isinstance(msg, _Chunk):
+                continue
+            if diff_mode:
+                continue     # raw deltas re-derive as the net diff
+            if epoch_atomic:
+                obuf.append(msg)
+                continue
+            out.send(msg)
+            if isinstance(msg, _Chunk):
                 m_chunks.inc()
+        for m2 in obuf:                 # clean EOS: flush the tail
+            out.send(m2)
     except (ConnectionError, OSError):
         return 2          # coordinator gone: exit quietly, nothing to save
     finally:

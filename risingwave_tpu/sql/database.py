@@ -819,8 +819,9 @@ class Database:
 
     def _create_sink(self, stmt: A.CreateSink) -> str:
         self._pending_subs = []
+        sink_pk = None
         if stmt.from_name is not None:
-            execu, schema, _pk = self._subscribe(stmt.from_name)
+            execu, schema, sink_pk = self._subscribe(stmt.from_name)
         else:
             execu, ns = self._make_planner(
                 self._subscribe, make_state=self._make_state,
@@ -843,7 +844,12 @@ class Database:
             log_table = StateTable(
                 self.store, self.catalog.alloc_table_id(),
                 [T.INT64, T.INT64, T.INT64, T.BYTEA], [0, 1])
-            sink_exec = SinkExecutor(execu, sink, log_table=log_table)
+            # upstream pk (when sinking FROM a materialized object)
+            # arms the sink-boundary dedupe: post-respawn refreshes may
+            # re-state rows the changelog already carries, and the MV's
+            # by-pk reconciliation doesn't reach external files
+            sink_exec = SinkExecutor(execu, sink, log_table=log_table,
+                                     pk_indices=sink_pk)
             obj.runtime = {"sink": sink, "sink_exec": sink_exec,
                            "collect": None,
                            "state_table": None, "shared": None,

@@ -276,11 +276,13 @@ class TestSupervisedRecovery:
         assert got == want and any(k == 1 for k, _ in got), (got, want)
         find_remote(db2, "ra").shutdown()
 
-    def test_join_fragment_death_escalates_immediately(self):
-        """Two-input join fragments are outside the in-place respawn
-        envelope (per-chunk join output can't be reconciled by refresh):
-        supervision must degrade gracefully to RemoteWorkerDied."""
-        from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+    @pytest.mark.chaos
+    def test_join_fragment_death_respawns_in_place(self):
+        """Supervision v2: a dead two-input join worker respawns IN
+        PLACE — re-seeded from both-side shadows rolled back to its last
+        delivered epoch, window replayed on both dispatchers — instead
+        of escalating to RemoteWorkerDied. Retractions and fresh inserts
+        against the respawned worker stay exact."""
         self._fast_backoff()
         db = Database()
         db.run("CREATE TABLE a (k BIGINT, v BIGINT)")
@@ -290,12 +292,29 @@ class TestSupervisedRecovery:
         db.run("SET streaming_supervision TO true")
         db.run("CREATE MATERIALIZED VIEW rj AS SELECT a.v, b.w"
                " FROM a JOIN b ON a.k = b.k")
-        db.run("INSERT INTO a VALUES (1, 10)")
-        for _ in range(3):
+        db.run("INSERT INTO a VALUES (1, 10), (2, 20), (3, 30)")
+        db.run("INSERT INTO b VALUES (1, 100), (2, 200)")
+        for _ in range(4):
             db.tick()
+        assert sorted(db.query("SELECT * FROM rj")) == \
+            [(10, 100), (20, 200)]
         rfs = find_remote(db, "rj")
-        rfs.workers[0].proc.kill()
-        with pytest.raises(RemoteWorkerDied, match="two-input join"):
-            for _ in range(10):
-                db.tick()
+        assert rfs.kind == "join"
+        victim = 0
+        old_pid = rfs.workers[victim].proc.pid
+        rfs.workers[victim].proc.kill()
+        for _ in range(4):
+            db.tick()                  # supervisor respawns, no teardown
+        assert find_remote(db, "rj") is rfs
+        assert rfs.supervisor.respawns == 1
+        assert rfs.workers[victim].proc.pid != old_pid
+        assert sorted(db.query("SELECT * FROM rj")) == \
+            [(10, 100), (20, 200)]
+        # retraction against RESEEDED both-side state must match exactly
+        db.run("DELETE FROM b WHERE k = 1")
+        db.run("INSERT INTO b VALUES (3, 300)")
+        for _ in range(4):
+            db.tick()
+        assert sorted(db.query("SELECT * FROM rj")) == \
+            [(20, 200), (30, 300)]
         rfs.shutdown()

@@ -26,6 +26,81 @@ def _clean_failpoints():
 
 
 # ---------------------------------------------------------------------------
+# global ordinal ledger: record, dump, replay
+# ---------------------------------------------------------------------------
+
+
+def test_ledger_records_every_fire_in_global_order():
+    fp.clear_ledger()
+    fp.arm("a", prob=1.0, seed=0, max_fires=2)
+    fp.arm("b", prob=1.0, seed=0, max_fires=1)
+    fp.failpoint("a")
+    fp.failpoint("b")
+    fp.failpoint("a")
+    fp.failpoint("a")                  # capped: no fire, no entry
+    led = fp.ledger()
+    assert [(o, p, h) for o, p, _t, h in led] == \
+        [(0, "a", 1), (1, "b", 1), (2, "a", 2)]
+    fp.clear_ledger()
+
+
+def test_ledger_dump_load_roundtrip(tmp_path):
+    fp.clear_ledger()
+    fp.arm("x", prob=0.5, seed=3)
+    for _ in range(40):
+        fp.failpoint("x")
+    path = str(tmp_path / "l.jsonl")
+    n = fp.dump_ledger(path)
+    assert n == len(fp.ledger()) > 0
+    assert fp.load_ledger(path) == fp.ledger()
+    fp.clear_ledger()
+
+
+def test_ledger_env_replay_arms_recorded_points(tmp_path, monkeypatch):
+    """RW_FAILPOINT_LEDGER pointed at an EXISTING recording re-arms the
+    recorded points in replay mode at load_env time — the process-tree
+    arming path (workers inherit the env)."""
+    import os
+    fp.clear_ledger()
+    fp.arm("x", prob=0.3, seed=11)
+    fired1 = [fp.failpoint("x") for _ in range(60)]
+    path = str(tmp_path / "l.jsonl")
+    fp.dump_ledger(path)
+    fp.reset()
+    fp.clear_ledger()
+    monkeypatch.setenv(fp.LEDGER_ENV, path)
+    monkeypatch.delenv(fp.ENV_VAR, raising=False)
+    monkeypatch.delenv(fp.MODE_ENV, raising=False)
+    fp.load_env()
+    armed = {p.name: p for p in fp.armed()}
+    assert armed["x"].replay_hits is not None
+    # the root pins its decision into the env for descendants
+    assert os.environ[fp.MODE_ENV] == "replay"
+    fired2 = [fp.failpoint("x") for _ in range(60)]
+    assert fired1 == fired2
+    fp.clear_ledger()
+
+
+def test_ledger_mode_pin_survives_file_appearing(tmp_path, monkeypatch):
+    """A process that inherited mode=record must KEEP recording even
+    though the ledger file now exists (a sibling recorder exited first):
+    without the pin, every worker spawned after the first clean sibling
+    exit would silently flip to replaying a partial ledger mid-run."""
+    path = str(tmp_path / "l.jsonl")
+    fp.arm("x", prob=1.0, seed=0, max_fires=1)
+    fp.failpoint("x")
+    fp.dump_ledger(path)               # the file now exists...
+    fp.reset()
+    monkeypatch.setenv(fp.LEDGER_ENV, path)
+    monkeypatch.setenv(fp.MODE_ENV, "record")   # ...but mode was pinned
+    monkeypatch.delenv(fp.ENV_VAR, raising=False)
+    fp.load_env()
+    assert not fp.armed(), \
+        "pinned record mode must not arm replay points from the file"
+    fp.clear_ledger()
+
+
+# ---------------------------------------------------------------------------
 # registry semantics
 # ---------------------------------------------------------------------------
 
@@ -267,3 +342,29 @@ def test_risectl_failpoints_lists_and_arms(capsys):
         main(["failpoints", "--arm", "nope.never"])
     with pytest.raises(SystemExit):
         main(["failpoints", "--arm", "worker.crash:banana"])
+
+
+def test_risectl_failpoints_ledger(tmp_path, capsys):
+    from risingwave_tpu.ctl import main
+    fp.clear_ledger()
+    assert main(["failpoints", "--ledger"]) == 0   # live, nothing fired
+    assert "ledger is empty" in capsys.readouterr().out
+    fp.arm("a", prob=1.0, seed=0, max_fires=2)
+    fp.arm("b", prob=1.0, seed=0, max_fires=1)
+    fp.failpoint("a"), fp.failpoint("b"), fp.failpoint("a")
+    assert main(["failpoints", "--ledger"]) == 0   # live in-process ledger
+    out = capsys.readouterr().out
+    assert "3 fires" in out and fp.LEDGER_ENV in out
+    assert out.index(" a ") < out.index(" b ")     # global ordinal order
+    path = str(tmp_path / "l.jsonl")
+    fp.dump_ledger(path)
+    fp.clear_ledger()
+    assert main(["failpoints", "--ledger", path]) == 0   # recorded file
+    out = capsys.readouterr().out
+    assert "3 fires" in out and "a" in out and "b" in out
+    with pytest.raises(SystemExit):
+        main(["failpoints", "--ledger", str(tmp_path / "nope.jsonl")])
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("not json\n")
+    with pytest.raises(SystemExit):
+        main(["failpoints", "--ledger", str(bad)])
