@@ -383,6 +383,14 @@ def _warmup_stats(db, warmup_s):
     return out
 
 
+def _freshness_stats(db):
+    """Per-MV source->commit freshness quantiles (utils/freshness.py):
+    p50/p99/last over the run's commits — eps without freshness is half
+    the perf story (a fast-but-stale engine fails the paper's
+    serve-production-traffic bar), so the trajectory records both."""
+    return db._freshness.summary()
+
+
 def _q4_db(on, n_events, chunk=None):
     from risingwave_tpu.sql import Database
     chunk = chunk or (Q4_CHUNK if on else 8192)
@@ -393,7 +401,7 @@ def _q4_db(on, n_events, chunk=None):
     dt = drive(db, n_events, chunk=chunk)
     rows = db.query("SELECT * FROM q4")
     return (n_events / dt, rows, _cap_stats(db), _profile_stats(db),
-            _warmup_stats(db, dt))
+            _warmup_stats(db, dt), _freshness_stats(db))
 
 
 def stage_q4_device(n_events):
@@ -406,10 +414,10 @@ def stage_q4_device(n_events):
     reported separately (`warmup_s`); cache entries also persist to disk
     (.jax_cache) so later processes skip the compile entirely."""
     t0 = time.perf_counter()
-    _, _, _, _, warm = _q4_db(True, n_events)
+    _, _, _, _, warm, _ = _q4_db(True, n_events)
     warmup_s = time.perf_counter() - t0
     warm["warmup_s"] = round(warmup_s, 1)
-    eps, rows, caps, prof, _ = _q4_db(True, n_events)
+    eps, rows, caps, prof, _, fresh = _q4_db(True, n_events)
     cols = nexmark_host_columns(n_events)["bid"]
     oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
     assert len(rows) == len(oracle)
@@ -421,6 +429,7 @@ def stage_q4_device(n_events):
         "warmup": warm,
         "capacity": caps,
         "profile": prof,
+        "freshness": fresh,
         "mv_verified": True,
         "note": "full SQL stack on device (fused epoch programs, "
                 "checkpoint every 8 barriers); warmup_s = first full "
@@ -428,13 +437,17 @@ def stage_q4_device(n_events):
                 "state (second pass, jit-cached); profile block = "
                 "measured-pass epoch timeline (phase_s splits the wall "
                 "into host-pack/dispatch/device-sync/commit; "
-                "compile_events decompose any residual warmup)",
+                "compile_events decompose any residual warmup); "
+                "freshness block = per-MV source->commit p50/p99 "
+                "seconds (rw_mv_freshness over the measured pass)",
     }}
 
 
 def stage_q4_host(n_events):
-    eps = _q4_db(False, n_events)[0]
-    return {"q4_sql_host": {"host_sql_eps": round(eps), "events": n_events}}
+    out = _q4_db(False, n_events)
+    return {"q4_sql_host": {"host_sql_eps": round(out[0]),
+                            "events": n_events,
+                            "freshness": out[5]}}
 
 
 QX_CHUNK = 2048   # smaller fused epochs: q5's hop(5x)+agg cascade compiles
@@ -460,7 +473,7 @@ def _qx_db(on, n_events, capacity):
         "q8": db.query("SELECT * FROM nexmark_q8"),
     }
     return (n_events / dt, out, _cap_stats(db), _profile_stats(db),
-            _warmup_stats(db, dt))
+            _warmup_stats(db, dt), _freshness_stats(db))
 
 
 def stage_qx_device(n_events):
@@ -470,7 +483,7 @@ def stage_qx_device(n_events):
     budget without changing the steady-state story; compiled programs
     persist in the cache across attempts either way."""
     t0 = time.perf_counter()
-    eps, qx, caps, prof, warm = _qx_db(True, n_events, QX_CAPACITY)
+    eps, qx, caps, prof, warm, fresh = _qx_db(True, n_events, QX_CAPACITY)
     warmup_s = round(time.perf_counter() - t0, 1)
     warm["warmup_s"] = warmup_s
     c = nexmark_host_columns(n_events)
@@ -498,6 +511,7 @@ def stage_qx_device(n_events):
         "warmup": warm,
         "capacity": caps,
         "profile": prof,
+        "freshness": fresh,
         "numpy_batch_eps": {"q5": round(q5_np_eps), "q7": round(q7_np_eps),
                             "q8": round(q8_np_eps)},
         "rows": {k: len(v) for k, v in qx.items()},
@@ -514,9 +528,10 @@ def stage_qx_device(n_events):
 
 
 def stage_qx_host(n_events):
-    eps = _qx_db(False, n_events, QX_CAPACITY)[0]
-    return {"q5_q7_q8_sql_host": {"host_sql_eps": round(eps),
-                                  "events": n_events}}
+    out = _qx_db(False, n_events, QX_CAPACITY)
+    return {"q5_q7_q8_sql_host": {"host_sql_eps": round(out[0]),
+                                  "events": n_events,
+                                  "freshness": out[5]}}
 
 
 # ---------------------------------------------------------------------------
@@ -756,7 +771,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r07.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r09.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:

@@ -80,6 +80,17 @@ class DeviceConfig:
     # shapes (the capacity ladder ahead of observed need). 0 disables
     # bucket pre-warm while keeping CREATE-time AOT.
     compile_buckets: int = 4
+    # key-skew telemetry (device/skew_stats.py): keyed fused nodes
+    # (Agg/Join) compute a vnode-occupancy histogram over their live key
+    # tables and per-epoch top-K heavy-hitter counters inside the traced
+    # epoch step, riding the stats vector (psum/pmax across mesh shards
+    # like every other stat) — the rw_key_skew evidence surface the
+    # adaptive-partitioning work needs. Costs one O(capacity) bucket
+    # pass + one O(epoch) sort per keyed node per epoch; off removes the
+    # stats from the trace entirely (and changes the plan-shape hash —
+    # the traced programs genuinely differ). RW_SKEW_STATS=0/1 in the
+    # environment overrides this without code changes.
+    skew_stats: bool = True
 
 
 @dataclass

@@ -188,8 +188,13 @@ class SplitSourceReader(SourceReader):
         self.records_per_poll = records_per_poll
         self.offsets: Dict[str, Any] = {}
         self._rr: int = 0   # round-robin cursor over the live split list
+        # wall of the last successful poll — the source->MV freshness
+        # measure anchors here (data "exists" the moment it is read off
+        # the split, BEFORE parsing: parse cost is inside the measure)
+        self.last_ingest_ts: Optional[float] = None
 
     def poll(self) -> Optional[StreamChunk]:
+        import time
         splits = self.enumerator.list_splits()
         if not splits:
             return None
@@ -199,10 +204,12 @@ class SplitSourceReader(SourceReader):
             records, nxt = self.reader.read(
                 s, self.offsets.get(s.split_id), self.records_per_poll)
             if records:
+                read_ts = time.time()
                 self._rr = (self._rr + probe + 1) % len(splits)
                 self.offsets[s.split_id] = nxt
                 chunk = self.parser.parse(records)
                 if chunk.cardinality > 0:
+                    self.last_ingest_ts = read_ts
                     return chunk
         self._rr = (self._rr + 1) % max(1, len(splits))
         return None

@@ -22,12 +22,20 @@ Commands:
                               works on a LIVE or wedged data dir;
                               --stuck-only drops committed epochs so
                               stalls survive fresh committed traffic
+    trace export              merge barrier_trace.jsonl +
+                              epoch_profile.jsonl + heartbeat clock
+                              samples into Chrome/Perfetto trace-event
+                              JSON on one coordinator-clock timeline
+                              (--format chrome, -o FILE) — a whole
+                              warmup/chaos run opens in ui.perfetto.dev
     profile [JOB]             fused-job epoch timeline from
                               epoch_profile.jsonl: phase totals
                               (host-pack / dispatch / device-sync /
                               commit), compile events, top-N slowest
                               epochs (JSON) — decompose warmup vs
-                              steady state without rerunning anything
+                              steady state without rerunning anything;
+                              --follow tails the file live
+                              (rotation-aware `tail -f`)
     failpoints [--spec S]     list declared fault-injection points and
                               which the spec (default: $RW_FAILPOINTS)
                               arms; --arm validates a spec and prints
@@ -185,8 +193,37 @@ def cmd_metrics(args) -> int:
 def cmd_trace(args) -> int:
     """Offline barrier-span summary (`monitor_service.rs:82` await-tree
     analog): reads the data dir's trace log without opening the Database,
-    so it works against a WEDGED process's directory too."""
+    so it works against a WEDGED process's directory too.
+
+    `trace export --format chrome [-o FILE]` instead merges the barrier
+    trace, the epoch profile and the heartbeat clock samples into ONE
+    Chrome/Perfetto trace-event JSON (utils/export.py): a whole warmup
+    or chaos run opens in ui.perfetto.dev."""
     from ..utils.trace import TRACE_FILE, diagnose
+    if args.action == "export":
+        if args.format != "chrome":
+            raise SystemExit(f"unknown export format {args.format!r} "
+                             "(supported: chrome)")
+        from ..utils.export import export_chrome, validate_chrome
+        doc = export_chrome(args.data_dir)
+        problems = validate_chrome(doc)
+        if problems:
+            for p in problems:
+                print(f"export invariant violated: {p}", file=sys.stderr)
+            return 1
+        payload = json.dumps(doc)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload)
+            n = len(doc["traceEvents"])
+            print(f"wrote {n} events -> {args.out} "
+                  "(open in ui.perfetto.dev)")
+        else:
+            print(payload)
+        return 0
+    if args.action is not None:
+        raise SystemExit(f"unknown trace action {args.action!r} "
+                         "(supported: export)")
     path = os.path.join(args.data_dir, TRACE_FILE)
     if not os.path.exists(path):
         print("no barrier trace (directory has no barrier_trace.jsonl)")
@@ -198,9 +235,32 @@ def cmd_trace(args) -> int:
 def cmd_profile(args) -> int:
     """Offline epoch-profile summary (the fused-path flame-graph-lite):
     reads epoch_profile.jsonl without opening the Database — same
-    wedged-process contract as `trace`."""
-    from ..utils.profile import PROFILE_FILE, summarize_file
+    wedged-process contract as `trace`. `--follow` instead TAILS the
+    file live (rotation-aware): one line per epoch/compile record as the
+    running process flushes them — `tail -f` that understands the
+    format and survives `rotate_tail`."""
+    from ..utils.profile import (PROFILE_FILE, format_record, summarize_file,
+                                 tail_jsonl)
     path = os.path.join(args.data_dir, PROFILE_FILE)
+    if args.follow:
+        # a missing FILE is fine (the job may not have flushed yet; the
+        # tail waits for it) — but a missing DIRECTORY is a typo that
+        # would otherwise hang silently forever
+        if not os.path.isdir(args.data_dir):
+            print(f"{args.data_dir}: not a directory", file=sys.stderr)
+            return 1
+        if not os.path.exists(path):
+            print(f"waiting for {path} ...", file=sys.stderr)
+        try:
+            for rec in tail_jsonl(path):
+                if args.job is not None and rec.get("job") != args.job:
+                    continue
+                line = format_record(rec)
+                if line:
+                    print(line, flush=True)
+        except KeyboardInterrupt:
+            pass
+        return 0
     if not os.path.exists(path):
         print("no epoch profile (directory has no epoch_profile.jsonl — "
               "fused jobs write it when DeviceConfig.profile is on)")
@@ -377,16 +437,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--limit", type=int, default=None)
     sp.set_defaults(fn=cmd_dump)
     sp = sub.add_parser("trace")
+    sp.add_argument("action", nargs="?", default=None,
+                    help="'export' merges barrier trace + epoch profile "
+                         "+ clock samples into Chrome/Perfetto "
+                         "trace-event JSON")
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--last", type=int, default=5)
     sp.add_argument("--stuck-only", action="store_true",
                     help="print only OPEN (uncommitted) epochs")
+    sp.add_argument("--format", default="chrome",
+                    help="export format (chrome)")
+    sp.add_argument("-o", "--out", default=None,
+                    help="export output file (default: stdout)")
     sp.set_defaults(fn=cmd_trace)
     sp = sub.add_parser("profile")
     sp.add_argument("job", nargs="?", default=None)
     sp.add_argument("--data-dir", required=True)
     sp.add_argument("--top", type=int, default=10,
                     help="slowest epochs to list per job")
+    sp.add_argument("--follow", action="store_true",
+                    help="tail epoch_profile.jsonl live "
+                         "(rotation-aware) instead of summarizing")
     sp.set_defaults(fn=cmd_profile)
     sp = sub.add_parser("compile-status")
     sp.add_argument("job", nargs="?", default=None)

@@ -471,6 +471,21 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                       f.capacity))
             pull = MVPull("pair", mv_idx, m.dtypes, m.decoders)
         ee = f.epoch_events or 8192 * 64
+        import os as _os
+        skew_on = getattr(device_cfg, "skew_stats", True)
+        env = _os.environ.get("RW_SKEW_STATS")
+        if env is not None:
+            # operational kill switch / force-on without code changes
+            # (tier-1 pins it off for compile budget; the dedicated skew
+            # tests force it on)
+            skew_on = env.strip().lower() not in ("0", "false", "off")
+        if skew_on:
+            # arm key-skew telemetry on every keyed node BEFORE the
+            # exchange is armed (the host-spliced "exch" stat must stay
+            # last in the layout) and before the plan hash is taken
+            # (skew extends the traced step — see AggNode._sig)
+            for node in f.nodes:
+                node.enable_skew()
         mesh = _fused_mesh(device_cfg, ee)
         if mesh is not None:
             # arm the declarative exchange stages: every node whose

@@ -261,9 +261,19 @@ class Node:
     # n_shards destination buckets; the planner sets the exact per-row
     # width when it arms the exchange (enable_exchange caller).
     exch_bytes: int = 256
+    # key-skew telemetry (device/skew_stats.py): keyed nodes compute a
+    # vnode-occupancy histogram + per-epoch top-K heavy hitters inside
+    # their traced step when armed (enable_skew). False everywhere else.
+    skew: bool = False
 
     def init_state(self):
         return None
+
+    def enable_skew(self) -> None:
+        """Arm skew telemetry for this node (planner-called, once,
+        BEFORE the program is built: the skew scalars extend both the
+        stat layout and the traced step, so arming is part of the
+        node's structural signature). No-op for un-keyed nodes."""
 
     # ---- mesh sharding (declarative; device/shard_exec.py executes) ----
     def shard_spec(self) -> ShardSpec:
@@ -432,36 +442,46 @@ class SourceNode(Node):
 class MapNode(Node):
     """Project: device-evaluable expressions over the input delta."""
 
+    stat_names = ("rows_in", "rows_out")
+    stat_sums = ("rows_in", "rows_out")
+
     def __init__(self, input: int, exprs: Sequence[Any]):
         self.inputs = (input,)
         self.exprs = list(exprs)
 
     def _sig(self):
-        return tuple(_expr_sig(e) for e in self.exprs)
+        # "rio" versions the signature: the rows_in/rows_out stat
+        # outputs extended the traced step, and a persisted compile
+        # manifest keyed by the OLD digest must miss (not falsely
+        # report the new trace as cached)
+        return tuple(_expr_sig(e) for e in self.exprs) + ("rio",)
 
     def apply(self, state, ins, extra, epoch_events):
         d = ins[0]
         cols = [e.eval_device(d.cols)[0] for e in self.exprs]
         out = Delta(cols, d.sign, d.mask, pk=d.pk, pk2=d.pk2)
-        return state, out, [], None
+        n = _nrows(d.mask)
+        return state, out, [n, n], None
 
 
 class FilterNode(Node):
-    stat_names = ("rows_out",)
-    stat_sums = ("rows_out",)
+    # rows_in alongside rows_out: EXPLAIN ANALYZE derives per-node
+    # selectivity/amplification without walking the producer
+    stat_names = ("rows_in", "rows_out")
+    stat_sums = ("rows_in", "rows_out")
 
     def __init__(self, input: int, pred: Any):
         self.inputs = (input,)
         self.pred = pred
 
     def _sig(self):
-        return (_expr_sig(self.pred),)
+        return (_expr_sig(self.pred), "rio")   # see MapNode._sig
 
     def apply(self, state, ins, extra, epoch_events):
         d = ins[0]
         ok, valid = self.pred.eval_device(d.cols)
         out = Delta(d.cols, d.sign, d.mask & ok & valid, pk=d.pk, pk2=d.pk2)
-        return state, out, [_nrows(out.mask)], None
+        return state, out, [_nrows(d.mask), _nrows(out.mask)], None
 
 
 class HopNode(Node):
@@ -469,8 +489,8 @@ class HopNode(Node):
     (`HopWindowExecutor` / TUMBLE when hop == size). Row identity extends
     with the window ordinal so each copy stays unique."""
 
-    stat_names = ("rows_out",)
-    stat_sums = ("rows_out",)
+    stat_names = ("rows_in", "rows_out")
+    stat_sums = ("rows_in", "rows_out")
 
     def __init__(self, input: int, time_col: int, hop_usecs: int,
                  size_usecs: int):
@@ -482,7 +502,7 @@ class HopNode(Node):
         self.n = size_usecs // hop_usecs
 
     def _sig(self):
-        return (self.time_col, self.hop, self.size)
+        return (self.time_col, self.hop, self.size, "rio")  # see MapNode
 
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
@@ -496,7 +516,7 @@ class HopNode(Node):
         cols = [rep(c) for c in d.cols] + [starts, starts + self.size]
         pk = rep(d.pk) * n + k if d.pk is not None else None
         out = Delta(cols, rep(d.sign), rep(d.mask), pk=pk)
-        return state, out, [_nrows(out.mask)], None
+        return state, out, [_nrows(d.mask), _nrows(out.mask)], None
 
 
 class ChainNode(Node):
@@ -507,14 +527,16 @@ class ChainNode(Node):
     materialized to HBM (the datagen of q4's 5 unused bid columns folds
     away entirely)."""
 
-    stat_names = ("rows_out",)
-    stat_sums = ("rows_out",)
-
     def __init__(self, chain: List[Node], inputs: Tuple[int, ...]):
         self.chain = list(chain)
         self.inputs = tuple(inputs)
         self.takes_event_lo = bool(getattr(chain[0], "takes_event_lo",
                                            False))
+        # source-rooted chains have no input delta to count; consuming
+        # chains report rows_in so amplification is derivable per node
+        self.stat_names = ("rows_in", "rows_out") if inputs \
+            else ("rows_out",)
+        self.stat_sums = self.stat_names
 
     def _sig(self):
         return tuple((type(n).__name__,) + n._sig() for n in self.chain)
@@ -525,7 +547,10 @@ class ChainNode(Node):
             node_ins = ins if i == 0 else [out]
             _, out, _, _ = n.apply(None, node_ins,
                                    extra if i == 0 else None, epoch_events)
-        return None, out, [_nrows(out.mask)], None
+        stats = [_nrows(out.mask)]
+        if self.inputs:
+            stats = [_nrows(ins[0].mask)] + stats
+        return None, out, stats, None
 
 
 _CHAINABLE = ()          # filled below once all node classes exist
@@ -606,6 +631,12 @@ class AggNode(Node):
                                 + [f"ms{i}" for i in range(len(spec.minputs))]
                                 + ["packbad", "rows_in", "rows_out"])
         self.stat_sums = ("rows_in", "rows_out")
+
+    def enable_skew(self):
+        from .skew_stats import SKEW_STAT_NAMES
+        if not self.skew:
+            self.skew = True
+            self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
 
     def shard_spec(self):
         # state partitions by the vnode of the packed group key; the one
@@ -710,10 +741,16 @@ class AggNode(Node):
         return outs, nulls
 
     def _sig(self):
-        return (tuple(self.group_idx),
-                tuple((c.kind, c.arg.index if c.arg is not None else None)
-                      for c in self.calls),
-                self.pack, self.pk_pack, self.spec, self.emit_out)
+        sig = (tuple(self.group_idx),
+               tuple((c.kind, c.arg.index if c.arg is not None else None)
+                     for c in self.calls),
+               self.pack, self.pk_pack, self.spec, self.emit_out)
+        # skew telemetry extends the traced step (and the stats layout):
+        # an armed node must never share an executable with an un-armed
+        # twin. Appended conditionally so un-armed signatures — and the
+        # plan hashes / manifests built from them — stay byte-identical
+        # to previous releases.
+        return sig + ("skew",) if self.skew else sig
 
     def _mut_sig(self):
         # grow mutates both; capacity shapes `bound`, exch the exchange.
@@ -743,6 +780,16 @@ class AggNode(Node):
         needed, ms_needed = _needed
         rows_in = _nrows(d.mask & (d.sign != 0))
         stats_tail = [m.astype(jnp.int64) for m in ms_needed]
+        sk: List[Any] = []
+        if self.skew:
+            # vnode-occupancy of the LIVE group table + this epoch's
+            # top-K hot group keys, riding the stats vector (max across
+            # epochs; pmax across shards — exact, vnode blocks are
+            # disjoint). See device/skew_stats.py.
+            from .skew_stats import epoch_topk, vnode_occupancy
+            from .sorted_state import EMPTY_KEY
+            sk = vnode_occupancy(new_state.main.keys, EMPTY_KEY) \
+                + epoch_topk(keys, d.mask & (d.sign != 0), EMPTY_KEY)
         if not self.emit_out:
             # terminal agg: only the MV apply reads the change set — keep
             # just what it needs; the delta stream is never materialized
@@ -758,7 +805,7 @@ class AggNode(Node):
             rows_out = _nrows(ch["old_found"] | ch["new_found"])
             stats = [needed.astype(jnp.int64),
                      ch["count"].astype(jnp.int64)] + stats_tail \
-                + [packbad, rows_in, rows_out]
+                + [packbad, rows_in, rows_out] + sk
             return new_state, None, stats, aux
         # ---- change stream: old rows (-1) then new rows (+1) ------------
         old_found, new_found = ch["old_found"], ch["new_found"]
@@ -798,7 +845,7 @@ class AggNode(Node):
         out = Delta(cols, sign, mask, pk=pk)
         stats = [needed.astype(jnp.int64),
                  ch["count"].astype(jnp.int64)] + stats_tail \
-            + [packbad, rows_in, _nrows(mask)]
+            + [packbad, rows_in, _nrows(mask)] + sk
         return new_state, out, stats, ch
 
 
@@ -823,6 +870,12 @@ class JoinNode(Node):
         self.stat_names = ("need_a", "need_b", "need_pairs", "packbad",
                            "rows_in", "rows_out")
         self.stat_sums = ("rows_in", "rows_out")
+
+    def enable_skew(self):
+        from .skew_stats import SKEW_STAT_NAMES
+        if not self.skew:
+            self.skew = True
+            self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
 
     def shard_spec(self):
         # both build sides partition by the vnode of the packed join key;
@@ -898,10 +951,12 @@ class JoinNode(Node):
         return (a, b)
 
     def _sig(self):
-        return (tuple(self.l_keys), tuple(self.r_keys), self.pack,
-                _expr_sig(self.cond) if self.cond is not None else None,
-                tuple(str(d) for d in self.l_val_dtypes),
-                tuple(str(d) for d in self.r_val_dtypes))
+        sig = (tuple(self.l_keys), tuple(self.r_keys), self.pack,
+               _expr_sig(self.cond) if self.cond is not None else None,
+               tuple(str(d) for d in self.l_val_dtypes),
+               tuple(str(d) for d in self.r_val_dtypes))
+        # see AggNode._sig: armed skew telemetry changes the trace
+        return sig + ("skew",) if self.skew else sig
 
     def _mut_sig(self):
         # grow mutates the pair capacity and the exchange bucket capacity
@@ -943,6 +998,19 @@ class JoinNode(Node):
                  needed["b"].astype(jnp.int64),
                  needed["pairs"].astype(jnp.int64), packbad,
                  rows_in, _nrows(omask)]
+        if self.skew:
+            # occupancy over BOTH build sides (same key space, summed
+            # per bucket) + this epoch's hot join keys across both input
+            # deltas — the JSPIM hot-build-key replication evidence
+            from .skew_stats import epoch_topk, vnode_occupancy
+            from .sorted_state import EMPTY_KEY
+            occ_a = vnode_occupancy(new_a.jk, EMPTY_KEY)
+            occ_b = vnode_occupancy(new_b.jk, EMPTY_KEY)
+            cat_keys = jnp.concatenate([ajk, bjk])
+            cat_live = jnp.concatenate([amk & (asg != 0),
+                                        bmk & (bsg != 0)])
+            stats += [a + b for a, b in zip(occ_a, occ_b)] \
+                + epoch_topk(cat_keys, cat_live, EMPTY_KEY)
         return (new_a, new_b), out, stats, None
 
 
@@ -1406,6 +1474,17 @@ class FusedJob:
         self._js_written: Dict[int, int] = {}
         self.counter = 0
         self.committed = 0
+        # wall-clock anchor for live eps columns (EXPLAIN ANALYZE)
+        import time as _time
+        self.t_created = _time.monotonic()
+        # source->MV freshness (utils/freshness.py): the Database
+        # attaches its tracker; each checkpoint then records
+        # commit_wall - dispatch_wall of the OLDEST epoch in the window.
+        # For a fused job ingest IS the dispatch — events are generated
+        # on device during the epoch, so the dispatch stamp is the
+        # moment the epoch's data came into existence.
+        self.freshness = None
+        self._window_ingest: Optional[float] = None
         self.states = program.init_states()
         self.snapshot = (self.states, 0)
         self._zero_stats = jnp.zeros((max(1, len(program.stat_layout)),),
@@ -1444,6 +1523,10 @@ class FusedJob:
         if prof is not None:
             prof.begin_epoch(self.counter, self.program.epoch_events)
         if not self.drained:
+            if self._window_ingest is None:
+                # first dispatch since the last checkpoint: freshness of
+                # the NEXT commit is measured against this moment
+                self._window_ingest = _time.time()
             t0 = _time.perf_counter() if prof is not None else 0.0
             lo = jnp.int64(self.counter)
             if prof is not None:
@@ -1659,6 +1742,14 @@ class FusedJob:
         if prof is not None:
             self._export_hbm_gauges()
             prof.phase("commit", _time.perf_counter() - t0)
+        if self.freshness is not None and self._window_ingest is not None:
+            # end-to-end staleness of this commit: the oldest epoch in
+            # the checkpoint window was dispatched (= its events came
+            # into existence) at _window_ingest; everything up to the
+            # verified sync + state-table commit is inside the measure
+            self.freshness.commit(self.name, epoch, self._window_ingest,
+                                  _time.time())
+        self._window_ingest = None
         self.snapshot = (self.states, self.counter)
         self.stats_acc = self._zero_stats
         self.committed = self.counter
@@ -1909,6 +2000,49 @@ class FusedJob:
                             cap * bpe.get(s, 0) / float(1 << 20),
                             entries > cap))
         return out
+
+    def skew_report(self) -> List[Tuple]:
+        """rw_key_skew rows for this job's skew-armed keyed nodes:
+        (node, type, metric, ordinal, key, value, share) —
+        metric='vnode_occ': ordinal = bucket index, value = live keys
+        whose vnode falls in the bucket (high-water), share = the
+        bucket's fraction of the live total; metric='hot_key': ordinal =
+        rank, key = the 40-bit-truncated hot key, value = its per-epoch
+        row count (the hottest (key, epoch) observed — see
+        device/skew_stats.py for the exact semantics). All read from the
+        stats the regular syncs already pulled — zero extra device
+        traffic."""
+        from .skew_stats import (SK_BUCKETS, SK_TOPK, skew_ratio,
+                                 unpack_hot)
+        out: List[Tuple] = []
+        totals = self._stat_totals
+        for i, node in enumerate(self.program.nodes):
+            if not node.skew:
+                continue
+            st = self.program.node_stats(i, totals)
+            tname = type(node).__name__
+            occ = [st.get(f"skv{b}", 0) for b in range(SK_BUCKETS)]
+            total = sum(occ)
+            for b, c in enumerate(occ):
+                out.append((i, tname, "vnode_occ", b, None, c,
+                            c / total if total else 0.0))
+            out.append((i, tname, "skew_ratio", 0, None,
+                        int(sum(occ)), skew_ratio(occ)))
+            for r in range(SK_TOPK):
+                key, count = unpack_hot(st.get(f"skh{r}", 0))
+                if count > 0:
+                    out.append((i, tname, "hot_key", r, key, count, None))
+        return out
+
+    def node_skew_ratio(self, i: int) -> Optional[float]:
+        """Occupancy skew ratio (max/mean bucket) of node i, or None
+        when the node carries no skew telemetry."""
+        from .skew_stats import SK_BUCKETS, skew_ratio
+        node = self.program.nodes[i]
+        if not node.skew:
+            return None
+        st = self.program.node_stats(i, self._stat_totals)
+        return skew_ratio([st.get(f"skv{b}", 0) for b in range(SK_BUCKETS)])
 
     # ---- capacity introspection -----------------------------------------
     def cap_report(self) -> Dict[str, Any]:

@@ -1,0 +1,115 @@
+"""Device-side key-skew telemetry riding the fused stats vector.
+
+The ROADMAP's skew-proof-operator work ("Global Hash Tables Strike
+Back!", PanJoin, JSPIM — PAPERS.md) needs one piece of evidence before
+any adaptive partitioning can be built: WHICH keys are hot and HOW
+unevenly the key space loads, measured on the running job, not guessed.
+This module computes that evidence inside the traced epoch programs so
+it costs no extra device sync — the numbers ride the existing
+`stats_acc` vector like every other per-node stat.
+
+Two signals per keyed node (AggNode / JoinNode, armed by
+`Node.enable_skew`):
+
+* **vnode-occupancy histogram** — the node's LIVE key table bucketed by
+  `vnode(key) * SK_BUCKETS // VNODE_COUNT` (the same CRC32 vnode map the
+  mesh exchange routes by, so a hot bucket here IS a hot shard there).
+  Slots combine by MAX across epochs (a high-water occupancy profile)
+  and by `pmax` across mesh shards — which is exact, not approximate:
+  contiguous vnode blocks put every bucket on exactly one shard, so the
+  other shards contribute zero and max equals the owner's count.
+
+* **top-K heavy hitters** — the K most frequent keys of each epoch's
+  input delta, packed as `(count << SK_SHIFT) | (key & SK_KEY_MASK)` so
+  a single int64 MAX combine keeps count-and-key together across epochs
+  and shards. Rank slots are per-epoch top-K high-watered, i.e. hot-key
+  CANDIDATES: slot 0 is exactly the hottest (key, per-epoch count) ever
+  observed; lower ranks are candidates from possibly different epochs.
+  Keys truncated to SK_KEY_BITS bits (packed group/join keys are ≤ 62
+  bits; the truncation is surfaced as-is in `rw_key_skew.key` and is
+  enough to identify a hot auction/seller in practice).
+
+Everything is gated by `DeviceConfig.skew_stats` (default on; the cost
+is one O(capacity) bucket pass plus one O(epoch) sort per keyed node per
+epoch — measured inside the profiler-overhead acceptance bound).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..core.vnode import VNODE_COUNT
+
+# histogram buckets over the vnode space (16 buckets of 16 vnodes each
+# at the default VNODE_COUNT=256)
+SK_BUCKETS = 16
+# heavy-hitter rank slots per keyed node
+SK_TOPK = 4
+# packed layout: count in the high bits, truncated key in the low bits
+SK_KEY_BITS = 40
+SK_SHIFT = SK_KEY_BITS
+SK_KEY_MASK = (1 << SK_KEY_BITS) - 1
+# counts clamp to 22 bits so count << 40 stays clear of the int64 sign
+SK_COUNT_MAX = (1 << 22) - 1
+
+SKEW_STAT_NAMES: Tuple[str, ...] = tuple(
+    [f"skv{i}" for i in range(SK_BUCKETS)]
+    + [f"skh{i}" for i in range(SK_TOPK)])
+
+
+def vnode_occupancy(keys, empty_key) -> List:
+    """Per-bucket live-key counts of a (padded, EMPTY_KEY-filled) device
+    key table: [SK_BUCKETS] int64 scalars. One pass over capacity."""
+    import jax.numpy as jnp
+    from ..core.vnode import compute_vnodes_jnp
+    live = keys != empty_key
+    vn = compute_vnodes_jnp(keys, VNODE_COUNT)
+    bucket = (vn.astype(jnp.int64) * SK_BUCKETS) // VNODE_COUNT
+    onehot = (bucket[None, :] == jnp.arange(SK_BUCKETS,
+                                            dtype=jnp.int64)[:, None]) \
+        & live[None, :]
+    counts = jnp.sum(onehot, axis=1, dtype=jnp.int64)
+    return [counts[i] for i in range(SK_BUCKETS)]
+
+
+def epoch_topk(keys, live, empty_key) -> List:
+    """Top-K (count, key) of one epoch's input delta, packed one int64
+    per rank: sort the live keys, segment-count runs, take the K largest
+    packed values. Rows where `live` is False drop out."""
+    import jax
+    import jax.numpy as jnp
+    k = jnp.where(live, keys, empty_key)
+    sk = jnp.sort(k)
+    n = sk.shape[0]
+    boundary = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    seg = jnp.cumsum(boundary) - 1
+    counts = jnp.zeros((n,), jnp.int64).at[seg].add(
+        jnp.where(sk != empty_key, 1, 0))
+    # representative key per segment lands at the segment's first slot
+    seg_keys = jnp.full((n,), empty_key, jnp.int64).at[
+        jnp.where(boundary, seg, n - 1)].set(sk, mode="drop")
+    packed = jnp.where(
+        (counts > 0) & (seg_keys != empty_key),
+        (jnp.minimum(counts, SK_COUNT_MAX) << SK_SHIFT)
+        | (seg_keys & SK_KEY_MASK),
+        0)
+    top, _ = jax.lax.top_k(packed, min(SK_TOPK, n))
+    out = [top[i] for i in range(min(SK_TOPK, n))]
+    out += [jnp.zeros((), jnp.int64)] * (SK_TOPK - len(out))
+    return out
+
+
+def unpack_hot(packed: int) -> Tuple[int, int]:
+    """Host-side decode of one heavy-hitter slot -> (key40, count)."""
+    packed = int(packed)
+    return packed & SK_KEY_MASK, packed >> SK_SHIFT
+
+
+def skew_ratio(bucket_counts) -> float:
+    """max/mean over the non-trivial occupancy histogram — 1.0 is
+    perfectly even, higher means the key space loads unevenly (the
+    direct straggler-chip predictor under mesh sharding)."""
+    total = sum(bucket_counts)
+    if total <= 0:
+        return 0.0
+    mean = total / float(len(bucket_counts))
+    return max(bucket_counts) / mean

@@ -53,6 +53,15 @@ class Barrier:
     mutation: Optional[Mutation] = None
     # passed_actors-style tracing breadcrumb (which executors saw it)
     trace: List[str] = field(default_factory=list)
+    # source->MV freshness stamp: wall time the OLDEST event of the
+    # epoch this barrier seals came into existence. Sources fold their
+    # first-chunk poll wall in via `note_ingest` (min wins — the
+    # injector hands every source the SAME Barrier instance, so the
+    # coordinator reads the cluster-wide minimum after the tick);
+    # `open_ts` is the injector's conservative fallback (the previous
+    # barrier's injection wall — no event of this epoch can predate it).
+    ingest_ts: Optional[float] = None
+    open_ts: Optional[float] = None
 
     @property
     def is_checkpoint(self) -> bool:
@@ -61,8 +70,21 @@ class Barrier:
     def is_stop(self) -> bool:
         return self.mutation is not None and self.mutation.kind == MutationKind.STOP
 
+    def note_ingest(self, ts: float) -> None:
+        self.ingest_ts = ts if self.ingest_ts is None \
+            else min(self.ingest_ts, ts)
+
+    def best_ingest_ts(self) -> Optional[float]:
+        """The freshness anchor: a source-stamped first-chunk wall when
+        any source stamped one, else the epoch-open fallback."""
+        return self.ingest_ts if self.ingest_ts is not None else self.open_ts
+
     def with_trace(self, name: str) -> "Barrier":
-        return Barrier(self.epoch, self.kind, self.mutation, self.trace + [name])
+        b = Barrier(self.epoch, self.kind, self.mutation,
+                    self.trace + [name])
+        b.ingest_ts = self.ingest_ts
+        b.open_ts = self.open_ts
+        return b
 
 
 @dataclass

@@ -25,7 +25,12 @@ from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Wate
 
 class SourceReader:
     """Connector-side reader protocol (`SplitReader` analog,
-    `src/connector/src/source/base.rs:474`)."""
+    `src/connector/src/source/base.rs:474`). Readers that know when
+    their data actually arrived set `last_ingest_ts` (wall clock of the
+    last successful poll) — the source->MV freshness measure anchors on
+    it; readers without it fall back to the executor's yield wall."""
+
+    last_ingest_ts: Optional[float] = None
 
     def poll(self) -> Optional[StreamChunk]:
         """Next chunk, or None if no data is currently available."""
@@ -44,12 +49,17 @@ class BarrierInjector:
 
     def __init__(self, checkpoint_frequency: int = 1,
                  start_epoch: Optional[int] = None):
+        import time as _time
         self.queues: List[Deque[Barrier]] = []
         self.checkpoint_frequency = max(1, checkpoint_frequency)
         self._tick = 0
         curr = start_epoch if start_epoch is not None else now_epoch()
         self.epoch = EpochPair.new_initial(curr)
         self._initial_sent = False
+        # freshness seam: the epoch each barrier seals opened when the
+        # PREVIOUS barrier went out — no event of the epoch can predate
+        # that, so it is the conservative ingest fallback
+        self._last_inject_ts = _time.time()
 
     def register(self) -> Deque[Barrier]:
         q: Deque[Barrier] = deque()
@@ -69,7 +79,10 @@ class BarrierInjector:
                  if self._tick % self.checkpoint_frequency == 0
                  else BarrierKind.BARRIER)
             self.epoch = self.epoch.next(now_epoch(self.epoch.curr))
+        import time as _time
         b = Barrier(self.epoch, k, mutation)
+        b.open_ts = self._last_inject_ts
+        self._last_inject_ts = _time.time()
         for q in self.queues:
             q.append(b)
         return b
@@ -120,6 +133,8 @@ class SourceExecutor(Executor):
         self.queue = injector.register()
         self.split_state_table = split_state_table
         self._recovered = False
+        # wall of the FIRST chunk of the current epoch (freshness stamp)
+        self._first_chunk_ts: Optional[float] = None
 
     def _persist_splits(self, epoch: int) -> None:
         if self.split_state_table is None:
@@ -139,8 +154,19 @@ class SourceExecutor(Executor):
         if states:
             self.reader.seek(states)
 
+    def _stamp_ingest(self) -> None:
+        """First chunk of the current epoch: remember when its data came
+        off the connector (the reader's poll wall when it reports one,
+        else now) — folded onto the sealing barrier for the source->MV
+        freshness measure."""
+        if self._first_chunk_ts is None:
+            import time as _time
+            self._first_chunk_ts = getattr(self.reader, "last_ingest_ts",
+                                           None) or _time.time()
+
     def execute(self) -> Iterator[Message]:
         paused = False
+        self._first_chunk_ts = None
         # Data available when a barrier is pending still belongs to the epoch
         # the barrier seals — drain it first (bounded, so an unbounded reader
         # cannot starve barriers; reference bounds this with channel capacity).
@@ -153,6 +179,7 @@ class SourceExecutor(Executor):
                     chunk = self.reader.poll()
                     if chunk is not None and chunk.cardinality > 0:
                         drained += 1
+                        self._stamp_ingest()
                         yield chunk
                         continue
                 drained = 0
@@ -166,6 +193,11 @@ class SourceExecutor(Executor):
                         paused = True
                     elif b.mutation.kind == MutationKind.RESUME:
                         paused = False
+                if self._first_chunk_ts is not None:
+                    # mutate the injector's SHARED instance (the yielded
+                    # copy never reaches the coordinator's tick loop)
+                    b.note_ingest(self._first_chunk_ts)
+                    self._first_chunk_ts = None
                 yield b.with_trace(self.name)
                 if b.is_stop():
                     return
@@ -176,6 +208,7 @@ class SourceExecutor(Executor):
                 continue
             chunk = self.reader.poll()
             if chunk is not None and chunk.cardinality > 0:
+                self._stamp_ingest()
                 yield chunk
             else:
                 # idle: auto-tick a barrier for ALL sources so bounded inputs

@@ -321,7 +321,7 @@ class _Conn:
                         self.db._log_ddl(f"SET streaming_parallelism TO {k}")
                     self.db._log_ddl(text)
                 # statements that answer with data, not just a tag
-                if isinstance(stmt, A.Explain):
+                if isinstance(stmt, (A.Explain, A.ExplainAnalyze)):
                     self._emit_text_rows(
                         "QUERY PLAN", [(ln,) for ln in str(result).split("\n")],
                         suppress_desc)

@@ -221,6 +221,7 @@ class FragmentSupervisor:
                 or (rc is not None and rc != 0 and not ch.closed)
             wedged = (not dead and rc is None and not ch.closed
                       and factor > 0
+                      and not s._backpressured(i)
                       and time.time() - s.heartbeats[i]
                       > ROBUSTNESS.heartbeat_timeout_s * factor)
             if wedged:
@@ -487,6 +488,7 @@ class _RemoteSetBase:
     group_count = 0                    # output group-key width (hash_agg)
 
     def _finish_init(self, supervise: bool) -> None:
+        from collections import deque
         self._next_cid = 1 + max(
             (p.get("in_channel_r", p["in_channel"]) for p in self.plans),
             default=-1)
@@ -494,6 +496,13 @@ class _RemoteSetBase:
         # piggyback M frames on their result streams; the drains stamp
         # these) — the substrate of worker_liveness / rw_worker_liveness
         self.heartbeats = [time.time()] * len(self.workers)
+        # barrier-decomposition logs the Database tick drains into the
+        # BarrierTracer: per-worker result-barrier arrival (the "align"
+        # sub-span — inject->align->commit then decomposes by worker)
+        # and heartbeat (sent worker-clock, received coordinator-clock)
+        # pairs, the clock-offset samples `risectl trace export` uses
+        self.align_log: deque = deque(maxlen=4096)
+        self.hb_log: deque = deque(maxlen=1024)
         self._wedged = [False] * len(self.workers)
         self._reaping = [False] * len(self.workers)
         # per-slot last-delivered output map (supervised owned-group
@@ -547,19 +556,35 @@ class _RemoteSetBase:
             for msg in inp.execute():
                 if failpoint("fragment.drain"):
                     raise ConnectionError("failpoint fragment.drain")
+                if ch.gen == gen:
+                    # ANY frame proves the worker alive — data and
+                    # barriers stamp liveness too, so a worker streaming
+                    # results between M frames never reads as wedged
+                    self.heartbeats[i] = time.time()
                 if isinstance(msg, MetricsFrame):
                     # metrics plane piggyback: fold the worker's registry
                     # delta into the coordinator's global registry under a
                     # `worker` label, stamp the heartbeat, and DON'T
                     # forward (observability is not dataflow)
                     if ch.gen == gen:
-                        self.heartbeats[i] = time.time()
+                        # (sent worker-clock, received coordinator-clock):
+                        # the clock-offset estimation sample for the
+                        # unified trace export
+                        self.hb_log.append((f"{self.kind}{i}", msg.ts,
+                                            time.time()))
                         if msg.payload:
                             REGISTRY.merge_remote(
                                 msg.payload,
                                 worker=f"{self.kind}{i}/{msg.pid}")
                     continue
                 if isinstance(msg, Barrier):
+                    if ch.gen == gen:
+                        # per-worker align sub-span: this worker's part
+                        # of the epoch is DONE now; the tracer decomposes
+                        # cross-fragment barrier latency from these
+                        self.align_log.append((msg.epoch.curr,
+                                               f"{self.kind}{i}",
+                                               time.time()))
                     if atomic:
                         # one lock-held append, no capacity waits: a
                         # flush blocked on a full channel could never be
@@ -592,12 +617,25 @@ class _RemoteSetBase:
                 ch.close()
 
     # ---- liveness -------------------------------------------------------
+    def _backpressured(self, i: int) -> bool:
+        """Worker i's result channel holds messages the coordinator has
+        not consumed: the worker provably produced output and the
+        staleness is OURS — an idle coordinator stops draining (the
+        drain thread blocks on the full channel behind the socket, so M
+        frames stop stamping heartbeats) and must not report — or REAP —
+        a healthy worker as wedged."""
+        chans = getattr(self, "channels", None)
+        return bool(chans and chans[i].buf)
+
     def liveness_rows(self, job: str) -> List[Tuple]:
         """(job, worker, pid, last_epoch, heartbeat_age_s, state) per
         slot — the rw_worker_liveness rows. `wedged?` = process alive but
         no heartbeat frame within RW_HEARTBEAT_TIMEOUT_S: the
         stuck-not-dead failure mode the spawn/drain deadlines only catch
-        much later."""
+        much later. Ages are recomputed at READ time against the last
+        received frame (any frame, not just M), and a slot whose result
+        channel holds undrained output is `ok` regardless of age — the
+        idle-coordinator case where the stale party is the reader."""
         now = time.time()
         out = []
         for i, w in enumerate(self.workers):
@@ -606,13 +644,27 @@ class _RemoteSetBase:
                 state = "reaping"        # wedge reaper mid-kill/respawn
             elif w.proc.poll() is not None:
                 state = "dead"
-            elif age > ROBUSTNESS.heartbeat_timeout_s:
+            elif age > ROBUSTNESS.heartbeat_timeout_s \
+                    and not self._backpressured(i):
                 state = "wedged?"
             else:
                 state = "ok"
             out.append((job, f"{self.kind}{i}", w.proc.pid,
                         -1 if w.last_epoch is None else w.last_epoch,
                         age, state))
+        return out
+
+    # ---- barrier decomposition (drained into the BarrierTracer) --------
+    def drain_align_log(self) -> List[Tuple[int, str, float]]:
+        out = []
+        while self.align_log:
+            out.append(self.align_log.popleft())
+        return out
+
+    def drain_hb_log(self) -> List[Tuple[str, float, float]]:
+        out = []
+        while self.hb_log:
+            out.append(self.hb_log.popleft())
         return out
 
     def _check_wedged(self) -> None:

@@ -390,6 +390,14 @@ class Explain:
 
 
 @dataclass
+class ExplainAnalyze:
+    """EXPLAIN ANALYZE <mv>: the live per-operator tree of a RUNNING
+    streaming job (eps, amplification, occupancy, phase shares, skew) —
+    unlike EXPLAIN, which renders the plan a statement WOULD run."""
+    target: str
+
+
+@dataclass
 class AlterParallelism:
     """ALTER MATERIALIZED VIEW <name> SET PARALLELISM <n>."""
     name: str

@@ -155,6 +155,15 @@ class Database:
         # dir for offline hang diagnosis (risectl trace)
         from ..utils.trace import BarrierTracer
         self.tracer = BarrierTracer(data_dir)
+        # source->MV freshness (utils/freshness.py): every MV commit
+        # records ingest->commit wall; surfaced as rw_mv_freshness + the
+        # mv_freshness_seconds histogram
+        from ..utils.freshness import FreshnessTracker
+        self._freshness = FreshnessTracker()
+        # oldest ingest stamp of the barriers in the CURRENT checkpoint
+        # window (host MVs commit whole windows at once; freshness must
+        # anchor on the window's oldest event, not the sealing barrier's)
+        self._window_ingest: Optional[float] = None
         # fused jobs mirror epoch-profile records here (risectl profile)
         self._data_dir = data_dir
         self.injector = BarrierInjector(checkpoint_frequency)
@@ -340,6 +349,8 @@ class Database:
             return self.catalog.list(kind)
         if isinstance(stmt, A.Explain):
             return self._explain(stmt.stmt)
+        if isinstance(stmt, A.ExplainAnalyze):
+            return self._explain_analyze(stmt.target)
         if isinstance(stmt, A.AlterParallelism):
             return self._alter_parallelism(stmt)
         if isinstance(stmt, A.SetVar):
@@ -614,6 +625,7 @@ class Database:
                 self.catalog.create(obj)
                 self._fused[stmt.name] = job
                 job.profiler.attach(self._data_dir)
+                job.freshness = self._freshness
                 if job.compile_service is not None and self._data_dir:
                     # mirror the compile manifest into the data dir so
                     # `risectl compile-status --offline` reads it from a
@@ -669,6 +681,27 @@ class Database:
         if rules:
             out += "\n-- rewrites: " + ", ".join(rules)
         return out
+
+    def _explain_analyze(self, name: str) -> str:
+        """EXPLAIN ANALYZE <mv>: live per-operator tree of a RUNNING
+        streaming job — eps in/out, row amplification, occupancy vs
+        capacity, HBM, per-phase time share, skew ratios (fused), or
+        worker liveness + exchange backpressure (host/process
+        placement). Numbers come from the same checkpoint-fresh
+        surfaces as the rw_* system tables; rendering performs no
+        device sync and no statement re-execution."""
+        from .system_catalog import (explain_analyze_fused,
+                                     explain_analyze_host)
+        obj = self.catalog.get(name)
+        if obj.kind not in ("mv", "sink", "index", "table"):
+            raise ValueError(
+                f"EXPLAIN ANALYZE needs a running streaming job; "
+                f"{name!r} is a {obj.kind}")
+        job = (obj.runtime or {}).get("fused_job") \
+            if isinstance(obj.runtime, dict) else None
+        if job is not None:
+            return explain_analyze_fused(name, job)
+        return explain_analyze_host(name, obj)
 
     def _peek_subscribe(self):
         """Schema-only subscribe: plans without taking subscriptions or
@@ -892,6 +925,7 @@ class Database:
                 return "DROP_SKIPPED"
             raise
         self._iters.pop(stmt.name, None)
+        self._freshness.forget(stmt.name)
         dropped_job = self._fused.pop(stmt.name, None)
         if dropped_job is not None:
             # remember where its capacities topped out, keyed by plan
@@ -1059,6 +1093,12 @@ class Database:
                 if isinstance(msg, Barrier) and msg.epoch.curr == b.epoch.curr:
                     break
             span.job_end(name)
+        # fold this barrier's ingest stamp (sources noted it while the
+        # jobs drove) into the checkpoint window's oldest
+        b_ing = b.best_ingest_ts()
+        if b_ing is not None:
+            self._window_ingest = b_ing if self._window_ingest is None \
+                else min(self._window_ingest, b_ing)
         if b.is_checkpoint:
             self.store.commit_epoch(b.epoch.curr)
             self.epoch_committed = b.epoch.curr
@@ -1069,6 +1109,33 @@ class Database:
                     if isinstance(obj.runtime, dict) else None
                 if se is not None:
                     se.deliver_durable()
+            # source->MV freshness: this commit durably reflects every
+            # barrier since the LAST checkpoint; anchor = the oldest
+            # source-stamped chunk wall across the whole window (the
+            # per-barrier stamps folded below — with checkpoint_frequency
+            # > 1 the sealing barrier's own stamp would under-report
+            # staleness by up to a window). Fused jobs record their own
+            # commits (their ingest is the device dispatch, not a host
+            # chunk).
+            ingest = self._window_ingest
+            self._window_ingest = None
+            if ingest is not None:
+                commit_wall = _time.time()
+                for obj in self.catalog.objects.values():
+                    rt = obj.runtime if isinstance(obj.runtime, dict) \
+                        else None
+                    if obj.kind == "mv" and rt \
+                            and rt.get("fused_job") is None:
+                        self._freshness.commit(obj.name, b.epoch.curr,
+                                               ingest, commit_wall)
+        # per-worker barrier decomposition + clock-offset samples from
+        # the remote result drains, folded into the tracer before the
+        # commit event so the jsonl stays ordered within the epoch
+        for _name, r in self._remote_sets():
+            for epoch, worker, ts in r.drain_align_log():
+                self.tracer.worker_align(epoch, worker, ts)
+            for worker, sent, recv in r.drain_hb_log():
+                self.tracer.hb_sample(worker, sent, recv)
         span.commit()   # barrier fully collected (checkpoint or not)
         # barrier latency + epoch progress (streaming_stats.rs analog)
         REGISTRY.histogram("barrier_latency_seconds",

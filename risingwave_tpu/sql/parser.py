@@ -190,6 +190,13 @@ class Parser:
                 return self._parse_set(system=False)
             if t.value == "explain":
                 self.next()
+                nxt = self.peek()
+                if nxt.kind == "id" and nxt.value == "analyze":
+                    # EXPLAIN ANALYZE <mv>: live per-operator stats of a
+                    # RUNNING streaming job (no statement re-execution —
+                    # the batch path has no runtime worth instrumenting)
+                    self.next()
+                    return A.ExplainAnalyze(self.ident())
                 return A.Explain(self.parse_statement())
             if t.value == "alter":
                 return self.parse_alter()

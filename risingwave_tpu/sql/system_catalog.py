@@ -103,19 +103,49 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("occupancy", T.FLOAT64), ("hbm_mb", T.FLOAT64),
                   ("overflow", T.BOOLEAN)),
         lambda db: _fused_node_stats(db)),
-    # metrics-plane worker heartbeats: age of the last M frame per
-    # remote worker; `wedged?` = alive process, stale heartbeat
+    # metrics-plane worker heartbeats: age of the last frame per remote
+    # worker (ANY frame counts — data proves liveness as well as M
+    # frames); `wedged?` = alive process, stale heartbeat, and no
+    # undrained output waiting on the coordinator. Ages recompute at
+    # SELECT time.
     "rw_worker_liveness": (
         Schema.of(("job", T.VARCHAR), ("worker", T.VARCHAR),
                   ("pid", T.INT64), ("last_epoch", T.INT64),
                   ("heartbeat_age_s", T.FLOAT64), ("state", T.VARCHAR)),
         lambda db: db._worker_liveness_rows()),
+    # source->MV end-to-end freshness (utils/freshness.py): last commit's
+    # ingest->commit wall, the SELECT-time staleness (now - last
+    # committed ingest), and ring quantiles
+    "rw_mv_freshness": (
+        Schema.of(("mv", T.VARCHAR), ("epoch", T.INT64),
+                  ("ingest_ts", T.FLOAT64), ("commit_ts", T.FLOAT64),
+                  ("freshness_s", T.FLOAT64), ("staleness_s", T.FLOAT64),
+                  ("p50_s", T.FLOAT64), ("p99_s", T.FLOAT64),
+                  ("commits", T.INT64)),
+        lambda db: db._freshness.rows()),
+    # key-skew telemetry (device/skew_stats.py): per keyed fused node,
+    # the vnode-occupancy histogram (metric='vnode_occ', one row per
+    # bucket, share = fraction of live keys), its max/mean ratio
+    # (metric='skew_ratio', share carries the ratio, value the live
+    # total) and the top-K heavy-hitter candidates (metric='hot_key',
+    # key = 40-bit-truncated hot key, value = its per-epoch row count)
+    "rw_key_skew": (
+        Schema.of(("job", T.VARCHAR), ("node", T.INT64),
+                  ("type", T.VARCHAR), ("metric", T.VARCHAR),
+                  ("ordinal", T.INT64), ("key", T.INT64),
+                  ("value", T.INT64), ("share", T.FLOAT64)),
+        lambda db: _key_skew(db)),
 }
 
 
 def _epoch_profile(db) -> List[Tuple]:
     return [row for job in db._fused.values()
             for row in job.profiler.rows()]
+
+
+def _key_skew(db) -> List[Tuple]:
+    return [(name,) + row for name, job in db._fused.items()
+            for row in job.skew_report()]
 
 
 def _fused_node_stats(db) -> List[Tuple]:
@@ -165,14 +195,165 @@ def _label(e) -> str:
     return name + (" { " + ", ".join(bits) + " }" if bits else "")
 
 
-def render_plan(e, depth: int = 0) -> str:
-    lines = ["  " * depth + ("-> " if depth else "") + _label(e)]
+def _plan_children(e) -> List[Any]:
+    """Child executors of one node — the ONE place that knows the child
+    attribute names, shared by EXPLAIN and EXPLAIN ANALYZE so the two
+    surfaces can never show different trees."""
     children = []
     for attr in ("input", "left_exec", "right_exec", "port"):
         c = getattr(e, attr, None)
         if c is not None:
             children.append(c)
     children.extend(getattr(e, "inputs", ()))
-    for c in children:
+    return children
+
+
+def render_plan(e, depth: int = 0) -> str:
+    lines = ["  " * depth + ("-> " if depth else "") + _label(e)]
+    for c in _plan_children(e):
         lines.append(render_plan(c, depth + 1))
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# streaming EXPLAIN ANALYZE: the live per-operator tree of a RUNNING job
+# ---------------------------------------------------------------------------
+
+
+def explain_analyze_fused(name: str, job) -> str:
+    """Per-operator tree of a running fused device job.
+
+    Every number comes from surfaces the job already maintains — the
+    stats vector totals behind `rw_fused_node_stats` (rows/occupancy
+    agree with that table by construction: both read `node_report`),
+    the epoch profiler's phase totals, per-node compile events, and the
+    skew telemetry — so rendering costs zero device traffic and the
+    numbers are checkpoint-fresh (the same contract as the system
+    tables)."""
+    import time
+    prog = job.program
+    prof = job.profiler
+    elapsed = max(1e-9, time.monotonic() - job.t_created)
+    ph = dict(prof.totals)
+    busy = sum(ph.values())
+    head = [
+        f"Streaming EXPLAIN ANALYZE: {name} "
+        f"(fused, shards={job.mesh_shards}, "
+        f"events={job.committed}/{job.max_events or '?'}, "
+        f"epochs={prof.epochs}, elapsed={elapsed:.1f}s, "
+        f"eps={job.committed / elapsed:.0f})",
+        "phase share: " + " | ".join(
+            f"{k} {v / elapsed * 100:.1f}%" for k, v in ph.items())
+        + f" | idle {max(0.0, elapsed - busy) / elapsed * 100:.1f}%",
+    ]
+    # per-(node, slot) attribution grouped by node — THE rows behind
+    # rw_fused_node_stats, so eps/occupancy columns agree with it
+    by_node: Dict[int, List[Tuple]] = {}
+    for row in job.node_report():
+        by_node.setdefault(row[0], []).append(row)
+    # per-node compile wall from the profiler's labeled events
+    compile_s: Dict[int, float] = {}
+    with prof._ev_lock:
+        infos = list(prof.compile_info)
+    for rec in infos:
+        try:
+            idx = int(rec["label"].split(":", 1)[0])
+        except (ValueError, KeyError):
+            continue
+        compile_s[idx] = compile_s.get(idx, 0.0) + rec.get("s", 0.0)
+    consumed = {j for n in prog.nodes for j in n.inputs}
+    roots = [i for i in range(len(prog.nodes)) if i not in consumed]
+    lines: List[str] = []
+
+    def node_line(i: int) -> str:
+        node = prog.nodes[i]
+        tname = type(node).__name__
+        label = f"{i}:{tname}"
+        if tname == "ChainNode":
+            label += "[" + ">".join(type(m).__name__.replace("Node", "")
+                                    for m in node.chain) + "]"
+        slots = by_node.get(i, [])
+        rows_in = slots[0][3] if slots else 0
+        rows_out = slots[0][4] if slots else 0
+        bits = [f"rows_in={rows_in}", f"rows_out={rows_out}",
+                f"eps_in={rows_in / elapsed:.0f}",
+                f"eps_out={rows_out / elapsed:.0f}"]
+        if rows_in:
+            bits.append(f"amp={rows_out / rows_in:.2f}")
+        for (_i, _t, slot, _ri, _ro, entries, cap, occ, hbm,
+             overflow) in slots:
+            if slot == "-":
+                continue
+            bits.append(f"{slot}={entries}/{cap}"
+                        + (f"({occ * 100:.0f}%)" if cap else "")
+                        + (" OVERFLOW" if overflow else ""))
+        hbm_total = sum(s[8] for s in slots)
+        if hbm_total:
+            bits.append(f"hbm={hbm_total:.1f}MB")
+        ratio = job.node_skew_ratio(i)
+        if ratio is not None:
+            bits.append(f"skew={ratio:.1f}x")
+        if compile_s.get(i):
+            bits.append(f"compile_s={compile_s[i]:.2f}")
+        return label + " { " + ", ".join(bits) + " }"
+
+    def render(i: int, depth: int) -> None:
+        lines.append("  " * depth + ("-> " if depth else "") + node_line(i))
+        for j in prog.nodes[i].inputs:
+            render(j, depth + 1)
+
+    for r in roots:
+        render(r, 0)
+    return "\n".join(head + lines)
+
+
+def _analyze_bits(e) -> List[str]:
+    """Live annotations for one host executor: backfill progress,
+    remote-worker liveness, and channel queue depths (the
+    busy/backpressure signal of the host path — a full result channel
+    means the consumer is the bottleneck, a full dispatch channel means
+    the worker is)."""
+    bits: List[str] = []
+    if getattr(e, "total", None) and hasattr(e, "emitted"):
+        bits.append(f"backfill={e.emitted}/{e.total}")
+    r = getattr(e, "_remote", None)
+    if r is not None:
+        for (_j, worker, pid, last_epoch, age,
+             state) in r.liveness_rows(""):
+            bits.append(f"{worker}[pid={pid} {state} epoch={last_epoch} "
+                        f"hb_age={age:.1f}s]")
+        # result-side backpressure: queued output the coordinator has
+        # not consumed, per worker channel
+        for i, ch in enumerate(getattr(r, "channels", ())):
+            q = len(getattr(ch, "buf", ()))
+            if q:
+                bits.append(f"out_queue[{i}]={q}/{ch.capacity}")
+        # dispatch-side backpressure: input waiting on a slow worker
+        for side, chans in enumerate(getattr(r, "in_channels", ())):
+            for i, nc in enumerate(chans):
+                q = nc._data_len() if hasattr(nc, "_data_len") else 0
+                if q:
+                    bits.append(f"in_queue[{side}.{i}]={q}/{nc.capacity}")
+    return bits
+
+
+def explain_analyze_host(name: str, obj) -> str:
+    """Per-operator tree of a running host/multi-process MV: the
+    planned executor tree annotated with live counters — backfill
+    progress, per-worker liveness + last result epoch (the metrics
+    plane), and exchange queue depths (backpressure)."""
+    shared = (obj.runtime or {}).get("shared")
+    if shared is None:
+        return f"{name}: no live dataflow (fused or dropped?)"
+    head = [f"Streaming EXPLAIN ANALYZE: {name} (host placement)"]
+    lines: List[str] = []
+
+    def walk(e, depth: int) -> None:
+        bits = _analyze_bits(e)
+        lines.append("  " * depth + ("-> " if depth else "") + _label(e)
+                     + (" { " + ", ".join(bits) + " }" if bits else ""))
+        for c in _plan_children(e):
+            walk(c, depth + 1)
+
+    walk(shared.upstream, 0)
+    return "\n".join(head + lines)

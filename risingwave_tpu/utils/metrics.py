@@ -254,6 +254,11 @@ class MetricsRegistry:
         # Kept apart from _metrics because their label sets carry the
         # extra `worker` label the local family doesn't have.
         self._remote: Dict[str, Dict[str, Any]] = {}
+        # label sets each WORKER ever shipped for a family: the lint
+        # flags divergence — merge_remote's first-dump-wins label names
+        # would otherwise silently misalign a straggler worker's samples
+        # (a histogram's bucket rows land under the wrong label names)
+        self._remote_label_history: Dict[str, Dict[str, set]] = {}
 
     def counter(self, name: str, help_: str = "",
                 labels: Sequence[str] = ()) -> Counter:
@@ -308,6 +313,9 @@ class MetricsRegistry:
         worker ships cumulative values."""
         with self._lock:
             for name, fam in dump.items():
+                self._remote_label_history.setdefault(
+                    name, {}).setdefault(worker, set()).add(
+                        tuple(fam.get("labels", ())))
                 store = self._remote.get(name)
                 if store is None:
                     store = self._remote[name] = {
@@ -387,6 +395,19 @@ def lint_registry(reg: MetricsRegistry) -> List[str]:
             problems.append(
                 f"metric {name}: registered with conflicting label sets "
                 f"{sorted(tuple(s) for s in sets)}")
+    # cluster plane: the same family shipped with DIFFERENT label sets
+    # by different workers (or by one worker across respawns) means
+    # merge_remote's first-dump-wins label names misalign someone's
+    # samples — a histogram's per-bucket rows would print under wrong
+    # label names. Divergence ACROSS workers and WITHIN one worker both
+    # flag.
+    for name, by_worker in sorted(reg._remote_label_history.items()):
+        all_sets = set().union(*by_worker.values())
+        if len(all_sets) > 1:
+            detail = {w: sorted(s) for w, s in sorted(by_worker.items())}
+            problems.append(
+                f"remote metric {name}: label sets diverge across "
+                f"workers {detail}")
     return problems
 
 

@@ -111,9 +111,12 @@ class JobProfiler:
             return
         self._cur = None
         wall = time.perf_counter() - cur.pop("t0")
+        # "ts" = epoch END wall clock: the unified trace export
+        # (utils/export.py) places the span at [ts - wall, ts] on the
+        # coordinator timeline
         rec = {"ev": "epoch", "job": self.job, "seq": cur["seq"],
                "events": cur["events"], "shards": self.shards,
-               "wall_ms": wall * 1e3,
+               "ts": time.time(), "wall_ms": wall * 1e3,
                "ph_ms": {k: v * 1e3 for k, v in cur["ph"].items()}}
         self.ring.append(rec)
         with self._ev_lock:
@@ -131,7 +134,7 @@ class JobProfiler:
         warmup into named, attributable compiles. Thread-safe: the
         compile service reports from its worker threads."""
         rec = {"ev": "compile", "job": self.job, "label": label,
-               "kind": kind, "s": seconds}
+               "kind": kind, "s": seconds, "ts": time.time()}
         if bucket is not None:
             rec["bucket"] = bucket
         if aot:
@@ -145,23 +148,30 @@ class JobProfiler:
 
     # ---- file sink (flushed at checkpoints) ------------------------------
     def flush(self) -> None:
+        """Write buffered records to epoch_profile.jsonl. The WHOLE
+        write+rotate runs under the event lock: flush is reachable from
+        more than one coordinator thread (the epoch loop at checkpoints,
+        a supervisor respawn draining a job mid-recovery), and two
+        interleaved writers could tear lines or rotate the file out from
+        under each other's handle — `--follow` readers and the offline
+        summarizer both assume whole lines."""
         with self._ev_lock:
             buf, self._buf = self._buf, []
-        if self.path is None or not buf:
-            return                       # unattached: the ring is the record
-        try:
-            if self._f is None:
-                self._f = open(self.path, "a")
-            for rec in buf:
-                self._f.write(json.dumps(rec) + "\n")
-            self._f.flush()
-            if os.path.getsize(self.path) > _MAX_FILE_BYTES:
-                from .trace import rotate_tail
-                self._f.close()
-                rotate_tail(self.path)
-                self._f = open(self.path, "a")
-        except OSError:
-            self.path = None             # profiling must never fail the job
+            if self.path is None or not buf:
+                return                   # unattached: the ring is the record
+            try:
+                if self._f is None:
+                    self._f = open(self.path, "a")
+                for rec in buf:
+                    self._f.write(json.dumps(rec) + "\n")
+                self._f.flush()
+                if os.path.getsize(self.path) > _MAX_FILE_BYTES:
+                    from .trace import rotate_tail
+                    self._f.close()
+                    rotate_tail(self.path)
+                    self._f = open(self.path, "a")
+            except OSError:
+                self.path = None         # profiling must never fail the job
 
     # ---- surfaces --------------------------------------------------------
     def rows(self) -> List[Tuple]:
@@ -197,6 +207,110 @@ class JobProfiler:
                  "ph_ms": {k: round(v, 3) for k, v in r["ph_ms"].items()}}
                 for r in slow],
         }
+
+
+# ---------------------------------------------------------------------------
+# live tail (risectl profile --follow)
+# ---------------------------------------------------------------------------
+
+
+def tail_jsonl(path: str, poll_s: float = 0.25, stop=None,
+               from_start: bool = False):
+    """Yield records appended to a JSONL file as they land — rotation-
+    aware: `rotate_tail` replaces the file (new inode, smaller size), so
+    the tail re-opens and resumes from the replacement's start instead
+    of wedging on a stale handle or a position past EOF. The replacement
+    IS the old file's second half, which this tail already yielded — so
+    after a rotation, already-seen lines (tracked by a bounded hash ring
+    of recent yields) are skipped until the first unseen line, and only
+    genuinely new records flow. Partial lines (a writer mid-append) stay
+    buffered until their newline arrives. `stop` is an optional
+    threading.Event; the generator also exits if the file never appears
+    within one poll after `stop` is set."""
+    import io
+    from collections import deque
+    f = None
+    ino = None
+    buf = b""
+    # hashes of the most recent yielded lines: rotate_tail keeps the
+    # newest ~512 KiB (a few thousand records) — the ring must cover it
+    recent: deque = deque(maxlen=16384)
+    recent_set: set = set()
+    skipping = False       # replaying a rotation's already-seen prefix
+    try:
+        while True:
+            if f is None:
+                try:
+                    f = open(path, "rb")
+                    st = os.fstat(f.fileno())
+                    ino = st.st_ino
+                    if not from_start:
+                        f.seek(0, io.SEEK_END)
+                    elif recent:
+                        skipping = True     # rotation replay: dedupe
+                    from_start = True       # after a rotation: read all
+                    buf = b""
+                except OSError:
+                    if stop is not None and stop.wait(poll_s):
+                        return
+                    elif stop is None:
+                        time.sleep(poll_s)
+                    continue
+            chunk = f.read()
+            if chunk:
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    h = hash(line)
+                    if skipping:
+                        if h in recent_set:
+                            continue        # already yielded pre-rotation
+                        skipping = False    # first unseen: all new now
+                    if len(recent) == recent.maxlen:
+                        recent_set.discard(recent[0])
+                    recent.append(h)
+                    recent_set.add(h)
+                    try:
+                        yield json.loads(line)
+                    except ValueError:
+                        pass                # torn line from a crash: skip
+                continue
+            # no new bytes: rotated (inode changed / file shrank)?
+            try:
+                st = os.stat(path)
+                if st.st_ino != ino or st.st_size < f.tell():
+                    f.close()
+                    f = None
+                    continue
+            except OSError:
+                f.close()
+                f = None
+                continue
+            if stop is not None:
+                if stop.wait(poll_s):
+                    return
+            else:
+                time.sleep(poll_s)
+    finally:
+        if f is not None:
+            f.close()
+
+
+def format_record(rec: Dict[str, Any]) -> Optional[str]:
+    """One-line human rendering of a profile record (`--follow`)."""
+    if rec.get("ev") == "epoch":
+        ph = rec.get("ph_ms", {})
+        phs = " ".join(f"{k}={v:.1f}" for k, v in ph.items() if v)
+        return (f"[{rec.get('job')}] epoch seq={rec.get('seq')} "
+                f"events={rec.get('events')} "
+                f"wall={rec.get('wall_ms', 0):.1f}ms " + phs)
+    if rec.get("ev") == "compile":
+        tags = "".join(
+            f" {t}" for t in ("aot", "cache_hit") if rec.get(t))
+        b = f" bucket={rec['bucket']}" if "bucket" in rec else ""
+        return (f"[{rec.get('job')}] {rec.get('kind', 'compile')} "
+                f"{rec.get('label')} {rec.get('s', 0):.2f}s{b}{tags}")
+    return None
 
 
 # ---------------------------------------------------------------------------

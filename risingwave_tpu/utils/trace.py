@@ -83,15 +83,43 @@ class BarrierTracer:
                     "ts": time.time()})
         return span
 
+    # ---- cross-worker decomposition -------------------------------------
+    def worker_align(self, epoch: int, worker: str, ts: float) -> None:
+        """A remote worker's result barrier for `epoch` reached the
+        coordinator at `ts` (coordinator clock): the inject->align
+        sub-span of that worker. Attached to the matching ring span (the
+        align may belong to an EARLIER epoch than the current one —
+        buffered result epochs lag the injector) and logged for offline
+        reads + the unified trace export."""
+        for span in reversed(self.ring):
+            if span.epoch == epoch:
+                span.workers[worker] = ts
+                break
+        self._emit({"ev": "worker_align", "epoch": epoch,
+                    "worker": worker, "ts": ts})
+
+    def hb_sample(self, worker: str, sent_ts: float, recv_ts: float) -> None:
+        """One heartbeat (sent worker-clock, received coordinator-clock)
+        pair — the clock-offset estimation samples `risectl trace
+        export` aligns worker timestamps with (utils/export.py)."""
+        self._emit({"ev": "hb", "worker": worker, "sent": sent_ts,
+                    "recv": recv_ts})
+
     # ---- queries ---------------------------------------------------------
     def rows(self) -> List[Tuple]:
-        """(epoch, kind, job, phase, ms) rows for rw_barrier_trace."""
+        """(epoch, kind, job, phase, ms) rows for rw_barrier_trace.
+        Worker rows (`worker:<slot>` / "align") carry the inject->align
+        wall — the per-worker decomposition of cross-fragment barrier
+        latency."""
         out: List[Tuple] = []
         for span in self.ring:
             for job, (t0, t1) in span.jobs.items():
                 ms = (t1 - t0) * 1000 if t1 is not None else None
                 state = "done" if t1 is not None else "RUNNING"
                 out.append((span.epoch, span.kind, job, state, ms))
+            for worker, ts in span.workers.items():
+                out.append((span.epoch, span.kind, f"worker:{worker}",
+                            "align", (ts - span.inject_ts) * 1000))
             total = (span.commit_ts - span.inject_ts) * 1000 \
                 if span.commit_ts is not None else None
             state = "committed" if span.commit_ts is not None else "OPEN"
@@ -101,7 +129,7 @@ class BarrierTracer:
 
 class BarrierSpan:
     __slots__ = ("tracer", "epoch", "kind", "inject_ts", "jobs",
-                 "commit_ts")
+                 "commit_ts", "workers")
 
     def __init__(self, tracer: BarrierTracer, epoch: int, kind: str):
         self.tracer = tracer
@@ -110,6 +138,7 @@ class BarrierSpan:
         self.inject_ts = time.time()
         self.jobs: Dict[str, List[Optional[float]]] = {}
         self.commit_ts: Optional[float] = None
+        self.workers: Dict[str, float] = {}
 
     def job_start(self, name: str) -> None:
         self.jobs[name] = [time.time(), None]

@@ -10,6 +10,12 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Key-skew telemetry (device/skew_stats.py) extends every keyed node's
+# traced step; on the CPU test platform that extra XLA compile across
+# dozens of fused-path tests costs real wall the tier-1 budget doesn't
+# have. Pin it OFF suite-wide; the dedicated skew tests
+# (test_observability2.py) force it back on per test.
+os.environ.setdefault("RW_SKEW_STATS", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

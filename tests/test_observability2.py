@@ -1,0 +1,505 @@
+"""ISSUE 9 observability plane: streaming EXPLAIN ANALYZE, source->MV
+freshness tracing, unified Perfetto export, and skew telemetry.
+
+Acceptance contract under test: EXPLAIN ANALYZE on a running fused q5
+returns a per-operator tree whose eps/occupancy columns agree with
+rw_fused_node_stats; rw_mv_freshness reports end-to-end staleness
+within one epoch cadence of ground truth on a datagen source (and stays
+monotonic across a PR 8-style worker respawn); `risectl trace export`
+output is valid Chrome trace-event JSON with monotonic per-track
+timestamps; the clock-offset estimator recovers a known skew; and
+rw_key_skew carries vnode-occupancy + heavy-hitter rows consistent with
+the node-stats table."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu.config import DeviceConfig, ROBUSTNESS
+from risingwave_tpu.sql import Database
+
+N = 5_000
+CHUNK = 32
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='{c}')")
+Q4 = ("CREATE MATERIALIZED VIEW q4 AS SELECT auction, count(*) AS c,"
+      " sum(price) AS s, max(price) AS m FROM bid GROUP BY auction")
+Q5 = """CREATE MATERIALIZED VIEW q5 AS
+SELECT AuctionBids.auction, AuctionBids.num FROM (
+    SELECT bid.auction, count(*) AS num, window_start AS starttime
+    FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+    GROUP BY window_start, bid.auction
+) AS AuctionBids
+JOIN (
+    SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+    FROM (
+        SELECT count(*) AS num, window_start AS starttime_c
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY bid.auction, window_start
+    ) AS CountBids
+    GROUP BY CountBids.starttime_c
+) AS MaxBids
+ON AuctionBids.starttime = MaxBids.starttime_c
+   AND AuctionBids.num >= MaxBids.maxn"""
+
+
+def drive(db, n=N, chunk=CHUNK):
+    for _ in range(n // (64 * chunk) + 3):
+        db.tick()
+
+
+def _fused_db(mv_sql=Q4, data_dir=None, n=N, chunk=CHUNK):
+    db = Database(device=DeviceConfig(capacity=512, aot_compile=False),
+                  data_dir=data_dir)
+    db.run(BID_SRC.format(n=n, c=chunk))
+    db.run(mv_sql)
+    name = mv_sql.split()[3]
+    assert (db.catalog.get(name).runtime or {}).get("fused_job") \
+        is not None
+    drive(db, n, chunk)
+    return db
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimator
+# ---------------------------------------------------------------------------
+
+
+def test_clock_offset_recovers_known_skew():
+    from risingwave_tpu.utils.export import estimate_clock_offset
+    # worker clock runs 3.5s BEHIND the coordinator; one-way delays vary
+    off = 3.5
+    delays = [0.080, 0.004, 0.200, 0.0, 0.035]
+    samples = [(1000.0 + i, 1000.0 + i + off + d)
+               for i, d in enumerate(delays)]
+    est = estimate_clock_offset(samples)
+    assert abs(est - off) < 1e-9          # one sample had zero delay
+
+
+def test_clock_offset_negative_skew_and_bounds():
+    from risingwave_tpu.utils.export import estimate_clock_offset
+    # worker clock AHEAD of the coordinator: offset is negative, and the
+    # estimate is exact up to the smallest delay in the sample set
+    off = -12.25
+    delays = [0.050, 0.010, 0.030]
+    samples = [(5000.0 + i, 5000.0 + i + off + d)
+               for i, d in enumerate(delays)]
+    est = estimate_clock_offset(samples)
+    assert off <= est <= off + min(delays) + 1e-9
+
+
+def test_clock_offset_empty():
+    from risingwave_tpu.utils.export import estimate_clock_offset
+    assert estimate_clock_offset([]) is None
+
+
+# ---------------------------------------------------------------------------
+# streaming EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+
+def test_explain_analyze_parses():
+    from risingwave_tpu.sql import ast as A
+    from risingwave_tpu.sql.parser import parse_sql
+    (stmt,) = parse_sql("EXPLAIN ANALYZE my_mv")
+    assert isinstance(stmt, A.ExplainAnalyze) and stmt.target == "my_mv"
+    (stmt,) = parse_sql("EXPLAIN SELECT 1")     # plain EXPLAIN unchanged
+    assert isinstance(stmt, A.Explain)
+
+
+def test_explain_analyze_q5_agrees_with_node_stats(monkeypatch):
+    """The acceptance snapshot: per-operator tree of a RUNNING fused q5
+    whose rows/eps/occupancy agree with rw_fused_node_stats."""
+    monkeypatch.setenv("RW_SKEW_STATS", "1")   # conftest pins it off
+    db = _fused_db(Q5, n=2048)
+    out = db.run("EXPLAIN ANALYZE q5")[0]
+    assert isinstance(out, str)
+    lines = out.splitlines()
+    assert lines[0].startswith("Streaming EXPLAIN ANALYZE: q5 (fused")
+    assert lines[1].startswith("phase share:") and "dispatch" in lines[1]
+    # the q5 shape is visible: hop, two agg chains, a join, the pair MV
+    assert any("JoinNode" in ln for ln in lines)
+    assert any("HopNode" in ln for ln in lines)
+    assert sum("AggNode" in ln for ln in lines) >= 2
+    by_node = {}
+    for ln in lines[2:]:
+        body = ln.strip().lstrip("-> ")
+        idx = int(body.split(":", 1)[0])
+        by_node[idx] = body
+    rows = db.query("SELECT * FROM rw_fused_node_stats")
+    assert rows
+    for (_job, node, _t, slot, rows_in, rows_out, entries, cap, _occ,
+         _hbm, _ov) in rows:
+        body = by_node[node]
+        assert f"rows_in={rows_in}" in body
+        assert f"rows_out={rows_out}" in body
+        if slot != "-":
+            assert f"{slot}={entries}/{cap}" in body
+    # eps columns derive from the same row counters (rows / elapsed)
+    job = db._fused["q5"]
+    elapsed = time.monotonic() - job.t_created
+    for (_job, node, _t, slot, rows_in, _ro, _e, _c, _o, _h,
+         _ov) in rows:
+        import re
+        m = re.search(r"eps_in=(\d+)", by_node[node])
+        assert m is not None
+        # rendered earlier than `elapsed` was sampled, so rendered eps
+        # can only be >= the recomputed bound
+        assert int(m.group(1)) >= int(rows_in / elapsed) - 1
+    # skew telemetry rides the same tree
+    assert any("skew=" in ln for ln in lines)
+
+
+def test_explain_analyze_host_tree_and_rejections():
+    db = Database()      # no device: host executor path
+    db.run("CREATE TABLE t (v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW m AS SELECT count(*) AS n FROM t")
+    db.run("INSERT INTO t VALUES (1), (2)")
+    out = db.run("EXPLAIN ANALYZE m")[0]
+    assert out.startswith("Streaming EXPLAIN ANALYZE: m (host")
+    assert "Materialize" in out or "Agg" in out
+    with pytest.raises(KeyError):
+        db.run("EXPLAIN ANALYZE nope")
+
+
+# ---------------------------------------------------------------------------
+# source->MV freshness
+# ---------------------------------------------------------------------------
+
+
+def test_datagen_freshness_within_tick_of_ground_truth():
+    """Ground truth on a datagen source: a chunk is minted, materialized
+    and committed inside ONE tick (checkpoint_frequency=1), so recorded
+    freshness must stay within a tick's wall (one epoch cadence)."""
+    db = Database(checkpoint_frequency=1)
+    db.run("CREATE SOURCE s (v BIGINT) WITH (connector='datagen',"
+           " rows.per.poll='256', datagen.max.rows='2048')")
+    db.run("CREATE MATERIALIZED VIEW msum AS SELECT count(*) AS n,"
+           " sum(v) AS s FROM s")
+    max_tick = 0.0
+    for _ in range(12):
+        t0 = time.perf_counter()
+        db.tick()
+        max_tick = max(max_tick, time.perf_counter() - t0)
+    hist = db._freshness.history("msum")
+    assert hist, "commits must record freshness"
+    flowing = [h for h in hist if h[3] > 0]
+    assert all(h[3] >= 0 for h in hist)
+    # ground truth bound: ingest->commit happens inside one tick; allow
+    # 2 ticks + slack for loaded hosts
+    assert min(h[3] for h in hist) <= 2 * max_tick + 0.25, flowing
+    rows = db.query("SELECT * FROM rw_mv_freshness WHERE mv = 'msum'")
+    assert len(rows) == 1
+    (_mv, _e, ingest, commit, fresh, stale, p50, p99, commits) = rows[0]
+    # the SELECT itself ticks a barrier first, so it may add one commit
+    assert commit >= ingest and fresh >= 0
+    assert commits == len(db._freshness.history("msum"))
+    assert p50 <= p99
+    # staleness recomputes at SELECT time: it GROWS while nothing commits
+    time.sleep(0.05)
+    stale2 = db.query(
+        "SELECT * FROM rw_mv_freshness WHERE mv = 'msum'")[0][5]
+    assert stale2 > stale
+
+
+def test_freshness_anchors_on_checkpoint_window_oldest():
+    """checkpoint_frequency > 1: the commit durably lands EVERY barrier
+    since the last checkpoint, so freshness must anchor on the window's
+    OLDEST ingest stamp — the sealing barrier's own stamp would report
+    the MV up to a whole window fresher than ground truth."""
+    db = Database(checkpoint_frequency=3)
+    db.run("CREATE SOURCE s (v BIGINT) WITH (connector='datagen',"
+           " rows.per.poll='32')")
+    db.run("CREATE MATERIALIZED VIEW m2 AS SELECT count(*) AS n FROM s")
+    db.tick()                              # INITIAL (checkpoint) barrier
+    sleep = 0.06
+    n0 = len(db._freshness.history("m2"))
+    while len(db._freshness.history("m2")) == n0:
+        time.sleep(sleep)
+        db.tick()
+    last = db._freshness.history("m2")[-1]
+    # the window spans >= 2 inter-tick sleeps; anchoring on the sealing
+    # barrier would report <= ~1 sleep
+    assert last[3] >= 1.6 * sleep, last
+
+
+def test_fused_freshness_rows():
+    db = _fused_db(Q4)
+    rows = db.query("SELECT * FROM rw_mv_freshness WHERE mv = 'q4'")
+    assert len(rows) == 1
+    (_mv, _e, ingest, commit, fresh, _stale, p50, p99, commits) = rows[0]
+    assert commits > 0 and commit >= ingest and 0 <= p50 <= p99
+    # the histogram rode along (bench reads p50/p99 from it)
+    from risingwave_tpu.utils.metrics import REGISTRY
+    assert 'mv_freshness_seconds_count{mv="q4"}' in REGISTRY.expose()
+
+
+@pytest.mark.chaos
+def test_freshness_monotonic_across_respawn():
+    """PR 8-style in-place respawn must not bend the freshness timeline:
+    commit timestamps and epochs stay nondecreasing, freshness stays
+    non-negative, and the worker's death is invisible in the series
+    shape (only, possibly, in magnitude)."""
+    saved = (ROBUSTNESS.respawn_backoff_s, ROBUSTNESS.spawn_backoff_s)
+    ROBUSTNESS.respawn_backoff_s = ROBUSTNESS.spawn_backoff_s = 0.001
+    try:
+        db = Database(checkpoint_frequency=1)
+        db.run(BID_SRC.format(n=30_000, c=256))
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        db.run("SET streaming_supervision TO true")
+        db.run(Q4)
+        from risingwave_tpu.sql.database import _walk_executors
+        r = None
+        for e in _walk_executors(db.catalog.get("q4").runtime["shared"]
+                                 .upstream):
+            if getattr(e, "_remote", None) is not None:
+                r = e._remote
+        assert r is not None
+        for _ in range(4):
+            db.tick()
+        r.workers[0].proc.kill()          # PR 8-style single-worker death
+        for _ in range(10):
+            db.tick()
+        assert r.supervisor is not None and r.supervisor.respawns >= 1
+        hist = db._freshness.history("q4")
+        assert len(hist) >= 5
+        for a, b in zip(hist, hist[1:]):
+            assert b[2] >= a[2], "commit_ts must be nondecreasing"
+            assert b[0] >= a[0], "epochs must be nondecreasing"
+        assert all(h[3] >= 0 for h in hist)
+        # the barrier decomposition recorded per-worker align sub-spans
+        trace_rows = db.query("SELECT * FROM rw_barrier_trace")
+        aligns = [t for t in trace_rows if t[2].startswith("worker:")]
+        assert aligns and all(t[3] == "align" for t in aligns)
+        r.shutdown()
+    finally:
+        (ROBUSTNESS.respawn_backoff_s, ROBUSTNESS.spawn_backoff_s) = saved
+
+
+# ---------------------------------------------------------------------------
+# unified Perfetto export
+# ---------------------------------------------------------------------------
+
+
+def test_chrome_export_valid_and_monotonic(tmp_path):
+    from risingwave_tpu.utils.export import export_chrome, validate_chrome
+    d = str(tmp_path / "d")
+    db = _fused_db(Q4, data_dir=d)
+    del db
+    doc = export_chrome(d)
+    assert validate_chrome(doc) == []
+    evs = doc["traceEvents"]
+    assert evs, "a fused run must export events"
+    # survives a JSON round trip (the file Perfetto actually loads)
+    doc2 = json.loads(json.dumps(doc))
+    assert len(doc2["traceEvents"]) == len(evs)
+    tracks = {(e["pid"], e["tid"]) for e in evs}
+    assert ("coordinator", "barrier") in tracks
+    assert ("fused:q4", "epoch") in tracks
+    assert ("fused:q4", "phases") in tracks
+    # every complete event is well-formed
+    for e in evs:
+        assert e["ph"] in ("X", "i")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+
+
+def test_ctl_trace_export_cli(tmp_path, capsys):
+    from risingwave_tpu import ctl
+    d = str(tmp_path / "d")
+    _fused_db(Q4, data_dir=d)
+    out = str(tmp_path / "trace.json")
+    rc = ctl.main(["trace", "export", "--data-dir", d, "-o", out])
+    assert rc == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert doc["traceEvents"] and doc["displayTimeUnit"] == "ms"
+    assert "perfetto" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# skew telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_rw_key_skew_consistent_with_node_stats(monkeypatch):
+    monkeypatch.setenv("RW_SKEW_STATS", "1")   # conftest pins it off
+    db = _fused_db(Q4)
+    skew = db.query("SELECT * FROM rw_key_skew WHERE job = 'q4'")
+    assert skew
+    occ = [r for r in skew if r[3] == "vnode_occ"]
+    hot = [r for r in skew if r[3] == "hot_key"]
+    ratio = [r for r in skew if r[3] == "skew_ratio"]
+    assert len(occ) == 16 and len(ratio) == 1
+    # groups only ever grow in q4, so the high-water occupancy histogram
+    # sums exactly to the agg's live-entry count in rw_fused_node_stats
+    agg_entries = [r[6] for r in
+                   db.query("SELECT * FROM rw_fused_node_stats")
+                   if r[2] == "AggNode" and r[3] == "main"]
+    assert sum(r[6] for r in occ) == agg_entries[0]
+    assert abs(sum(r[7] for r in occ) - 1.0) < 1e-9   # shares sum to 1
+    assert ratio[0][7] >= 1.0
+    # nexmark's hot-auction distribution produces real heavy hitters
+    assert hot and all(r[6] > 0 for r in hot)
+    counts = [r[6] for r in hot]
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_skew_stats_off_removes_slots_and_changes_nothing_else(
+        monkeypatch):
+    # the CONFIG off-switch (no env override in play)
+    monkeypatch.delenv("RW_SKEW_STATS", raising=False)
+    db = Database(device=DeviceConfig(capacity=512, aot_compile=False,
+                                      skew_stats=False))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4)
+    drive(db)
+    assert db.query("SELECT * FROM rw_key_skew") == []
+    job = db._fused["q4"]
+    assert all(not n.skew for n in job.program.nodes)
+    assert not any(s.startswith("skv") for _i, s in
+                   job.program.stat_layout)
+
+
+def test_skew_stats_env_kill_switch(monkeypatch):
+    # RW_SKEW_STATS=0 force-disables even when the config says on —
+    # the no-code-change operational kill switch
+    monkeypatch.setenv("RW_SKEW_STATS", "0")
+    db = Database(device=DeviceConfig(capacity=512, aot_compile=False,
+                                      skew_stats=True))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q4)
+    assert all(not n.skew for n in db._fused["q4"].program.nodes)
+
+
+# ---------------------------------------------------------------------------
+# satellites: follow-tail, liveness recompute, remote label lint
+# ---------------------------------------------------------------------------
+
+
+def test_tail_jsonl_survives_rotation(tmp_path):
+    from risingwave_tpu.utils.profile import tail_jsonl
+    from risingwave_tpu.utils.trace import rotate_tail
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as f:
+        for i in range(100):
+            f.write(json.dumps({"i": i}) + "\n")
+    got = []
+    stop = threading.Event()
+
+    def consume():
+        for rec in tail_jsonl(path, poll_s=0.02, stop=stop,
+                              from_start=True):
+            got.append(rec)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.time() + 5
+    while len(got) < 100 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(got) == 100
+    rotate_tail(path)                     # replaces the file (new inode)
+    with open(path, "a") as f:
+        f.write(json.dumps({"i": "post"}) + "\n")
+    while not any(r.get("i") == "post" for r in got) \
+            and time.time() < deadline:
+        time.sleep(0.01)
+    stop.set()
+    t.join(timeout=5)
+    assert any(r.get("i") == "post" for r in got), \
+        "tail must survive rotate_tail and keep yielding"
+    assert all(isinstance(r, dict) for r in got)    # no torn lines
+    # the rotation's replacement file is the old tail the follower
+    # already yielded — it must be deduped, not re-emitted
+    seen = [r["i"] for r in got]
+    assert len(seen) == len(set(seen)), "rotation replayed seen records"
+
+
+def test_profiler_flush_is_single_writer(tmp_path):
+    """Concurrent flushes (epoch loop + supervisor respawn) must never
+    tear lines: hammer flush from two threads while a third appends
+    events, then parse every line."""
+    from risingwave_tpu.utils.profile import JobProfiler
+    prof = JobProfiler("j", enabled=True)
+    prof.attach(str(tmp_path))
+    stop = threading.Event()
+
+    def emit():
+        i = 0
+        while not stop.is_set():
+            prof.compile_event("0:AggNode:%08x" % i, 0.001)
+            i += 1
+
+    def flusher():
+        while not stop.is_set():
+            prof.flush()
+
+    threads = [threading.Thread(target=emit, daemon=True)] \
+        + [threading.Thread(target=flusher, daemon=True)
+           for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    prof.flush()
+    with open(prof.path) as f:
+        for line in f:
+            json.loads(line)              # every line parses whole
+
+
+def test_backpressured_worker_not_wedged():
+    """An idle coordinator (undrained result channel) must not read —
+    or reap — a healthy worker as wedged: ages recompute at SELECT time
+    and queued output proves liveness."""
+    from risingwave_tpu.runtime.remote_fragments import _RemoteSetBase
+
+    class _P:
+        pid = 1
+
+        def poll(self):
+            return None
+
+    class _W:
+        proc = _P()
+        last_epoch = 7
+
+    class _Ch:
+        def __init__(self, buf):
+            self.buf = buf
+            self.capacity = 256
+
+    s = _RemoteSetBase.__new__(_RemoteSetBase)
+    s.kind = "partial"
+    s.workers = [_W()]
+    s.heartbeats = [time.time() - 10 * ROBUSTNESS.heartbeat_timeout_s]
+    s._reaping = [False]
+    s.channels = [_Ch(buf=["queued-chunk"])]
+    assert s.liveness_rows("j")[0][5] == "ok"        # queued output
+    s.channels = [_Ch(buf=[])]
+    assert s.liveness_rows("j")[0][5] == "wedged?"   # genuinely stale
+    s.heartbeats = [time.time()]
+    assert s.liveness_rows("j")[0][5] == "ok"        # recomputed NOW
+
+
+def test_lint_flags_remote_label_divergence():
+    from risingwave_tpu.utils.metrics import MetricsRegistry, lint_registry
+    reg = MetricsRegistry()
+    fam = {"type": "histogram", "help": "h", "labels": ["fragment"],
+           "samples": [[["agg"], {"counts": [1], "total": 1, "sum": 0.1,
+                                  "buckets": [1.0]}]]}
+    reg.merge_remote({"worker_lat": dict(fam)}, worker="w0")
+    assert lint_registry(reg) == []
+    fam2 = dict(fam)
+    fam2["labels"] = ["fragment", "shard"]    # diverged label set
+    reg.merge_remote({"worker_lat": fam2}, worker="w1")
+    problems = lint_registry(reg)
+    assert any("diverge" in p and "worker_lat" in p for p in problems)
