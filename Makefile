@@ -1,0 +1,33 @@
+# Test lanes. The chaos lane records a failpoint ledger on every run, so
+# any chaos failure ships with the exact (ordinal, point, thread, hit)
+# fire sequence that produced it — re-arm with RW_FAILPOINT_LEDGER=<file>
+# (or `make chaos-replay`) and the run reproduces the identical fire
+# sequence regardless of how threads race the second time.
+
+PY ?= python
+CHAOS_LEDGER ?= /tmp/rw_chaos.ledger
+PYTEST_FLAGS ?= -q -p no:cacheprovider -p no:xdist -p no:randomly
+
+.PHONY: tier1 chaos chaos-replay
+
+# the tier-1 gate (ROADMAP "Tier-1 verify" without the log plumbing)
+tier1:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) \
+		-m 'not slow' --continue-on-collection-errors
+
+# chaos CI lane: every supervision/fault-injection test, ledger RECORDED
+# (the target removes a stale ledger first — an existing file would flip
+# the run into replay mode). On failure, keep $(CHAOS_LEDGER): it IS the
+# reproducer.
+chaos:
+	rm -f $(CHAOS_LEDGER) $(CHAOS_LEDGER).*
+	RW_FAILPOINT_LEDGER=$(CHAOS_LEDGER) JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m chaos
+	@echo "chaos ledger recorded at $(CHAOS_LEDGER)"
+	@echo "replay exactly: make chaos-replay  (or RW_FAILPOINT_LEDGER=$(CHAOS_LEDGER) <cmd>)"
+
+# exact replay of the last recorded chaos run's fire sequence
+chaos-replay:
+	test -f $(CHAOS_LEDGER) || (echo "no ledger at $(CHAOS_LEDGER) — run 'make chaos' first" && exit 1)
+	RW_FAILPOINT_LEDGER=$(CHAOS_LEDGER) JAX_PLATFORMS=cpu \
+		$(PY) -m pytest tests/ $(PYTEST_FLAGS) -m chaos

@@ -613,6 +613,99 @@ def stage_shards_qx(n_events):
         n_events, QX_CHUNK, QX_CAPACITY, warm_pass=False)
 
 
+def stage_chaos_mttr(n_events):
+    """Workload: recovery MTTR under chaos (fault-tolerance v3).
+
+    Two halves, both deterministic:
+    * kill a SUPERVISED worker mid-run (SIGKILL) — time until the
+      FragmentSupervisor's in-place respawn converges, then measure the
+      post-recovery throughput of fresh traffic;
+    * fire a fused device-path failpoint (`fused.dispatch`) mid-run —
+      time the in-place fused recovery (state rebuild + crash-window
+      re-dispatch on AOT-cached executables), then the post-recovery
+      steady-state eps."""
+    import time as _t
+    from risingwave_tpu.config import ROBUSTNESS
+    from risingwave_tpu.sql import Database
+    from risingwave_tpu.sql.database import _walk_executors
+    from risingwave_tpu.utils import failpoint as fp
+    ROBUSTNESS.respawn_backoff_s = 0.001
+    out = {}
+    # ---- half 1: supervised worker kill -> in-place respawn ----------
+    db = Database()
+    db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+    db.run("SET streaming_parallelism = 2")
+    db.run("SET streaming_placement = 'process'")
+    db.run("SET streaming_supervision TO true")
+    db.run("CREATE MATERIALIZED VIEW ra AS SELECT k, count(*) AS c,"
+           " sum(v) AS s FROM t GROUP BY k")
+    n_seed = 2000
+    vals = ", ".join(f"({k % 97}, {k})" for k in range(n_seed))
+    db.run(f"INSERT INTO t VALUES {vals}")
+    for _ in range(4):
+        db.tick()
+    rset = None
+    for e in _walk_executors(db.catalog.get("ra").runtime["shared"]
+                             .upstream):
+        rset = getattr(e, "_remote", None) or rset
+    t0 = _t.perf_counter()
+    rset.workers[0].proc.kill()
+    while rset.supervisor.respawns == 0:
+        db.tick()
+    respawn_s = _t.perf_counter() - t0
+    # post-recovery eps over fresh traffic
+    vals = ", ".join(f"({k % 97}, {k})" for k in range(n_seed))
+    t0 = _t.perf_counter()
+    db.run(f"INSERT INTO t VALUES {vals}")
+    post_dt = _t.perf_counter() - t0
+    assert len(db.query("SELECT * FROM ra")) == 97
+    rset.shutdown()
+    out["worker_kill"] = {
+        "respawn_mttr_s": round(respawn_s, 3),
+        "post_recovery_eps": round(n_seed / post_dt),
+        "respawns": rset.supervisor.respawns,
+        "escalated": rset.supervisor._escalated is not None,
+    }
+    # ---- half 2: fused device-path fault -> in-place recovery --------
+    # chunk sized for ~8 epochs: the fault must land MID-RUN, with real
+    # committed history to rebuild and a real crash window to re-dispatch
+    chunk = max(64, n_events // (64 * 8))
+    db2 = Database(device=_device_cfg(True, 1 << 18))
+    db2.run(BID_SRC.format(n=n_events, c=chunk))
+    db2.run(Q4_MV)
+    job = db2.catalog.get("q4").runtime["fused_job"]
+    epochs = max(1, n_events // job.program.epoch_events)
+    warm = max(1, epochs // 4)
+    for _ in range(warm):
+        db2.tick()
+    fp.arm("fused.dispatch", 1.0, 0, 1)
+    t0 = _t.perf_counter()
+    db2.tick()                     # fires + recovers inside this barrier
+    job.sync()
+    mttr = _t.perf_counter() - t0
+    fp.reset()
+    assert job.recoveries == 1
+    t0 = _t.perf_counter()
+    for _ in range(epochs - warm + 2):
+        db2.tick()
+    job.sync()
+    post_dt = max(1e-9, _t.perf_counter() - t0)
+    post_events = job.counter - (warm + 1) * job.program.epoch_events
+    out["fused_fault"] = {
+        "recovery_mttr_s": round(mttr, 3),
+        "recoveries": job.recoveries,
+        "post_recovery_eps": round(max(0, post_events) / post_dt),
+        "events": n_events,
+        "zero_ddl_replay": True,
+    }
+    out["note"] = ("worker_kill: SIGKILL a supervised stateful-agg "
+                   "worker, MTTR = kill->in-place respawn converged; "
+                   "fused_fault: fused.dispatch failpoint fires once "
+                   "mid-run, MTTR = barrier wall incl. state rebuild + "
+                   "crash-window re-dispatch (AOT-cached, zero compiles)")
+    return {"chaos_mttr": out}
+
+
 # ---------------------------------------------------------------------------
 # the un-killable harness
 # ---------------------------------------------------------------------------
@@ -625,6 +718,7 @@ _STAGES = {
     "qx_host": stage_qx_host,
     "shards_q4": stage_shards_q4,
     "shards_qx": stage_shards_qx,
+    "chaos_mttr": stage_chaos_mttr,
 }
 
 
@@ -771,7 +865,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r09.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r12.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -795,6 +889,7 @@ def main():
         h.run_stage("qx_host", (8_192,), 30)
         h.run_stage("shards_q4", (262_144,), 90)
         h.run_stage("shards_qx", (65_536,), 90)
+        h.run_stage("chaos_mttr", (262_144,), 90)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
         # compile of a fused epoch program set is ~200-400s on the remote-
@@ -831,6 +926,9 @@ def main():
         # programs are compile-heavy; the cache from qx_device warms 1-
         # shard, the 8-shard pass pays its own compiles once)
         h.run_stage("shards_qx", (QX_SQL_EVENTS[0],), 900)
+        # recovery MTTR under chaos (fault-tolerance v3): worker SIGKILL
+        # respawn + fused device-fault in-place recovery, both timed
+        h.run_stage("chaos_mttr", (Q4_SQL_EVENTS[0] // 4,), 300)
     h.emit()
 
 

@@ -133,6 +133,21 @@ class RobustnessConfig:
     # escalating to RemoteWorkerDied (full job recovery)
     respawn_attempts: int = 3
     respawn_backoff_s: float = 0.05
+    # poison-pill quarantine: consecutive respawns of ONE slot that die
+    # on the SAME retained input window (fingerprinted) before the
+    # supervisor sidelines the window's data chunks into the durable
+    # rw_dead_letter table and resumes past them — bounded data loss
+    # with an audit trail instead of a wedged-forever fragment. Must be
+    # <= respawn_attempts or the attempt bound escalates first; <= 0
+    # disables quarantine (the pre-v3 respawn-until-escalate behavior).
+    poison_threshold: int = 2
+    # fused device jobs: in-place recoveries per job from a device-path
+    # failure (dispatch/sync/replay/commit exception or an armed
+    # fused.* failpoint) before the error propagates to the classic
+    # DDL-replay restart. Recovery rebuilds program state from the last
+    # checkpoint and re-dispatches the retained crash-window epochs —
+    # all on AOT-cached executables, so it is zero-compile.
+    fused_recovery_attempts: int = 3
     # metrics plane: a worker whose last heartbeat frame (piggybacked on
     # its result stream) is older than this is flagged WEDGED in
     # rw_worker_liveness / worker_liveness — alive-but-stuck detection

@@ -162,14 +162,23 @@ class SinkExecutor(Executor):
     mirrored row is dropped (the duplicate), a `+` for a pk holding a
     DIFFERENT row becomes a `-old`/`+new` repair pair, and a `-` for a
     pk the mirror holds retracts the mirrored row (robust to refresh
-    artifacts). Rows for unseen pks always pass — a recovered
-    coordinator starts with an empty mirror and must not eat the legit
-    deltas that follow. Appended-only streams and pk-less shapes skip
-    the mirror entirely."""
+    artifacts). Rows for unseen pks always pass — unseen means the
+    mirror genuinely never delivered them.
+
+    Durable mirror journal (`mirror_table`, fault-tolerance v3): the
+    per-pk mirror is no longer a coordinator-process-lifetime structure.
+    Its deltas journal through a durable state table with EPOCH-FENCED
+    commits — the same store commit as the sink log and every operator's
+    state — and a restarted coordinator REBUILDS the mirror from the
+    journal before the first post-restart change arrives. A refresh
+    racing a coordinator crash therefore cannot duplicate into the
+    external file: the re-stated rows meet a mirror that remembers them.
+    Append-only streams and pk-less shapes skip the mirror entirely."""
 
     def __init__(self, input: Executor, sink: FileSink,
                  log_table: Optional[StateTable] = None,
                  pk_indices: Optional[List[int]] = None,
+                 mirror_table: Optional[StateTable] = None,
                  name: str = "Sink"):
         super().__init__(input.schema, name)
         self.input = input
@@ -180,6 +189,24 @@ class SinkExecutor(Executor):
         self.pk_indices = list(pk_indices) if pk_indices else None
         self._mirror: dict = {}
         self.dedupe = bool(self.pk_indices) and not input.append_only
+        self.mirror_table = mirror_table if self.dedupe else None
+        self._pk_dtypes = [self._dtypes[i] for i in self.pk_indices] \
+            if self.pk_indices else []
+        # pk -> the exact journal row last written (delete-then-insert
+        # upserts need the old row back)
+        self._journaled: dict = {}
+        # pks whose mirror entry changed since the last checkpoint —
+        # the journal writes deltas, not full snapshots
+        self._mirror_dirty: set = set()
+        if self.mirror_table is not None:
+            # coordinator restart: rebuild the delivered mirror from the
+            # journal — only COMMITTED entries survive in the store, so
+            # the rebuild is epoch-fenced by construction
+            for jrow in self.mirror_table.iter_all():
+                jrow = tuple(jrow)
+                row = decode_row(jrow[2], self._dtypes)
+                self._mirror[tuple(row[i] for i in self.pk_indices)] = row
+                self._journaled[jrow[0]] = jrow
 
     def _reconcile(self, sign: int, row: Tuple) -> List[Tuple[int, Tuple]]:
         """Map one change through the delivered-row mirror; returns the
@@ -195,13 +222,36 @@ class SinkExecutor(Executor):
                     "boundary").inc()
                 return []
             self._mirror[pk] = row
+            self._mirror_dirty.add(pk)
             if held is not None:        # refresh with a changed value
                 return [(-1, held), (1, row)]
             return [(1, row)]
         if held is not None:
             del self._mirror[pk]
+            self._mirror_dirty.add(pk)
             return [(-1, held)]
         return [(-1, row)]              # unseen pk: trust upstream
+
+    def _journal_mirror(self, epoch: int) -> None:
+        """Write the checkpoint window's mirror deltas to the journal
+        table and commit them fenced at `epoch` — the same store commit
+        that makes the sink log and the operators' state durable, so the
+        mirror can never run ahead of (or behind) the data it fences."""
+        if self.mirror_table is None or not self._mirror_dirty:
+            self._mirror_dirty.clear()
+            return
+        for pk in self._mirror_dirty:
+            key = encode_row(pk, self._pk_dtypes)
+            old = self._journaled.pop(key, None)
+            if old is not None:
+                self.mirror_table.delete(old)
+            row = self._mirror.get(pk)
+            if row is not None:
+                new = (key, epoch, encode_row(row, self._dtypes))
+                self.mirror_table.insert(new)
+                self._journaled[key] = new
+        self._mirror_dirty.clear()
+        self.mirror_table.commit(epoch)
 
     def deliver_durable(self) -> None:
         """Ship every log epoch that the store has made durable. Called by
@@ -253,4 +303,7 @@ class SinkExecutor(Executor):
                                  encode_row(row, self._dtypes)))
                     self._pending.clear()
                     self.log_table.commit(epoch)
+                    # mirror deltas journal in the SAME epoch fence as
+                    # the log entries they deduplicated against
+                    self._journal_mirror(epoch)
             yield msg

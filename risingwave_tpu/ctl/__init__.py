@@ -57,6 +57,14 @@ Commands:
                               up" and proves zero-compile warm starts;
                               --wait SECS lets in-flight background
                               compiles land first
+    dlq [JOB]                 poison-pill dead-letter queue: list the
+                              quarantined input rows (default — reads
+                              the durable table directly, works on a
+                              DEAD dir), --requeue ID,..|all re-injects
+                              them into the live job (opens a Database,
+                              replays DDL, ticks delivery), --purge
+                              ID,..|all drops them (data loss accepted,
+                              audit closed)
 """
 from __future__ import annotations
 
@@ -296,6 +304,13 @@ def cmd_failpoints(args) -> int:
     import risingwave_tpu.runtime.remote_fragments  # noqa: F401
     import risingwave_tpu.runtime.worker  # noqa: F401
     import risingwave_tpu.state.hummock  # noqa: F401
+    try:
+        # fused device-path points (dispatch / device_sync /
+        # growth_replay / checkpoint_commit); jax-hosted module, so a
+        # jax-less operator box still lists the host-side points
+        import risingwave_tpu.device.fused  # noqa: F401
+    except ImportError:
+        pass
     if args.ledger is not None:
         try:
             entries = fp.load_ledger(args.ledger) if args.ledger \
@@ -407,6 +422,67 @@ def cmd_compile_status(args) -> int:
     return 0
 
 
+def cmd_dlq(args) -> int:
+    """Poison-pill dead-letter queue (`rw_dead_letter`): list the
+    quarantined input rows of a job (or all jobs), re-inject them into
+    the live dataflow once the underlying poison condition is fixed, or
+    purge them. Listing reads the durable DLQ table directly — no
+    Database, works on a dead directory; requeue/purge open a full
+    Database (DDL replay respawns the worker sets) and commit the
+    status flip durably."""
+    if args.requeue is None and args.purge is None:
+        store = _store(args.data_dir)
+        from ..runtime.remote_fragments import DeadLetterQueue
+        from ..sql.database import DLQ_TABLE_ID
+        from ..state import StateTable
+        dlq = DeadLetterQueue(StateTable(
+            store, DLQ_TABLE_ID, list(DeadLetterQueue.DTYPES),
+            list(DeadLetterQueue.PK)))
+        ents = dlq.entries(job=args.job)
+        if not ents:
+            print("dead-letter queue is empty"
+                  + (f" for job {args.job!r}" if args.job else ""))
+            return 0
+        print(f"{'id':>5s}  {'job':12s} {'slot':>4s} {'side':>4s} "
+              f"{'epoch':>7s}  {'status':12s} {'sign':>4s}  row")
+        for (i, job, slot, side, epoch, _fp, sign, rrepr, _payload,
+             status, _ts) in ents:
+            print(f"{i:5d}  {job:12s} {slot:4d} {side:4d} {epoch:7d}  "
+                  f"{status:12s} {sign:4d}  {rrepr}")
+        print(f"-- {len(ents)} rows; requeue with "
+              f"`dlq {args.job or '<job>'} --data-dir {args.data_dir} "
+              "--requeue all` once the poison condition is fixed")
+        return 0
+    if args.requeue is not None and args.purge is not None:
+        raise SystemExit("dlq: --requeue and --purge are mutually "
+                         "exclusive (one destructive action at a time)")
+    if args.job is None:
+        raise SystemExit("dlq --requeue/--purge needs the JOB argument")
+    from ..sql import Database
+    db = Database(data_dir=args.data_dir, device="auto")
+    ids = None
+    spec = args.purge if args.purge is not None else args.requeue
+    if spec != "all":
+        try:
+            ids = [int(x) for x in spec.split(",") if x]
+        except ValueError:
+            raise SystemExit(f"bad id list {spec!r} (want 'all' or "
+                             "comma-separated ids)")
+    if args.purge is not None:
+        n = db.dlq_purge(args.job, ids)
+        print(f"purged {n} dead-letter rows of {args.job!r}")
+        return 0
+    try:
+        n = db.dlq_requeue(args.job, ids)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    for _ in range(max(0, args.ticks)):
+        db.tick()
+    print(f"requeued {n} rows into {args.job!r} "
+          f"(delivered over {args.ticks} barriers)")
+    return 0
+
+
 def cmd_history(args) -> int:
     """Retained manifest versions (time-travel window)."""
     store = _store(args.data_dir)
@@ -477,6 +553,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("history")
     sp.add_argument("--data-dir", required=True)
     sp.set_defaults(fn=cmd_history)
+    sp = sub.add_parser("dlq")
+    sp.add_argument("job", nargs="?", default=None)
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--requeue", default=None, metavar="IDS|all",
+                    help="re-inject quarantined rows (comma-separated "
+                         "ids or 'all') into the live job")
+    sp.add_argument("--purge", default=None, metavar="IDS|all",
+                    help="drop quarantined rows outright")
+    sp.add_argument("--ticks", type=int, default=4,
+                    help="barriers to drive after a requeue so the rows "
+                         "reach the MV/sink (default 4)")
+    sp.set_defaults(fn=cmd_dlq)
     sp = sub.add_parser("failpoints")
     sp.add_argument("--spec", default=os.environ.get("RW_FAILPOINTS", ""))
     sp.add_argument("--arm", default=None,

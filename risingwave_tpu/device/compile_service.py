@@ -454,7 +454,16 @@ class CompileService:
                 with self._lock:
                     self.compiled_steps += 1
                 return out
-            except Exception as e:       # aval/placement drift: fall back
+            except Exception as e:
+                # TRANSIENT device-path faults (injected fused.* points,
+                # XLA runtime errors) belong to the job's in-place
+                # recovery — re-raise; demoting the entry would leave a
+                # healthy executable permanently on the inline-jit
+                # fallback after the job heals (fault-tolerance v3).
+                from .fused import _is_device_fault
+                if _is_device_fault(e):
+                    raise
+                # aval/placement drift: permanent fallback
                 ent.status = "failed"
                 ent.error = f"dispatch: {type(e).__name__}: {e}"
         if ent.status == "failed":

@@ -33,6 +33,10 @@ from .fused import (AggNode, Delta, FilterNode, FusedJob, FusedProgram,
 NUM = ("num",)
 TS = ("ts",)
 _HORIZON = 1 << 33          # event horizon assumed for unbounded sources
+# fused epoch cadence = source events_per_poll * EPOCH_POLLS (the
+# SourceExecutor poll budget per barrier); module-level so tests can pin
+# a cadence that does NOT divide the shard count (tail-padding coverage)
+EPOCH_POLLS = 64
 
 
 class FuseReject(Exception):
@@ -202,7 +206,7 @@ class _Fuser:
             self.max_events = desc.max_events
         elif desc.max_events != self.max_events:
             raise FuseReject("sources disagree on max_events")
-        ee = desc.events_per_poll * 64      # SourceExecutor poll budget
+        ee = desc.events_per_poll * EPOCH_POLLS
         if self.epoch_events is None:
             self.epoch_events = ee
         elif self.epoch_events != ee:
@@ -537,15 +541,17 @@ def try_fuse(execu, ns, device_cfg, name: str,
 
 def _fused_mesh(device_cfg, epoch_events: int):
     """The 1-D device mesh a fused program shards over, or None for the
-    single-chip path. `DeviceConfig.mesh_shards` opts in; the epoch
-    cadence must split evenly into contiguous per-shard event blocks,
-    and the platform must actually have the devices (mesh.make_mesh
-    falls back to virtual CPU devices under
-    --xla_force_host_platform_device_count, the tier-1 test substrate).
-    Any miss degrades silently to one chip — sharding is an execution
-    detail, never an eligibility cliff."""
+    single-chip path. `DeviceConfig.mesh_shards` opts in; the platform
+    must actually have the devices (mesh.make_mesh falls back to virtual
+    CPU devices under --xla_force_host_platform_device_count, the tier-1
+    test substrate) — a device miss degrades silently to one chip.
+    An epoch cadence that does NOT divide the shard count no longer
+    degrades: each shard's contiguous event block is ceil-div sized and
+    the tail block is PADDED (the over-generated ids mask out inside the
+    traced step, `shard_exec.sharded_apply`), so all chips engage at any
+    cadence."""
     n = max(1, int(getattr(device_cfg, "mesh_shards", 1) or 1))
-    if n <= 1 or epoch_events % n != 0:
+    if n <= 1:
         return None
     from ..parallel.mesh import make_mesh
     try:

@@ -37,6 +37,37 @@ import numpy as np
 
 from ..core import dtypes as T
 from ..core.dtypes import DataType, TypeKind
+from ..utils.failpoint import FailpointError, declare, failpoint
+
+# Fused device-path failure seams (fault-tolerance v3): each hook sits at
+# the point where a real device fault would surface — the async epoch
+# dispatch, the blocking device_get of a sync, the growth-replay
+# re-dispatch, and the checkpoint commit. An armed point (or a real
+# dispatch/runtime exception) routes the job through IN-PLACE recovery
+# (`FusedJob._recover_in_place`), never a DDL-replay restart.
+declare("fused.dispatch",
+        "fail a fused epoch dispatch (device-path fault mid-epoch)")
+declare("fused.device_sync",
+        "fail the blocking device sync of a fused checkpoint/SELECT")
+declare("fused.growth_replay",
+        "fail a fused capacity growth replay mid-re-dispatch")
+declare("fused.checkpoint_commit",
+        "fail a fused job-state checkpoint commit")
+
+
+def _is_device_fault(e: BaseException) -> bool:
+    """Failures the in-place recovery path may absorb: injected fused.*
+    failpoints and the runtime errors jax surfaces on a genuine
+    device-path fault. Correctness errors (packed-key bounds violations
+    raise a plain RuntimeError) and control-flow exceptions always
+    propagate — replaying them would loop on a real bug."""
+    if isinstance(e, FailpointError):
+        return True
+    if isinstance(e, (KeyboardInterrupt, SystemExit)):
+        return False
+    return type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError",
+                                "InternalError", "UnavailableError",
+                                "DataLoss")
 
 # ---------------------------------------------------------------------------
 # Delta: the traced value flowing between stages (NOT a jit boundary type)
@@ -1191,11 +1222,10 @@ class FusedProgram:
         self.nodes, self.remap = _chain_nodes(nodes)
         self.epoch_events = epoch_events
         # device mesh for shard_map'd execution (device/shard_exec.py);
-        # None = the single-chip path, byte-for-byte the pre-mesh program
+        # None = the single-chip path, byte-for-byte the pre-mesh
+        # program. A cadence that does not divide the shard count is
+        # fine: the tail event block pads (shard_exec.sharded_apply)
         self.mesh = mesh
-        if mesh is not None:
-            assert epoch_events % mesh.devices.size == 0, \
-                "epoch cadence must divide evenly into mesh shards"
         # wall seconds the LAST epoch() spent dispatching exchange
         # programs (the ICI shuffle stage) — FusedJob splits it out of
         # the dispatch phase so ICI cost is attributable
@@ -1466,6 +1496,16 @@ class FusedJob:
         self.growth_replays = 0
         self.retraces = 0
         self.growths = 0
+        # coordinator-side epoch event log: one (event_lo, events) entry
+        # per epoch dispatched since the last checkpoint — the retained
+        # crash window an IN-PLACE recovery re-dispatches (sources are
+        # deterministic, so the log of ranges IS the log of events).
+        # Trimmed at every checkpoint commit.
+        self._epoch_log: List[Tuple[int, int]] = []
+        # in-place recoveries from device-path failures (no DDL replay);
+        # attempts reset on a successful checkpoint
+        self.recoveries = 0
+        self._recovery_attempts = 0
         # key stride of the capacity rows: plan-derived (deterministic on
         # recovery), widened past the minimum when a node has more slots
         self._js_stride = max([_JS_CAP_STRIDE]
@@ -1512,8 +1552,6 @@ class FusedJob:
             and self.counter >= self.max_events
 
     def on_barrier(self, barrier) -> None:
-        import jax.numpy as jnp
-        import time as _time
         # no span for post-drain barriers: a drained job keeps seeing
         # ticks forever, and zero-event records would evict the real
         # epoch history from the profile ring (sync/commit at a
@@ -1522,31 +1560,26 @@ class FusedJob:
             and not self.drained else None
         if prof is not None:
             prof.begin_epoch(self.counter, self.program.epoch_events)
-        if not self.drained:
-            if self._window_ingest is None:
-                # first dispatch since the last checkpoint: freshness of
-                # the NEXT commit is measured against this moment
-                self._window_ingest = _time.time()
-            t0 = _time.perf_counter() if prof is not None else 0.0
-            lo = jnp.int64(self.counter)
-            if prof is not None:
-                t1 = _time.perf_counter()
-                prof.phase("host_pack", t1 - t0)
-                t0 = t1
-            self.states, self.stats_acc = self._step(
-                self.states, lo, self.stats_acc)
-            if prof is not None:
-                dt = _time.perf_counter() - t0
-                # the ICI shuffle's enqueue wall is its own phase so the
-                # exchange stage is attributable; it was measured inside
-                # the dispatch window, so subtract to keep phases disjoint
-                ex = min(self.program.last_exchange_s, dt)
-                if ex > 0.0:
-                    prof.phase("exchange", ex)
-                prof.phase("dispatch", dt - ex)
-            self.counter += self.program.epoch_events
-        if barrier.is_checkpoint:
-            self._checkpoint(barrier.epoch.curr)
+        # fault-tolerance v3: a device-path failure anywhere in the
+        # barrier's work (dispatch, sync, growth replay, commit — real
+        # exception or armed fused.* failpoint) recovers IN PLACE and the
+        # barrier's remaining work retries. `dispatched` makes the retry
+        # idempotent: a failure after the dispatch (e.g. in the
+        # checkpoint sync) must not dispatch the epoch twice — recovery
+        # already re-dispatched it from the epoch event log.
+        dispatched = False
+        while True:
+            try:
+                if not self.drained and not dispatched:
+                    self._dispatch_epoch(prof)
+                    dispatched = True
+                if barrier.is_checkpoint:
+                    self._checkpoint(barrier.epoch.curr)
+                break
+            except Exception as e:
+                if not _is_device_fault(e):
+                    raise
+                self._recover_in_place(e)
         if prof is not None:
             prof.end_epoch()
         if self.profiler.enabled and barrier.is_checkpoint:
@@ -1555,6 +1588,93 @@ class FusedJob:
             # jsonl now, not one checkpoint later — `risectl profile`
             # against a wedged process must see the newest checkpoint
             self.profiler.flush()
+
+    def _dispatch_epoch(self, prof) -> None:
+        """Dispatch ONE epoch (async) and log it into the epoch event
+        log — the coordinator-side record an in-place recovery replays."""
+        import jax.numpy as jnp
+        import time as _time
+        if self._window_ingest is None:
+            # first dispatch since the last checkpoint: freshness of
+            # the NEXT commit is measured against this moment
+            self._window_ingest = _time.time()
+        if failpoint("fused.dispatch"):
+            raise FailpointError("fused.dispatch")
+        t0 = _time.perf_counter() if prof is not None else 0.0
+        lo = jnp.int64(self.counter)
+        if prof is not None:
+            t1 = _time.perf_counter()
+            prof.phase("host_pack", t1 - t0)
+            t0 = t1
+        self.states, self.stats_acc = self._step(
+            self.states, lo, self.stats_acc)
+        if prof is not None:
+            dt = _time.perf_counter() - t0
+            # the ICI shuffle's enqueue wall is its own phase so the
+            # exchange stage is attributable; it was measured inside
+            # the dispatch window, so subtract to keep phases disjoint
+            ex = min(self.program.last_exchange_s, dt)
+            if ex > 0.0:
+                prof.phase("exchange", ex)
+            prof.phase("dispatch", dt - ex)
+        self._epoch_log.append((self.counter, self.program.epoch_events))
+        self.counter += self.program.epoch_events
+
+    def _recover_in_place(self, err: BaseException) -> None:
+        """In-place recovery from a device-path failure: NO DDL-replay
+        restart. Rebuild program state from the last checkpointed state
+        tables' committed view (the event counter + capacity high-water
+        marks are already live on this job — `recover()` presized them at
+        open), then re-dispatch the retained crash-window epochs from the
+        coordinator-side epoch event log. Every node signature and
+        capacity is unchanged, so the whole rebuild dispatches on the
+        AOT-cached executables — ZERO fresh compiles — and deterministic
+        sources regenerate bit-identical state. Bounded attempts
+        (`RW_FUSED_RECOVERY_ATTEMPTS`); past the bound the original error
+        propagates and the classic DDL-replay recovery takes over."""
+        import time as _time
+        from ..config import ROBUSTNESS
+        from ..utils.metrics import REGISTRY
+        self._recovery_attempts += 1
+        if self._recovery_attempts > max(1, ROBUSTNESS.fused_recovery_attempts):
+            raise err
+        t_rec = _time.perf_counter()
+        target = self.committed
+        window = list(self._epoch_log)
+        # the log must be contiguous from the committed counter — a torn
+        # log cannot be replayed exactly, so escalate instead of guessing
+        expect = target
+        for lo, ev in window:
+            if lo != expect:
+                raise err
+            expect += ev
+        # rebuild: empty state at the CURRENT (>= persisted high-water)
+        # capacities, regenerate the checkpointed history device-side,
+        # re-anchor the growth snapshot at the checkpoint, then replay
+        # the crash window — the same barrier boundaries, so the MV is
+        # bit-identical to an undisturbed run
+        self.states = self.program.init_states()
+        self.stats_acc = self._zero_stats
+        self.counter = 0
+        if target:
+            self._dispatch_range(0, target)
+            self.counter = target
+            self.sync()
+        self.snapshot = (self.states, target)
+        self.stats_acc = self._zero_stats
+        if expect > target:
+            self._dispatch_range(target, expect)
+            self.counter = expect
+        self.recoveries += 1
+        REGISTRY.counter(
+            "fused_recoveries_total",
+            "in-place fused-job recoveries (device-path failures healed "
+            "without a DDL-replay restart)",
+            labels=("job",)).labels(self.name).inc()
+        REGISTRY.histogram(
+            "fused_recovery_seconds",
+            "wall seconds one in-place fused recovery took").observe(
+            _time.perf_counter() - t_rec)
 
     # ---- sync / growth / replay ----------------------------------------
     def _dispatch_range(self, lo: int, hi: int) -> None:
@@ -1641,6 +1761,8 @@ class FusedJob:
     def _sync_inner(self) -> None:
         import jax
         while True:
+            if failpoint("fused.device_sync"):
+                raise FailpointError("fused.device_sync")
             vec = np.asarray(jax.device_get(self.stats_acc))
             self._last_stats = vec
             for k, (ni, nm) in enumerate(self.program.stat_layout):
@@ -1686,6 +1808,8 @@ class FusedJob:
                 else:
                     new_states.append(snap_states[i])
             self.growth_replays += 1
+            if failpoint("fused.growth_replay"):
+                raise FailpointError("fused.growth_replay")
             target = self.counter
             self.states = tuple(new_states)
             self.snapshot = (self.states, snap_counter)
@@ -1727,6 +1851,8 @@ class FusedJob:
         if due:
             self._persist_mv(epoch)
             self._last_persist = self.counter
+        if failpoint("fused.checkpoint_commit"):
+            raise FailpointError("fused.checkpoint_commit")
         if self.job_state_table is not None:
             dirty = False
             if self.committed != self.counter or self.committed == 0:
@@ -1753,6 +1879,11 @@ class FusedJob:
         self.snapshot = (self.states, self.counter)
         self.stats_acc = self._zero_stats
         self.committed = self.counter
+        # the checkpoint closed the window: trim the epoch event log and
+        # reset the in-place recovery attempt budget (attempts bound
+        # failures per window, not per job lifetime)
+        self._epoch_log.clear()
+        self._recovery_attempts = 0
 
     # ---- MV materialization --------------------------------------------
     def _pull_rows(self) -> List[Tuple]:

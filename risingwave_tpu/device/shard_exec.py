@@ -274,9 +274,20 @@ def sharded_apply(mesh, node, epoch_events: int, state, ins, extra,
     capacities."""
     import jax
     import jax.numpy as jnp
-    from .fused import MVKeyedNode
+    from .fused import Delta, MVKeyedNode, _nrows
     n = mesh.devices.size
-    ev_local = epoch_events // n if node.takes_event_lo else epoch_events
+    # ceil-div when the cadence does not split evenly: every shard
+    # generates the same-size contiguous event-id block (shapes must be
+    # uniform across shards) and the PADDED TAIL — ids at or past
+    # event_lo + epoch_events, which belong to the NEXT epoch's dispatch
+    # — is masked out of the source delta below. Before this, a
+    # non-dividing cadence silently degraded the whole job to one chip
+    # (the ROADMAP mesh residual).
+    ev_local = epoch_events
+    pad = 0
+    if node.takes_event_lo:
+        ev_local = -(-epoch_events // n)
+        pad = n * ev_local - epoch_events
     names = node.stat_names
     sums = set(node.stat_sums)
 
@@ -290,6 +301,17 @@ def sharded_apply(mesh, node, epoch_events: int, state, ins, extra,
         elif isinstance(node, MVKeyedNode):
             ex = _drop(extra)
         st, out, stats, aux = node.apply(lst, lins, ex, ev_local)
+        if pad and node.takes_event_lo and out is not None \
+                and out.pk is not None:
+            # drop the tail block's over-generated events (source-rooted
+            # deltas carry the event id as pk through Map/Filter chains,
+            # so the bound is exact) and recount the flow stat so psum'd
+            # rows_out equals the single-chip number
+            live = out.mask & (out.pk < extra + epoch_events)
+            out = Delta(out.cols, out.sign, live, pk=out.pk, pk2=out.pk2)
+            if "rows_out" in names:
+                stats = list(stats)
+                stats[names.index("rows_out")] = _nrows(live)
         if abst:
             red = list(stats)
         else:

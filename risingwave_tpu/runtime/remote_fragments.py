@@ -37,6 +37,7 @@ Failure handling has two tiers:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import select
 import subprocess
@@ -46,7 +47,9 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..config import ROBUSTNESS
+from ..core import dtypes as T
 from ..core.chunk import Op, StreamChunk
+from ..core.encoding import encode_row
 from ..core.epoch import EpochPair
 from ..core.vnode import compute_vnodes
 from ..ops import DispatchExecutor, MergeExecutor
@@ -65,6 +68,86 @@ declare("fragment.drain",
 
 class RemoteWorkerDied(RuntimeError):
     pass
+
+
+# Every reason an `_escalate` call site may cite — the
+# `supervisor_escalations_total{reason}` label values, with their
+# meanings. The registry makes escalation hygiene TESTABLE:
+# tests/test_supervision2.py walks the module's call sites and asserts
+# each cites exactly one registered reason and no two sites share one
+# ambiguously (a dashboard must be able to tell WHY a fragment fell back
+# to full recovery from the label alone).
+ESCALATION_REASONS: Dict[str, str] = {
+    "stop": "worker died during job stop — nothing to respawn into",
+    "respawns_exhausted":
+        "one slot kept dying past RW_RESPAWN_ATTEMPTS in-place respawns",
+    "unkillable": "dead/wedged worker process would not reap within 10s",
+    "drain_stuck": "the old result drain thread would not stop",
+    "spawn_failed": "the successor worker failed to spawn",
+    "shadow_mismatch":
+        "retained input window does not roll back cleanly against the "
+        "coordinator shadow (join respawn cannot refresh its way out)",
+}
+
+
+class DeadLetterQueue:
+    """Durable poison-pill quarantine store — the rows behind the
+    `rw_dead_letter` system table and `risectl dlq`.
+
+    One row per sidelined input record:
+        (id, job, slot, side, epoch, fingerprint, sign, row_repr,
+         payload, status, ts)
+    `payload` is the value-encoded row (exact requeue); `row_repr` is a
+    human-readable audit copy; `status` walks quarantined -> requeued
+    (or the row is purged). The table rides the normal state-store
+    commit protocol, so quarantines are durable at the next checkpoint
+    and survive coordinator restarts."""
+
+    DTYPES = (T.INT64, T.VARCHAR, T.INT64, T.INT64, T.INT64, T.VARCHAR,
+              T.INT64, T.VARCHAR, T.BYTEA, T.VARCHAR, T.FLOAT64)
+    PK = (0,)
+
+    def __init__(self, table):
+        self.table = table
+        self._next_id = 1 + max(
+            [int(r[0]) for r in table.iter_all()], default=-1)
+
+    def quarantine(self, job: str, slot: int, entries,
+                   fingerprint: str, commit_epoch: int) -> int:
+        """`entries`: (side, epoch, sign, row, payload) per sidelined
+        record; returns the count written."""
+        n = 0
+        for side, epoch, sign, row, payload in entries:
+            self.table.insert((self._next_id, job, slot, side, epoch,
+                               fingerprint, sign, repr(tuple(row)),
+                               payload, "quarantined", time.time()))
+            self._next_id += 1
+            n += 1
+        if n:
+            self.table.commit(commit_epoch)
+        return n
+
+    def entries(self, job: Optional[str] = None,
+                status: Optional[str] = None) -> List[Tuple]:
+        return sorted(tuple(r) for r in self.table.iter_all()
+                      if (job is None or r[1] == job)
+                      and (status is None or r[9] == status))
+
+    def mark(self, ids, status: Optional[str], commit_epoch: int) -> int:
+        """Flip entries to `status` (None = purge them outright)."""
+        by_id = {int(r[0]): tuple(r) for r in self.table.iter_all()}
+        n = 0
+        for i in ids:
+            r = by_id.get(int(i))
+            if r is None:
+                continue
+            self.table.delete(r)
+            if status is not None:
+                self.table.insert(r[:9] + (status, r[10]))
+            n += 1
+        if n:
+            self.table.commit(commit_epoch)
+        return n
 
 
 def _plain_column_calls(calls, kinds) -> bool:
@@ -207,6 +290,11 @@ class FragmentSupervisor:
         self.attempts = [0] * len(rset.workers)
         self.respawns = 0
         self.reaped = 0
+        self.quarantined = 0
+        # per-slot (window fingerprint, consecutive same-window deaths):
+        # the poison-pill detector's memory
+        self._poison: List[Tuple[Optional[str], int]] = \
+            [(None, 0)] * len(rset.workers)
         self._escalated: Optional[RemoteWorkerDied] = None
 
     def check(self) -> None:
@@ -214,6 +302,7 @@ class FragmentSupervisor:
             raise self._escalated
         s = self.rset
         factor = ROBUSTNESS.wedge_kill_factor
+        victims: List[int] = []
         for i in range(len(s.workers)):
             ch, w = s.channels[i], s.workers[i]
             rc = w.proc.poll()
@@ -235,12 +324,17 @@ class FragmentSupervisor:
                     "wedged workers SIGKILLed by the supervisor").inc()
                 w.proc.kill()
             if dead or wedged:
-                try:
-                    self._recover(i)
-                finally:
+                victims.append(i)
+        if victims:
+            try:
+                self._recover_batch(victims)
+            finally:
+                for i in victims:
                     s._reaping[i] = False
 
     def _escalate(self, msg: str, reason: str) -> None:
+        assert reason in ESCALATION_REASONS, \
+            f"unregistered escalation reason {reason!r}"
         REGISTRY.counter("supervisor_escalations_total",
                          "supervised fragments handed to full recovery",
                          labels=("reason",)).labels(reason).inc()
@@ -251,37 +345,82 @@ class FragmentSupervisor:
         raise err
 
     def _recover(self, i: int) -> None:
+        self._recover_batch([i])
+
+    def _recover_batch(self, victims: List[int]) -> None:
+        """Coordinated respawn of EVERY dead/wedged slot in one pass —
+        two (or N) simultaneous worker deaths converge in place instead
+        of escalating. Phases:
+
+        1. escalation gates per victim (job stop, attempt bound);
+        2. QUIESCE every victim first — kill, reap, join its drain —
+           so no victim's stale drain thread can mutate a channel while
+           another victim's replay is already in flight;
+        3. capture every victim's retained undelivered window (and run
+           the poison-pill detector over it — see `_poison_check`);
+        4. ONE shared shadow scan per input side (the shared rollback
+           horizon): each victim re-seeds from its hash partition of the
+           same scan instead of N redundant full-table walks;
+        5. re-seed the victims in slot order and swap them in.
+
+        Escalation remains only for genuinely lost state (shadow
+        mismatch, unkillable processes, exhausted attempts)."""
+        s = self.rset
+        n_in = len(s.dispatchers)
+        lb = s.dispatchers[0].last_barrier
+        if lb is not None and lb.is_stop():
+            pids = ",".join(str(s.workers[i].proc.pid) for i in victims)
+            self._escalate(
+                f"worker pid(s)={pids} died during job stop", "stop")
+        for i in victims:
+            if self.attempts[i] >= max(1, ROBUSTNESS.respawn_attempts):
+                self._escalate(
+                    f"worker slot {i} kept dying "
+                    f"({self.attempts[i]} respawns exhausted)",
+                    "respawns_exhausted")
+            self.attempts[i] += 1
+        # ---- phase 2: quiesce ALL victims before any reseed ----------
+        for i in victims:
+            w = s.workers[i]
+            if w.proc.poll() is None:
+                w.proc.kill()
+            try:
+                w.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self._escalate(f"worker pid={w.proc.pid} is unkillable",
+                               "unkillable")
+            if w.drain_thread is not None:
+                w.drain_thread.join(timeout=10)
+                if w.drain_thread.is_alive():
+                    self._escalate("old result drain did not stop",
+                                   "drain_stuck")
+        time.sleep(min(1.0, ROBUSTNESS.respawn_backoff_s
+                       * (2 ** (max(self.attempts[i]
+                                    for i in victims) - 1))))
+        # ---- phase 3: windows + poison-pill detection ----------------
+        lasts: Dict[int, int] = {}
+        windows: Dict[int, List[List[Any]]] = {}
+        for i in victims:
+            w = s.workers[i]
+            last = -1 if w.last_epoch is None else w.last_epoch
+            lasts[i] = last
+            replays = [s.in_channels[side][i].replay_for(last)
+                       for side in range(n_in)]
+            windows[i] = self._poison_check(i, replays)
+        # ---- phase 4: one shared shadow scan per side ----------------
+        shared: Optional[List[List[Tuple]]] = None
+        if s.kind in ("stateful", "join") and s.seed_tables:
+            shared = [s._shadow_rows(side) for side in range(n_in)]
+        # ---- phase 5: reseed in slot order ---------------------------
+        for i in sorted(victims):
+            self._reseed(i, lasts[i], windows[i], shared)
+
+    def _reseed(self, i: int, last: int, replays: List[List[Any]],
+                shared: Optional[List[List[Tuple]]]) -> None:
         s = self.rset
         w = s.workers[i]
         ch_out = s.channels[i]
         n_in = len(s.dispatchers)
-        lb = s.dispatchers[0].last_barrier
-        if lb is not None and lb.is_stop():
-            self._escalate(
-                f"worker pid={w.proc.pid} died during job stop", "stop")
-        if self.attempts[i] >= max(1, ROBUSTNESS.respawn_attempts):
-            self._escalate(
-                f"worker slot {i} kept dying "
-                f"({self.attempts[i]} respawns exhausted)",
-                "respawns_exhausted")
-        self.attempts[i] += 1
-        # quiesce the old worker: reap the process, wait out its drain
-        # thread (the dead socket errors it out promptly) so nothing can
-        # mutate the result channel after we reset it
-        if w.proc.poll() is None:
-            w.proc.kill()
-        try:
-            w.proc.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            self._escalate(f"worker pid={w.proc.pid} is unkillable",
-                           "unkillable")
-        if w.drain_thread is not None:
-            w.drain_thread.join(timeout=10)
-            if w.drain_thread.is_alive():
-                self._escalate("old result drain did not stop",
-                               "drain_stuck")
-        time.sleep(min(1.0, ROBUSTNESS.respawn_backoff_s
-                       * (2 ** (self.attempts[i] - 1))))
         # fresh input channel(s) under fresh ids: the old ids stay
         # claimed on the server, so a half-dead predecessor can never
         # splice itself into the successor's stream
@@ -302,7 +441,6 @@ class FragmentSupervisor:
                 retain_epochs=old_ins[side].retain_epochs))
             plan["in_channel" if side == 0 else "in_channel_r"] = cid
         nw = None
-        last = -1 if w.last_epoch is None else w.last_epoch
         seeding = s.kind in ("stateful", "join")
         if not seeding:
             # stateless: seed-free respawn + retained-window replay
@@ -310,10 +448,11 @@ class FragmentSupervisor:
                 nw = _spawn_worker(plan)
             except RemoteWorkerDied as e:
                 self._escalate(str(e), "spawn_failed")
-            for msg in old_ins[0].replay_for(last):
+            for msg in replays[0]:
                 new_ins[0].send(msg)
         else:
-            nw = self._respawn_stateful(i, plan, old_ins, new_ins, last)
+            nw = self._respawn_stateful(i, plan, new_ins, last, replays,
+                                        shared)
         nw.last_epoch = w.last_epoch
         # swap into the live topology (we run on the merge thread, so the
         # dispatchers are quiescent during the swap)
@@ -341,8 +480,116 @@ class FragmentSupervisor:
                          "in-place worker respawns", labels=("kind",)
                          ).labels(s.kind).inc()
 
-    def _respawn_stateful(self, i: int, plan: Dict, old_ins, new_ins,
-                          last: int) -> _WorkerHandle:
+    # ---- poison-pill quarantine -----------------------------------------
+    @staticmethod
+    def _window_fingerprint(replays: List[List[Any]]) -> str:
+        """Stable digest of one retained undelivered window — the
+        identity the poison detector compares across consecutive deaths
+        of one slot (same window kills the successor too => the INPUT is
+        the problem, not the process)."""
+        h = hashlib.sha1()
+        for side, msgs in enumerate(replays):
+            for msg in msgs:
+                if isinstance(msg, Barrier):
+                    h.update(b"B%d;%d" % (side, msg.epoch.curr))
+                elif isinstance(msg, StreamChunk):
+                    for op, row in msg.compact().op_rows():
+                        h.update(repr((side, op.sign, tuple(row)))
+                                 .encode())
+        return h.hexdigest()[:16]
+
+    def _poison_check(self, i: int,
+                      replays: List[List[Any]]) -> List[List[Any]]:
+        """Poison-pill detector: fingerprint slot i's retained window;
+        after `RW_POISON_THRESHOLD` consecutive deaths on the SAME
+        window, sideline its data into the durable dead-letter queue and
+        return a barriers-only window — the respawn re-seeds, re-aligns
+        every missed epoch, and the job makes progress past the poison.
+        Bounded data loss with a full audit trail (`rw_dead_letter`,
+        `risectl dlq` list/requeue/purge) instead of a wedged-forever
+        fragment. The quarantined rows are also UN-APPLIED from the live
+        shadow tables, so coordinator state, worker state and the
+        downstream changelog stay consistent (the window never reached
+        downstream — epoch-atomic drains — so nothing there needs
+        repair)."""
+        s = self.rset
+        threshold = ROBUSTNESS.poison_threshold
+        has_data = any(isinstance(m, StreamChunk)
+                       for msgs in replays for m in msgs)
+        if threshold <= 0 or not has_data:
+            return replays
+        fpmt = self._window_fingerprint(replays)
+        prev, count = self._poison[i]
+        count = count + 1 if fpmt == prev else 1
+        self._poison[i] = (fpmt, count)
+        if count < threshold:
+            return replays
+        # ---- quarantine: record, scrub shadow, scrub window ----------
+        lb = s.dispatchers[0].last_barrier
+        commit_epoch = lb.epoch.curr if lb is not None else 0
+        entries: List[Tuple] = []
+        dropped: List[List[Tuple[int, Tuple]]] = []   # per side, in order
+        scrubbed: List[List[Any]] = []
+        for side, msgs in enumerate(replays):
+            keep: List[Any] = []
+            side_drop: List[Tuple[int, Tuple]] = []
+            pend: List[Tuple[int, Tuple]] = []
+            dtypes = s.in_dtypes[side]
+            for msg in msgs:
+                if isinstance(msg, StreamChunk):
+                    for op, row in msg.compact().op_rows():
+                        pend.append((op.sign, tuple(row)))
+                    continue
+                if isinstance(msg, Barrier):
+                    for sign, row in pend:
+                        entries.append((side, msg.epoch.curr, sign, row,
+                                        encode_row(row, dtypes)))
+                        side_drop.append((sign, row))
+                    pend = []
+                    keep.append(msg)
+                else:
+                    keep.append(msg)      # watermarks ride along
+            for sign, row in pend:        # open-epoch tail (no barrier yet)
+                entries.append((side, -1, sign, row,
+                                encode_row(row, dtypes)))
+                side_drop.append((sign, row))
+            dropped.append(side_drop)
+            scrubbed.append(keep)
+        dlq = getattr(s, "dead_letter", None)
+        job = getattr(s, "job_name", "") or ""
+        if dlq is not None:
+            dlq.quarantine(job, i, entries, fpmt, commit_epoch)
+        # un-apply the sidelined rows from the live shadows, in reverse
+        # (the exact inverse of what TeeState applied), so the next seed
+        # — this respawn's AND any later one's — excludes them
+        if s.seed_tables:
+            for side, side_drop in enumerate(dropped):
+                table = s.seed_tables[side] \
+                    if side < len(s.seed_tables) else None
+                if table is None:
+                    continue
+                pad = (0,) * (s.seed_strips[side] if s.seed_strips else 0)
+                for sign, row in reversed(side_drop):
+                    if sign > 0:
+                        table.delete(tuple(row) + pad)
+                    else:
+                        table.insert(tuple(row) + pad)
+        n = len(entries)
+        self.quarantined += n
+        REGISTRY.counter(
+            "supervisor_quarantined_total",
+            "input records sidelined into rw_dead_letter by the "
+            "poison-pill detector", labels=("job",)).labels(job).inc(n)
+        # quarantine IS progress: the slot starts a fresh respawn budget
+        # and a fresh poison history
+        self.attempts[i] = 1
+        self._poison[i] = (None, 0)
+        return scrubbed
+
+    def _respawn_stateful(self, i: int, plan: Dict, new_ins, last: int,
+                          replays: List[List[Any]],
+                          shared: Optional[List[List[Tuple]]]
+                          ) -> _WorkerHandle:
         """Respawn a stateful (owned-group agg or two-input join) worker.
 
         Incremental (default): seed every input side with the shadow
@@ -359,7 +606,14 @@ class FragmentSupervisor:
         no refresh to lean on, so a mismatch escalates)."""
         s = self.rset
         n_in = len(s.dispatchers)
-        replays = [old_ins[side].replay_for(last) for side in range(n_in)]
+
+        def part(side: int) -> List[Tuple]:
+            # victim's hash partition of the shared shadow scan (batch
+            # recovery walks each side's table once for ALL victims)
+            if shared is not None:
+                return s._partition_rows(side, shared[side], i)
+            return s.seed_rows(side, i)
+
         if last < 0:
             # never delivered a barrier: the retained window IS the
             # complete input stream (trims only happen on delivery) —
@@ -378,7 +632,7 @@ class FragmentSupervisor:
         if ROBUSTNESS.incremental_refresh:
             seeds = []
             for side in range(n_in):
-                rows = s.seed_rows(side, i)
+                rows = part(side)
                 asof = s.unapply_window(side, rows, replays[side])
                 if asof is None:
                     seeds = None
@@ -420,7 +674,7 @@ class FragmentSupervisor:
                 new_ins[side].send(seed_b)
             self._send_window(i, new_ins, replays)
         else:
-            rows0 = s.seed_rows(0, i)
+            rows0 = part(0)
             for chunk in _chunks_from_rows(s.in_dtypes[0], rows0):
                 new_ins[0].send(chunk)
                 s.heartbeats[i] = time.time()
@@ -486,6 +740,12 @@ class _RemoteSetBase:
     seed_tables: Optional[List[Any]] = None
     seed_strips: Sequence[int] = ()
     group_count = 0                    # output group-key width (hash_agg)
+    # stamped by the Database after CREATE: the owning streaming job's
+    # name and the process's durable dead-letter queue — the poison-pill
+    # quarantine's audit/metric identity (empty/None = standalone sets,
+    # e.g. unit tests, which quarantine without the durable record)
+    job_name: str = ""
+    dead_letter: Optional[DeadLetterQueue] = None
 
     def _finish_init(self, supervise: bool) -> None:
         from collections import deque
@@ -697,16 +957,22 @@ class _RemoteSetBase:
                     "replays the fragments)")
 
     # ---- seeds (stateful sets) -----------------------------------------
-    def seed_rows(self, side: int, i: int) -> List[Tuple]:
-        """Worker i's partition of the coordinator shadow table —
-        exactly the rows the hash dispatcher would have routed to it
-        (same vnode map, so respawn ownership matches)."""
+    def _shadow_rows(self, side: int) -> List[Tuple]:
+        """ONE full scan of a side's shadow table, stripped of filler
+        columns — batch recovery partitions this single scan for every
+        victim instead of re-walking the table per slot."""
         table = self.seed_tables[side] if self.seed_tables else None
         if table is None:
             return []
         strip = self.seed_strips[side] if self.seed_strips else 0
-        rows = [tuple(r)[:-strip] if strip else tuple(r)
+        return [tuple(r)[:-strip] if strip else tuple(r)
                 for r in table.iter_all()]
+
+    def _partition_rows(self, side: int, rows: List[Tuple],
+                        i: int) -> List[Tuple]:
+        """Worker i's hash partition of a side's (already scanned)
+        shadow rows — exactly the rows the dispatcher would have routed
+        to it (same vnode map, so respawn ownership matches)."""
         disp = self.dispatchers[side]
         dtypes = self.in_dtypes[side]
         out: List[Tuple] = []
@@ -720,6 +986,49 @@ class _RemoteSetBase:
             out.extend(r for r, keep in zip(rows[lo:lo + 4096], vis)
                        if keep)
         return out
+
+    def seed_rows(self, side: int, i: int) -> List[Tuple]:
+        """Worker i's partition of the coordinator shadow table."""
+        return self._partition_rows(side, self._shadow_rows(side), i)
+
+    def requeue_rows(self, side: int, pairs: List[Tuple[int, Tuple]]) -> int:
+        """Re-inject previously quarantined input rows (`risectl dlq
+        requeue`): re-apply them to the side's shadow (future respawns
+        must see them again) and route each row to its key-owning
+        worker's input channel — between barriers, exactly like live
+        stream data, so the next epoch's output states them exactly
+        once. Caller runs on the coordinator thread between ticks (the
+        dispatchers are quiescent)."""
+        disp = self.dispatchers[side]
+        dtypes = self.in_dtypes[side]
+        table = self.seed_tables[side] \
+            if self.seed_tables and side < len(self.seed_tables) else None
+        pad = (0,) * (self.seed_strips[side] if self.seed_strips else 0)
+        by_worker: Dict[int, List[Tuple[Any, Tuple]]] = {}
+        for lo in range(0, len(pairs), 4096):
+            batch = pairs[lo:lo + 4096]
+            chunk = StreamChunk.from_rows(
+                dtypes, [(Op.INSERT if sgn > 0 else Op.DELETE, tuple(r))
+                         for sgn, r in batch])
+            vn = compute_vnodes(
+                [chunk.columns[j] for j in disp.key_indices],
+                vnode_count=disp.vnode_count)
+            owners = disp.vnode_to_out[vn]
+            for (sgn, row), wi in zip(batch, owners):
+                by_worker.setdefault(int(wi), []).append(
+                    (Op.INSERT if sgn > 0 else Op.DELETE, tuple(row)))
+                if table is not None:
+                    if sgn > 0:
+                        table.insert(tuple(row) + pad)
+                    else:
+                        table.delete(tuple(row) + pad)
+        n = 0
+        for wi, oprows in by_worker.items():
+            for lo in range(0, len(oprows), 4096):
+                self.in_channels[side][wi].send(StreamChunk.from_rows(
+                    dtypes, oprows[lo:lo + 4096]))
+            n += len(oprows)
+        return n
 
     def _seed_key(self, side: int):
         """Row-identity key function of a shadow side: the shadow

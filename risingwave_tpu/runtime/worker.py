@@ -42,6 +42,52 @@ from .exchange_net import ExchangeServer, MetricsFrame, RemoteInput
 
 declare("worker.crash",
         "hard-kill the worker process mid-stream (os._exit per message)")
+declare("worker.poison_pill",
+        "content-triggered hard kill: RW_POISON_PILL='<col>:<value>' "
+        "kills the worker on any INPUT row whose column <col> stringifies"
+        " to <value> — the deterministic poison-pill chaos seam (respawns"
+        " inherit the env, so replaying the same window re-kills until "
+        "the supervisor quarantines it)")
+
+
+def _poison_spec() -> Optional[tuple]:
+    """Parse RW_POISON_PILL='<col index>:<value>' once per process."""
+    spec = os.environ.get("RW_POISON_PILL")
+    if not spec:
+        return None
+    col, _, val = spec.partition(":")
+    try:
+        return int(col), val
+    except ValueError:
+        return None
+
+
+from ..ops.executor import Executor as _Executor
+
+
+class _PoisonGate(_Executor):
+    """Input-side shim: hard-kills the process (like a real data-
+    dependent crash — a decode bug, a kernel assert) the moment a
+    matching row is INGESTED, before the fragment executor ever sees it.
+    Wraps the worker's RemoteInput(s); active only when RW_POISON_PILL
+    is set, so production ingestion pays nothing."""
+
+    def __init__(self, input, col: int, val: str):
+        super().__init__(input.schema, "PoisonGate")
+        self.append_only = input.append_only
+        self.input = input
+        self.col = col
+        self.val = val
+
+    def execute(self):
+        from ..core.chunk import StreamChunk
+        for msg in self.input.execute():
+            if isinstance(msg, StreamChunk):
+                for _op, row in msg.compact().op_rows():
+                    if self.col < len(row) \
+                            and str(row[self.col]) == self.val:
+                        os._exit(3)     # hard death, like SIGKILL
+            yield msg
 
 
 class HeartbeatTimer:
@@ -210,6 +256,14 @@ def main(argv: List[str]) -> int:
                                 _schema(plan["in_schema_r"]),
                                 append_only=plan.get("append_only_r",
                                                      False))
+    pp = _poison_spec()
+    if pp is not None:
+        # deterministic poison-pill chaos: die on ingestion of the
+        # matching row, every respawn, until the supervisor quarantines
+        # the window carrying it (fault-tolerance v3)
+        upstream = _PoisonGate(upstream, *pp)
+        if upstream2 is not None:
+            upstream2 = _PoisonGate(upstream2, *pp)
     execu = build_fragment(plan, upstream, upstream2)
     server = ExchangeServer()
     out = server.register(0, execu.schema.dtypes)

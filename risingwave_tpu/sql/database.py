@@ -50,6 +50,11 @@ WIRE_SESSION = threading.local()
 DDL_LOG_TABLE_ID = 0
 DDL_LOG_DTYPES = (T.INT64, T.VARCHAR)
 DDL_LOG_PK = (0,)
+# durable poison-pill dead-letter queue (fault-tolerance v3): a reserved
+# table id far above anything the catalog allocates, shared by every job
+# in the directory (rows carry the job name) and readable standalone by
+# `risectl dlq` without a Database
+DLQ_TABLE_ID = 0x7EAD
 
 
 class _Backfill(Executor):
@@ -91,7 +96,11 @@ class _Backfill(Executor):
 
 
 def _walk_executors(root) -> Iterator[Any]:
-    """Walk an executor tree through the common child attributes."""
+    """Walk an executor tree through the common child attributes.
+    `pumps` descends through a Merge's upstream dispatchers into NESTED
+    remote fragment sets (an agg set fed by a join set) — without it the
+    liveness sweep, EXPLAIN ANALYZE and the dead-letter wiring only saw
+    the topmost set of a multi-set topology."""
     stack = [root]
     seen = set()
     while stack:
@@ -100,7 +109,8 @@ def _walk_executors(root) -> Iterator[Any]:
             continue
         seen.add(id(e))
         yield e
-        for attr in ("input", "left_exec", "right_exec", "port", "inputs"):
+        for attr in ("input", "left_exec", "right_exec", "port",
+                     "inputs", "pumps"):
             v = getattr(e, attr, None)
             if isinstance(v, list):
                 stack.extend(v)
@@ -191,6 +201,13 @@ class Database:
         self._ddl_log = StateTable(self.store, DDL_LOG_TABLE_ID,
                                    list(DDL_LOG_DTYPES), list(DDL_LOG_PK))
         self._ddl_seq = 0
+        # poison-pill dead-letter queue (rw_dead_letter / risectl dlq):
+        # durable through the same store as everything else, created
+        # BEFORE catalog recovery so replayed jobs wire into it
+        from ..runtime.remote_fragments import DeadLetterQueue
+        self._dlq = DeadLetterQueue(StateTable(
+            self.store, DLQ_TABLE_ID, list(DeadLetterQueue.DTYPES),
+            list(DeadLetterQueue.PK)))
         self._replaying = False
         self._recover_catalog()
 
@@ -659,6 +676,15 @@ class Database:
         self._pending_subs = []
         self.catalog.create(obj)
         self._iters[stmt.name] = obj.runtime["port"].execute()
+        # stamp every remote worker set in the plan with its owning job
+        # name + this process's dead-letter queue: the poison-pill
+        # quarantine's audit identity (rw_dead_letter rows, the
+        # supervisor_quarantined_total{job} label, risectl dlq routing)
+        for e in _walk_executors(shared.upstream):
+            r = getattr(e, "_remote", None)
+            if r is not None:
+                r.job_name = stmt.name
+                r.dead_letter = self._dlq
         return "CREATE_MATERIALIZED_VIEW"
 
     def _explain(self, inner: Any) -> str:
@@ -881,8 +907,16 @@ class Database:
             # arms the sink-boundary dedupe: post-respawn refreshes may
             # re-state rows the changelog already carries, and the MV's
             # by-pk reconciliation doesn't reach external files
+            # durable per-pk mirror journal (fault-tolerance v3): the
+            # delivered mirror persists through this table with epoch-
+            # fenced commits, so a coordinator restart rebuilds it and a
+            # refresh racing the crash cannot duplicate into the file
+            mirror_table = StateTable(
+                self.store, self.catalog.alloc_table_id(),
+                [T.BYTEA, T.INT64, T.BYTEA], [0]) if sink_pk else None
             sink_exec = SinkExecutor(execu, sink, log_table=log_table,
-                                     pk_indices=sink_pk)
+                                     pk_indices=sink_pk,
+                                     mirror_table=mirror_table)
             obj.runtime = {"sink": sink, "sink_exec": sink_exec,
                            "collect": None,
                            "state_table": None, "shared": None,
@@ -1201,6 +1235,56 @@ class Database:
                         f"{name!r} exited rc="
                         f"{w.proc.returncode} (heartbeat sweep; "
                         "restart the job — DDL replay rebuilds it)")
+
+    # ------------------------------------------------------------------
+    # dead-letter queue (poison-pill quarantine surface)
+    # ------------------------------------------------------------------
+    def dlq_requeue(self, job: str, ids: Optional[Sequence[int]] = None
+                    ) -> int:
+        """Re-inject quarantined input rows of `job` back into its live
+        remote worker sets (risectl `dlq --requeue`): decode each
+        payload, re-apply it to the shadow, route it to its key-owning
+        worker, and flip the entry to status='requeued'. Returns the row
+        count. Call between ticks; the next barrier states the rows
+        downstream exactly once."""
+        from ..core.encoding import decode_row
+        ents = self._dlq.entries(job=job, status="quarantined")
+        if ids is not None:
+            idset = {int(x) for x in ids}
+            ents = [e for e in ents if int(e[0]) in idset]
+        if not ents:
+            return 0
+        rset = None
+        for name, r in self._remote_sets():
+            if name == job:
+                rset = r
+                break
+        if rset is None:
+            raise ValueError(
+                f"no live remote worker set for job {job!r} "
+                "(fused/local jobs have no dead-letter consumers)")
+        n = 0
+        by_side: Dict[int, List[Tuple[int, Tuple]]] = {}
+        for e in ents:
+            side = int(e[3])
+            row = decode_row(e[8], list(rset.in_dtypes[side]))
+            by_side.setdefault(side, []).append((int(e[6]), tuple(row)))
+        for side, pairs in by_side.items():
+            n += rset.requeue_rows(side, pairs)
+        self._dlq.mark([e[0] for e in ents], "requeued",
+                       self.injector.epoch.curr)
+        return n
+
+    def dlq_purge(self, job: str, ids: Optional[Sequence[int]] = None
+                  ) -> int:
+        """Drop dead-letter entries of `job` outright (audit closed,
+        data loss accepted)."""
+        ents = self._dlq.entries(job=job)
+        if ids is not None:
+            idset = {int(x) for x in ids}
+            ents = [e for e in ents if int(e[0]) in idset]
+        return self._dlq.mark([e[0] for e in ents], None,
+                              self.injector.epoch.curr)
 
     def metrics(self) -> str:
         """Prometheus text exposition (MonitorService analog)."""

@@ -135,7 +135,27 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("ordinal", T.INT64), ("key", T.INT64),
                   ("value", T.INT64), ("share", T.FLOAT64)),
         lambda db: _key_skew(db)),
+    # poison-pill dead-letter queue (fault-tolerance v3): one row per
+    # input record the supervisor sidelined after bounded respawns kept
+    # dying on the same retained window. The full audit trail of the
+    # bounded data loss — `risectl dlq <job>` lists/requeues/purges the
+    # same rows. epoch=-1 marks the open (not-yet-barriered) tail of the
+    # quarantined window; status walks quarantined -> requeued.
+    "rw_dead_letter": (
+        Schema.of(("id", T.INT64), ("job", T.VARCHAR), ("slot", T.INT64),
+                  ("side", T.INT64), ("epoch", T.INT64),
+                  ("fingerprint", T.VARCHAR), ("sign", T.INT64),
+                  ("row", T.VARCHAR), ("status", T.VARCHAR),
+                  ("ts", T.FLOAT64)),
+        lambda db: _dead_letter(db)),
 }
+
+
+def _dead_letter(db) -> List[Tuple]:
+    # project the binary payload column out — the system-table view is
+    # the human-readable audit surface; exact bytes stay in the store
+    return [(r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7], r[9], r[10])
+            for r in db._dlq.entries()]
 
 
 def _epoch_profile(db) -> List[Tuple]:

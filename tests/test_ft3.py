@@ -1,0 +1,498 @@
+"""Fault-tolerance v3 chaos suite.
+
+The four tentpoles of the v3 round, each asserted end to end and
+ledger-replayable (seeded failpoints / deterministic poison content):
+
+* **In-place fused-job recovery** — a device-path failure mid-epoch
+  (armed `fused.*` failpoint) heals WITHOUT a DDL-replay restart: state
+  rebuilds from the last checkpoint, the crash-window epochs re-dispatch
+  from the coordinator-side epoch event log, and the whole recovery runs
+  on AOT-cached executables (zero fresh compiles), bit-identical.
+* **Poison-pill quarantine** — a deterministic poison chunk that
+  re-kills every respawn is sidelined into the durable `rw_dead_letter`
+  table after bounded respawns; the job keeps making progress, and
+  `risectl dlq` lists/requeues the sidelined rows.
+* **Coordinated multi-failure respawn** — two simultaneous worker kills
+  (both workers of one set; one worker each of a join set AND its
+  downstream agg set) converge in place, bit-identical, zero
+  escalations.
+* **Durable sink journal** — across a coordinator kill + restart, a
+  post-respawn (v1 full) refresh delivers ZERO duplicate rows to a file
+  sink: the per-pk delivered mirror is rebuilt from the journaled sink
+  log.
+"""
+import json
+import os
+
+import pytest
+
+from risingwave_tpu.config import ROBUSTNESS, DeviceConfig
+from risingwave_tpu.sql import Database
+from risingwave_tpu.sql.database import _walk_executors
+from risingwave_tpu.utils import failpoint as fp
+
+pytestmark = pytest.mark.chaos
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='{c}')")
+Q1_MV = ("CREATE MATERIALIZED VIEW q1a AS SELECT bidder, count(*) AS n,"
+         " sum(price) AS dol, max(price) AS top FROM bid GROUP BY bidder")
+AUCTION_SRC = ("CREATE SOURCE auction (id BIGINT, item_name VARCHAR,"
+               " description VARCHAR, initial_bid BIGINT, reserve BIGINT,"
+               " date_time TIMESTAMP, expires TIMESTAMP, seller BIGINT,"
+               " category BIGINT, extra VARCHAR) WITH (connector='nexmark',"
+               " nexmark.table='auction', nexmark.max.events='{n}',"
+               " nexmark.chunk.size='{c}')")
+PERSON_SRC = ("CREATE SOURCE person (id BIGINT, name VARCHAR,"
+              " email_address VARCHAR, credit_card VARCHAR, city VARCHAR,"
+              " state VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
+              " WITH (connector='nexmark', nexmark.table='person',"
+              " nexmark.max.events='{n}', nexmark.chunk.size='{c}')")
+
+
+@pytest.fixture(autouse=True)
+def _fast_and_clean():
+    saved = (ROBUSTNESS.respawn_backoff_s, ROBUSTNESS.spawn_backoff_s,
+             ROBUSTNESS.poison_threshold, ROBUSTNESS.incremental_refresh,
+             ROBUSTNESS.fused_recovery_attempts)
+    ROBUSTNESS.respawn_backoff_s = 0.001
+    ROBUSTNESS.spawn_backoff_s = 0.001
+    fp.reset()
+    yield
+    fp.reset()
+    (ROBUSTNESS.respawn_backoff_s, ROBUSTNESS.spawn_backoff_s,
+     ROBUSTNESS.poison_threshold, ROBUSTNESS.incremental_refresh,
+     ROBUSTNESS.fused_recovery_attempts) = saved
+
+
+def remotes_of(db, name):
+    out = []
+    shared = db.catalog.get(name).runtime["shared"]
+    for e in _walk_executors(shared.upstream):
+        r = getattr(e, "_remote", None)
+        if r is not None:
+            out.append(r)
+    return out
+
+
+def kill_on_next_chunk(rset, victim, side=0):
+    """Hook the victim's input channel: hard-kill the worker right after
+    its next dispatched data chunk (mid-epoch, deterministic by
+    construction)."""
+    from risingwave_tpu.core.chunk import StreamChunk
+    vin = rset.in_channels[side][victim]
+    orig = vin.send
+
+    def send_and_kill(msg):
+        orig(msg)
+        if isinstance(msg, StreamChunk):
+            vin.send = orig
+            rset.workers[victim].proc.kill()
+            rset.workers[victim].proc.wait()
+    vin.send = send_and_kill
+
+
+# ---------------------------------------------------------------------------
+# tentpole 1: in-place fused-job recovery (no DDL replay, bit-identical)
+# ---------------------------------------------------------------------------
+
+N, CHUNK = 4096, 32                     # fused epoch cadence = 2048
+TICKS = N // 2048 + 3
+
+
+def _fused_q1(arm=None, capacity=512, aot=False, buckets=4):
+    db = Database(device=DeviceConfig(capacity=capacity, aot_compile=aot,
+                                      compile_buckets=buckets))
+    db.run(BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q1_MV)
+    job = db.catalog.get("q1a").runtime["fused_job"]
+    assert job is not None
+    if arm:
+        fp.arm(*arm)
+    for _ in range(TICKS):
+        db.tick()
+    rows = db.query("SELECT * FROM q1a")
+    fp.reset()
+    return rows, job, db
+
+
+@pytest.mark.parametrize("point", ["fused.dispatch", "fused.device_sync",
+                                   "fused.checkpoint_commit"])
+def test_fused_device_fault_recovers_in_place_bit_identical(point):
+    """A fused.* failpoint mid-run (fires once, seeded — the
+    ledger-replayable arming style) must heal IN PLACE: same job object
+    (no DDL replay), exactly one recovery, and the final MV bit-identical
+    to an undisturbed run."""
+    want, j0, _ = _fused_q1()
+    assert j0.recoveries == 0
+    got, job, db = _fused_q1(arm=(point, 1.0, 0, 1))
+    assert job.recoveries == 1, point
+    assert db._fused["q1a"] is job, "in-place recovery must not rebuild"
+    assert got == want, point
+    from risingwave_tpu.utils.metrics import REGISTRY
+    assert "fused_recoveries_total" in REGISTRY.expose()
+
+
+def test_fused_growth_replay_fault_recovers():
+    """A fault during a capacity growth replay (tiny capacity forces
+    growth) recovers in place at the GROWN capacities and still matches
+    the undisturbed run."""
+    want, _, _ = _fused_q1(capacity=4)
+    got, job, _ = _fused_q1(arm=("fused.growth_replay", 1.0, 0, 1),
+                            capacity=4)
+    assert job.recoveries >= 1
+    assert job.growth_replays >= 1
+    assert got == want
+
+
+@pytest.mark.aot
+def test_fused_recovery_is_zero_compile():
+    """The whole in-place recovery (history rebuild + crash-window
+    re-dispatch) runs on the AOT-cached executables: the compile
+    service's compile count must not move across the recovery.
+    compile_buckets=0 pins bucket pre-warm off so the only possible
+    compiles WOULD be recovery-induced retraces."""
+    from risingwave_tpu.device.compile_service import get_service
+    n2 = 8192                            # 4 epochs: warm 2, fault mid-run
+    db = Database(device=DeviceConfig(capacity=512, aot_compile=True,
+                                      compile_buckets=0))
+    db.run(BID_SRC.format(n=n2, c=CHUNK))
+    db.run(Q1_MV)
+    job = db.catalog.get("q1a").runtime["fused_job"]
+    db.tick()
+    db.tick()
+    assert not job.drained
+    svc = get_service()
+    assert svc.wait_idle(120.0)
+    before = svc.summary()["compiles"]
+    fp.arm("fused.dispatch", 1.0, 0, 1)
+    try:
+        for _ in range(n2 // 2048 + 3):
+            db.tick()
+        job.sync()
+    finally:
+        fp.reset()
+    assert job.recoveries == 1
+    assert svc.wait_idle(120.0)
+    assert svc.summary()["compiles"] == before, \
+        "in-place fused recovery must be zero-compile"
+    # bit-identity vs an undisturbed run of the same stream
+    db2 = Database(device=DeviceConfig(capacity=512))
+    db2.run(BID_SRC.format(n=n2, c=CHUNK))
+    db2.run(Q1_MV)
+    for _ in range(n2 // 2048 + 3):
+        db2.tick()
+    assert db.query("SELECT * FROM q1a") == db2.query(
+        "SELECT * FROM q1a")
+
+
+def test_fused_recovery_attempts_bound_escalates():
+    """Past RW_FUSED_RECOVERY_ATTEMPTS the original device fault
+    propagates (the classic DDL-replay restart path owns it) instead of
+    looping forever."""
+    from risingwave_tpu.utils.failpoint import FailpointError
+    ROBUSTNESS.fused_recovery_attempts = 2
+    with pytest.raises(FailpointError):
+        _fused_q1(arm=("fused.dispatch", 1.0, 0, None))
+
+
+# ---------------------------------------------------------------------------
+# tentpole 2: poison-pill quarantine + rw_dead_letter + risectl dlq
+# ---------------------------------------------------------------------------
+
+
+def _agg_db(data_dir=None):
+    db = Database(data_dir=data_dir)
+    db.run("CREATE TABLE t (k BIGINT, v BIGINT)")
+    db.run("SET streaming_parallelism = 2")
+    db.run("SET streaming_placement = 'process'")
+    db.run("SET streaming_supervision TO true")
+    db.run("CREATE MATERIALIZED VIEW ra AS SELECT k, count(*) AS c,"
+           " sum(v) AS s FROM t GROUP BY k")
+    return db
+
+
+def test_poison_chunk_quarantined_job_progresses(tmp_path, monkeypatch):
+    """A deterministic poison row (RW_POISON_PILL content trigger —
+    every respawn inherits it and re-dies on the same replayed window)
+    is sidelined into rw_dead_letter after RW_POISON_THRESHOLD respawns;
+    the job keeps making progress past it, `risectl dlq` lists it, and
+    after the operator fixes the poison condition a requeue re-delivers
+    the sidelined rows exactly once."""
+    monkeypatch.setenv("RW_POISON_PILL", "1:666")   # v == 666 kills
+    d = str(tmp_path / "data")
+    db = _agg_db(data_dir=d)
+    rset = remotes_of(db, "ra")[0]
+    db.run("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    for _ in range(4):
+        db.tick()
+    db.run("INSERT INTO t VALUES (4, 666), (5, 50)")
+    for _ in range(8):
+        db.tick()
+    # the job made progress PAST the poison: the healthy row landed,
+    # the poisoned window is gone from the dataflow
+    got = sorted(db.query("SELECT * FROM ra"))
+    keys = [r[0] for r in got]
+    assert 5 in keys and 4 not in keys
+    assert rset.supervisor.quarantined >= 1
+    assert rset.supervisor._escalated is None
+    # audit trail: rw_dead_letter holds the sidelined rows, durable
+    dl = db.query("SELECT * FROM rw_dead_letter")
+    assert any(r[1] == "ra" and r[8] == "quarantined" and "666" in r[7]
+               for r in dl)
+    from risingwave_tpu.utils.metrics import REGISTRY
+    assert 'supervisor_quarantined_total{job="ra"}' in REGISTRY.expose()
+    # risectl dlq lists the same rows straight off the durable table
+    from risingwave_tpu import ctl
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = ctl.main(["dlq", "ra", "--data-dir", d])
+    assert rc == 0 and "666" in buf.getvalue() \
+        and "quarantined" in buf.getvalue()
+    # operator fixes the poison condition, requeues: rows re-deliver
+    monkeypatch.delenv("RW_POISON_PILL")
+    n = db.dlq_requeue("ra")
+    assert n >= 1
+    for _ in range(6):
+        db.tick()
+    got = sorted(db.query("SELECT * FROM ra"))
+    want = sorted(db.query("SELECT k, count(*), sum(v) FROM t GROUP BY k"))
+    assert got == want and any(r[0] == 4 for r in got)
+    assert all(r[8] == "requeued"
+               for r in db.query("SELECT * FROM rw_dead_letter"))
+    rset.shutdown()
+
+
+def test_poison_threshold_zero_disables_quarantine(monkeypatch):
+    """RW_POISON_THRESHOLD<=0 restores the pre-v3 behavior: the slot
+    burns its respawn budget and escalates."""
+    from risingwave_tpu.runtime.remote_fragments import RemoteWorkerDied
+    monkeypatch.setenv("RW_POISON_PILL", "1:666")
+    ROBUSTNESS.poison_threshold = 0
+    db = _agg_db()
+    rset = remotes_of(db, "ra")[0]
+    db.run("INSERT INTO t VALUES (1, 10)")
+    for _ in range(3):
+        db.tick()
+    with pytest.raises(RemoteWorkerDied):
+        db.run("INSERT INTO t VALUES (4, 666)")
+        for _ in range(12):
+            db.tick()
+    assert rset.supervisor.quarantined == 0
+    rset.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tentpole 3: coordinated multi-failure respawn (no escalation)
+# ---------------------------------------------------------------------------
+
+
+def _escalations():
+    from risingwave_tpu.utils.metrics import REGISTRY
+    return sum(float(ln.rsplit(" ", 1)[1])
+               for ln in REGISTRY.expose().splitlines()
+               if ln.startswith("supervisor_escalations_total{"))
+
+
+def test_two_simultaneous_join_worker_kills_converge():
+    """BOTH workers of one join set die in the same epoch: one
+    `_recover_batch` pass quiesces both first, re-seeds both from one
+    shared shadow scan, and the MV is bit-identical to an undisturbed
+    run — no escalation."""
+    n, chunk = 12_000, 64
+    ticks = n // (64 * chunk) + 4
+
+    def build(supervise):
+        db = Database()
+        db.run(AUCTION_SRC.format(n=n, c=chunk))
+        db.run(PERSON_SRC.format(n=n, c=chunk))
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        if supervise:
+            db.run("SET streaming_supervision TO true")
+        db.run("CREATE MATERIALIZED VIEW q3 AS SELECT p.name, p.city,"
+               " p.state, a.id FROM auction a JOIN person p"
+               " ON a.seller = p.id")
+        return db
+
+    db = build(supervise=False)
+    for _ in range(ticks):
+        db.tick()
+    want = sorted(db.query("SELECT * FROM q3"))
+    remotes_of(db, "q3")[0].shutdown()
+
+    base_esc = _escalations()
+    db = build(supervise=True)
+    rfs = remotes_of(db, "q3")[0]
+    assert rfs.kind == "join"
+    kill_on_next_chunk(rfs, 0)
+    kill_on_next_chunk(rfs, 1)
+    for _ in range(ticks):
+        db.tick()
+    assert rfs.supervisor.respawns == 2
+    assert rfs.supervisor._escalated is None
+    assert _escalations() == base_esc
+    assert sorted(db.query("SELECT * FROM q3")) == want
+    rfs.shutdown()
+
+
+def test_simultaneous_agg_and_join_worker_kills_converge():
+    """One worker of the JOIN set and one worker of its downstream AGG
+    set die in the same epoch — cross-set simultaneous failure. Each
+    set's supervisor converges its victim in place; zero escalations;
+    result bit-identical to the undisturbed oracle."""
+    n, chunk = 12_000, 64
+    ticks = n // (64 * chunk) + 4
+    mv = ("CREATE MATERIALIZED VIEW qa AS SELECT p.state, count(*) AS c"
+          " FROM auction a JOIN person p ON a.seller = p.id"
+          " GROUP BY p.state")
+
+    def build(supervise):
+        db = Database()
+        db.run(AUCTION_SRC.format(n=n, c=chunk))
+        db.run(PERSON_SRC.format(n=n, c=chunk))
+        db.run("SET streaming_parallelism = 2")
+        db.run("SET streaming_placement = 'process'")
+        if supervise:
+            db.run("SET streaming_supervision TO true")
+        db.run(mv)
+        return db
+
+    db = build(supervise=False)
+    for _ in range(ticks):
+        db.tick()
+    want = sorted(db.query("SELECT * FROM qa"))
+    for r in remotes_of(db, "qa"):
+        r.shutdown()
+
+    base_esc = _escalations()
+    db = build(supervise=True)
+    sets = remotes_of(db, "qa")
+    kinds = {r.kind for r in sets}
+    assert kinds == {"join", "stateful"}, \
+        f"plan must place BOTH fragment kinds remotely (got {kinds})"
+    for r in sets:
+        kill_on_next_chunk(r, 0)
+    for _ in range(ticks + 2):
+        db.tick()
+    for r in sets:
+        assert r.supervisor is not None
+        assert r.supervisor.respawns >= 1, r.kind
+        assert r.supervisor._escalated is None, r.kind
+    assert _escalations() == base_esc
+    assert sorted(db.query("SELECT * FROM qa")) == want
+    for r in sets:
+        r.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tentpole 4: durable sink journal across a coordinator kill + restart
+# ---------------------------------------------------------------------------
+
+
+def _changelog_net(path):
+    """Net row multiset of a jsonl changelog; multiplicities must never
+    go negative (a duplicate `+` inflates one; a stale `-` sinks one)."""
+    state = {}
+    for ln in open(path):
+        rec = json.loads(ln)
+        row = tuple(str(rec["row"][k]) for k in sorted(rec["row"]))
+        state[row] = state.get(row, 0) + (1 if rec["op"] == "+" else -1)
+        assert state[row] >= 0, f"negative multiplicity for {row}"
+        if state[row] == 0:
+            del state[row]
+    return sorted(r for row, cnt in state.items() for r in [row] * cnt)
+
+
+def test_sink_mirror_journal_survives_coordinator_restart(tmp_path):
+    """Coordinator kill + restart during a post-respawn refresh window:
+    the v1 FULL refresh re-states every owned group, and before v3 the
+    restarted coordinator's EMPTY in-memory mirror let those duplicates
+    straight into the file. Now the per-pk delivered mirror rebuilds
+    from the journaled sink log (epoch-fenced commits), so zero
+    duplicate rows reach the file."""
+    from risingwave_tpu.utils.metrics import REGISTRY
+    ROBUSTNESS.incremental_refresh = False    # the duplicate-generating
+    out = tmp_path / "out.jsonl"              # v1 full-refresh path
+    d = str(tmp_path / "data")
+    db = _agg_db(data_dir=d)
+    db.run(f"CREATE SINK snk FROM ra WITH (connector='fs',"
+           f" fs.path='{out}')")
+    db.run("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30), (4, 40)")
+    for _ in range(6):
+        db.tick()
+    assert _changelog_net(str(out)), "sink must have delivered"
+    # coordinator crash: drop the process state, keep the directory
+    for r in remotes_of(db, "ra"):
+        r.shutdown()
+    del db
+    # restart: DDL replay rebuilds the job + sink; the sink's delivered
+    # mirror must come back from the journal, NOT start empty
+    db2 = Database(data_dir=d)
+    sink_exec = db2.catalog.get("snk").runtime["sink_exec"]
+    assert sink_exec._mirror, "mirror must rebuild from the journal"
+    assert sink_exec.mirror_table is not None
+
+    def dropped():
+        for ln in REGISTRY.expose().splitlines():
+            if ln.startswith("sink_dedupe_dropped_total"):
+                return float(ln.rsplit(" ", 1)[1])
+        return 0.0
+
+    base_drop = dropped()
+    # post-restart respawn: the v1 full refresh re-INSERTs every owned
+    # group straight into the sink's changelog. Tick first so the
+    # victim DELIVERS post-restart barriers — the refresh path (not the
+    # never-delivered verbatim replay) is what must hit the mirror.
+    for _ in range(3):
+        db2.tick()
+    rset = remotes_of(db2, "ra")[0]
+    kill_on_next_chunk(rset, 0)
+    kill_on_next_chunk(rset, 1)       # whichever slot owns the new keys
+    db2.run("INSERT INTO t VALUES (1, 1), (5, 50)")
+    for _ in range(8):
+        db2.tick()
+    assert rset.supervisor.respawns >= 1
+    want = sorted(db2.query("SELECT * FROM ra"))
+    assert want == sorted(db2.query(
+        "SELECT k, count(*), sum(v) FROM t GROUP BY k"))
+    # exactly-once at the file: the changelog nets to the MV — no
+    # duplicate `+`, no negative multiplicity (asserted in the replay)
+    net = _changelog_net(str(out))
+    want_rows = sorted(tuple(str(v) for v in (r[1], r[0], r[2]))
+                       for r in want)
+    assert net == want_rows, (net, want_rows)
+    assert dropped() > base_drop, \
+        "the journal-rebuilt mirror must be what caught the refresh " \
+        "duplicates"
+    rset.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellites: fused failpoints listed; ledger records fused fires
+# ---------------------------------------------------------------------------
+
+
+def test_risectl_lists_fused_and_poison_failpoints(capsys):
+    from risingwave_tpu import ctl
+    rc = ctl.main(["failpoints"])
+    txt = capsys.readouterr().out
+    assert rc == 0
+    for point in ("fused.dispatch", "fused.device_sync",
+                  "fused.growth_replay", "fused.checkpoint_commit",
+                  "worker.poison_pill"):
+        assert point in txt, point
+
+
+def test_fused_fault_lands_in_ledger():
+    """Chaos runs over the fused path leave the same exact-replay
+    ledger record as host-path runs (`make chaos` ships it on failure)."""
+    fp.clear_ledger()
+    _fused_q1(arm=("fused.dispatch", 1.0, 0, 1))
+    assert any(p == "fused.dispatch" for _o, p, _t, _h in fp.ledger())
+    fp.clear_ledger()

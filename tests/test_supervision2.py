@@ -367,6 +367,40 @@ def test_no_duplicate_rows_reach_sink_across_respawn(tmp_path,
 
 
 # ---------------------------------------------------------------------------
+# escalation hygiene: every _escalate call site cites a registered reason
+# ---------------------------------------------------------------------------
+
+
+def test_escalation_reasons_registered_and_distinct():
+    """Every `_escalate` call site in remote_fragments must cite a
+    reason from the ESCALATION_REASONS registry (the
+    supervisor_escalations_total{reason} label values), every registered
+    reason must have a call site, and each reason carries its own
+    documentation — a dashboard must be able to tell WHY a fragment fell
+    back to full recovery from the label alone."""
+    import inspect
+    from risingwave_tpu.runtime import remote_fragments as rf
+    src = inspect.getsource(rf)
+    cited = re.findall(
+        r"_escalate\((?:[^()]|\([^()]*\))*?\"([a-z_]+)\"\)", src,
+        re.DOTALL)
+    assert cited, "no _escalate call sites found (regex rot?)"
+    assert set(cited) == set(rf.ESCALATION_REASONS), (
+        sorted(set(cited) ^ set(rf.ESCALATION_REASONS)))
+    # registry hygiene: distinct, documented, label-grammar-safe
+    assert len(rf.ESCALATION_REASONS) == len(set(rf.ESCALATION_REASONS))
+    for reason, doc in rf.ESCALATION_REASONS.items():
+        assert re.fullmatch(r"[a-z][a-z0-9_]*", reason), reason
+        assert doc and len(doc) > 10, reason
+    # the runtime enforces the registry too
+    db = _q3_db(1_000, 64)
+    rfs = find_remote(db, "q3")
+    with pytest.raises(AssertionError, match="unregistered"):
+        rfs.supervisor._escalate("x", "not_a_real_reason")
+    rfs.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # tentpole 4: ledger record/replay reproduces the fire sequence
 # ---------------------------------------------------------------------------
 
