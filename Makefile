@@ -8,12 +8,17 @@ PY ?= python
 CHAOS_LEDGER ?= /tmp/rw_chaos.ledger
 PYTEST_FLAGS ?= -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: tier1 chaos chaos-replay
+.PHONY: tier1 chaos chaos-replay bench-smoke
 
 # the tier-1 gate (ROADMAP "Tier-1 verify" without the log plumbing)
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) \
 		-m 'not slow' --continue-on-collection-errors
+
+# quick bench sanity (tiny scales, <2 min; includes the Zipfian skew_q4
+# sweep): results print as one JSON line, nothing is recorded
+bench-smoke:
+	$(PY) bench.py --smoke
 
 # chaos CI lane: every supervision/fault-injection test, ledger RECORDED
 # (the target removes a stale ledger first — an existing file would flip

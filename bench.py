@@ -613,6 +613,121 @@ def stage_shards_qx(n_events):
         n_events, QX_CHUNK, QX_CAPACITY, warm_pass=False)
 
 
+# ---------------------------------------------------------------------------
+# Zipfian skew sweep (ISSUE 13): power-law keys, defenses off vs on
+# ---------------------------------------------------------------------------
+
+
+def _skew_src(src_sql, s):
+    return src_sql.replace("connector='nexmark'",
+                           f"connector='nexmark', "
+                           f"nexmark.key.dist='zipf:{s}'")
+
+
+def _skew_pass(shards, defenses, mv_sqls, mv_names, srcs, n_events, chunk,
+               capacity, s, threshold):
+    """One Zipfian pass: eps, achieved shards, per-job skew report
+    (raw key skew_ratio, per-shard load ratio under the current routing
+    bounds, adopted policy counters), sorted MV rows for cross-verify."""
+    import time as _t
+    os.environ["RW_SKEW_STATS"] = "1"   # the defenses need the evidence
+    from risingwave_tpu.config import DeviceConfig
+    from risingwave_tpu.sql import Database
+    db = Database(device=DeviceConfig(capacity=capacity,
+                                      mesh_shards=shards,
+                                      mv_persist_every=MV_PERSIST_EVERY,
+                                      agg_precombine=defenses,
+                                      hot_key_rep=defenses,
+                                      vnode_rebalance=defenses,
+                                      rebalance_threshold=threshold),
+                  checkpoint_frequency=CKPT_EVERY)
+    for src in srcs:
+        db.run(_skew_src(src.format(n=n_events, c=chunk), s))
+    for mv in mv_sqls:
+        db.run(mv)
+    dt = drive(db, n_events, chunk=chunk)
+    jobs = db._fused
+    # let a staged routing policy (background pre-warm) adopt
+    for j in jobs.values():
+        for _ in range(100):
+            if j._pending_policy is None:
+                break
+            _t.sleep(0.1)
+            db.tick()
+    db.tick()
+    eff = max([j.mesh_shards for j in jobs.values()] or [1])
+    skew = {}
+    for name, j in jobs.items():
+        rep = j.skew_report()
+        ratios = [r[6] for r in rep if r[2] == "skew_ratio"]
+        shard_r = [r[6] for r in rep if r[2] == "shard_skew"]
+        # max per-epoch ICI send-bucket fill: pre-combine's wire win —
+        # one combined row per key per (shard, epoch) instead of every
+        # raw row — shows up directly here
+        exch_hw = max([r[5] for r in j.node_report() if r[2] == "exch"]
+                      or [0])
+        skew[name] = {
+            "skew_ratio": round(max(ratios or [0.0]), 3),
+            "shard_skew_ratio": round(max(shard_r or [0.0]), 3),
+            "rebalances": j.rebalances,
+            "hot_keys": sum(len(nd.hot_keys)
+                            for nd in j.program.nodes),
+            "exch_rows_high_water": int(exch_hw),
+        }
+    rows = {m: sorted(db.query(f"SELECT * FROM {m}")) for m in mv_names}
+    return n_events / dt, eff, skew, rows
+
+
+def _skew_sweep(key, mv_sqls, mv_names, srcs, n_events, chunk, capacity,
+                s=1.5, threshold=1.5):
+    """The same Zipfian SQL at 1 vs 8 shards, skew defenses off vs on:
+    the number that matters is speedup_8v1 per arm — a power-law key
+    distribution collapses it toward 1x without the defenses; the
+    defenses (pre-combine, hot-key replication, vnode rebalancing) are
+    what keep '8 chips' meaning '8x'. MVs are cross-verified
+    bit-identical across every arm (the defenses are pure routing)."""
+    out = {"events": n_events, "zipf_s": s,
+           "note": "nexmark.key.dist=zipf:%s; defenses_off/on x 1/8 "
+                   "shards; skew_ratio = raw key skew (max/mean vnode "
+                   "bucket, bounds-independent), shard_skew_ratio = "
+                   "per-shard load under the CURRENT routing bounds "
+                   "(what rebalancing reduces); MV rows cross-verified "
+                   "bit-identical across all four arms" % s}
+    rows_ref = None
+    for defenses in (False, True):
+        sub = {}
+        for shards in SHARDS_SWEEP:
+            eps, eff, skew, rows = _skew_pass(
+                shards, defenses, mv_sqls, mv_names, srcs, n_events,
+                chunk, capacity, s, threshold)
+            if rows_ref is None:
+                rows_ref = rows
+            else:
+                assert rows == rows_ref, "skew-defense MV diverged"
+            sub[str(shards)] = {"device_eps": round(eps),
+                                "effective_shards": eff,
+                                "skew": skew}
+        lo, hi = str(SHARDS_SWEEP[0]), str(SHARDS_SWEEP[-1])
+        if sub.get(lo, {}).get("device_eps"):
+            sub["speedup_8v1"] = round(
+                sub[hi]["device_eps"] / sub[lo]["device_eps"], 3)
+        out["defenses_on" if defenses else "defenses_off"] = sub
+    out["mv_verified"] = rows_ref is not None
+    return {key: out}
+
+
+def stage_skew_q4(n_events):
+    return _skew_sweep("skew_q4", [Q4_MV], ["q4"], [BID_SRC], n_events,
+                       Q4_CHUNK, 1 << 19)
+
+
+def stage_skew_qx(n_events):
+    # q5: the join-bearing reference query — exercises hot-key
+    # replication and the pre-combined hop+agg chain together
+    return _skew_sweep("skew_qx", [Q5_MV], ["nexmark_q5"], [BID_SRC],
+                       n_events, QX_CHUNK, QX_CAPACITY)
+
+
 def stage_chaos_mttr(n_events):
     """Workload: recovery MTTR under chaos (fault-tolerance v3).
 
@@ -718,6 +833,8 @@ _STAGES = {
     "qx_host": stage_qx_host,
     "shards_q4": stage_shards_q4,
     "shards_qx": stage_shards_qx,
+    "skew_q4": stage_skew_q4,
+    "skew_qx": stage_skew_qx,
     "chaos_mttr": stage_chaos_mttr,
 }
 
@@ -732,9 +849,9 @@ def _stage_child(name, args, out_path):
         # (fused: 1.64B vs 984M ev/s, compile 30s vs 229s); q4's
         # 1M-capacity agg measures faster with the variadic-sort forms
         # (1.17M vs 350k ev/s warm). Must be set before jax imports.
-        if name in ("fused", "qx_device", "shards_qx"):
+        if name in ("fused", "qx_device", "shards_qx", "skew_qx"):
             os.environ["RW_TPU_CHEAP_COMPILE"] = "1"
-        if name.startswith("shards"):
+        if name.startswith("shards") or name.startswith("skew"):
             # mesh fallback for CPU-only hosts: 8 virtual devices (the
             # flag is inert when the default platform has real chips);
             # must land before jax initializes in this child
@@ -865,7 +982,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r12.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r13.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -889,6 +1006,7 @@ def main():
         h.run_stage("qx_host", (8_192,), 30)
         h.run_stage("shards_q4", (262_144,), 90)
         h.run_stage("shards_qx", (65_536,), 90)
+        h.run_stage("skew_q4", (131_072,), 120)
         h.run_stage("chaos_mttr", (262_144,), 90)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
@@ -926,6 +1044,13 @@ def main():
         # programs are compile-heavy; the cache from qx_device warms 1-
         # shard, the 8-shard pass pays its own compiles once)
         h.run_stage("shards_qx", (QX_SQL_EVENTS[0],), 900)
+        # Zipfian skew sweep (ISSUE 13): the same fused SQL under a
+        # power-law key distribution, defenses off vs on at 1 vs 8
+        # shards — speedup_8v1 per arm is the straggler-proofing number
+        if not h.run_stage("skew_q4", (SHARDS_Q4_EVENTS // 2,), 800):
+            h.run_stage("skew_q4", (SHARDS_Q4_EVENTS // 2,), 500,
+                        " — retry (warmer)")
+        h.run_stage("skew_qx", (QX_SQL_EVENTS[0] // 4,), 700)
         # recovery MTTR under chaos (fault-tolerance v3): worker SIGKILL
         # respawn + fused device-fault in-place recovery, both timed
         h.run_stage("chaos_mttr", (Q4_SQL_EVENTS[0] // 4,), 300)

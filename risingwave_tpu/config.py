@@ -91,6 +91,36 @@ class DeviceConfig:
     # the traced programs genuinely differ). RW_SKEW_STATS=0/1 in the
     # environment overrides this without code changes.
     skew_stats: bool = True
+    # --- skew defenses (act on the rw_key_skew evidence) ----------------
+    # local pre-combine (device/agg_step.py `PrecombineNode`): duplicate-
+    # key rows of an agg's epoch input combine to one partial-aggregate
+    # row per key BEFORE the state merge — and, under mesh sharding,
+    # BEFORE the ICI exchange, so a hot key ships one combined row per
+    # (shard, epoch) instead of every raw row ("Global Hash Tables
+    # Strike Back!": per-partition pre-aggregation + global merge).
+    # Exact: applies only to integer-reduction aggs (no retractable
+    # min/max multisets, no float sums — their reductions are not
+    # order-independent bit-for-bit). RW_AGG_PRECOMBINE=0/1 overrides.
+    agg_precombine: bool = True
+    # hot-key replication (device/shard_exec.py): join keys flagged by
+    # the in-program heavy-hitter counters get one side's rows
+    # replicated to every shard while the other side's rows salt
+    # round-robin by row identity — the PanJoin/JSPIM split-hot-keys
+    # move. Policy changes adopt at a checkpoint barrier via the
+    # rebuild-replay maneuver (bit-identical). RW_HOT_KEY_REP=0/1.
+    hot_key_rep: bool = True
+    # a key is "hot" when its per-epoch row count reaches this fraction
+    # of the epoch cadence (evidence: the skh* heavy-hitter slots).
+    hot_key_frac: float = 0.125
+    # barrier-time vnode rebalancing (device/shard_exec.py routing +
+    # FusedJob._maybe_retune): when the per-shard load implied by the
+    # vnode-occupancy histogram exceeds rebalance_threshold (max/mean),
+    # the job recomputes the vnode-block bounds at a checkpoint, pre-
+    # warms the re-routed exchange executables in the background, and
+    # switches via the rebuild-replay maneuver — zero fresh compiles,
+    # bit-identical. RW_VNODE_REBALANCE=0/1 overrides.
+    vnode_rebalance: bool = True
+    rebalance_threshold: float = 2.0
 
 
 @dataclass

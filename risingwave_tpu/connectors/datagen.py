@@ -27,16 +27,20 @@ def splitmix64(x: np.ndarray) -> np.ndarray:
 
 
 class FieldGen:
-    """Per-column generator."""
+    """Per-column generator. kind = 'sequence' | 'random' | 'zipf'
+    (power-law over [start, end), pmf ~ rank^-s with `s` > 1; rank 1 =
+    `start`, the stationary hot key — reproducible skewed workloads)."""
 
     def __init__(self, kind: str = "sequence", start: int = 0, end: int = 2**31,
-                 seed: int = 0, length: int = 10, values: Optional[List[Any]] = None):
+                 seed: int = 0, length: int = 10,
+                 values: Optional[List[Any]] = None, s: float = 1.5):
         self.kind = kind
         self.start = start
         self.end = end
         self.seed = seed
         self.length = length
         self.values = values
+        self.s = max(float(s), 1.0 + 1e-6)
 
     def generate(self, dtype: DataType, offsets: np.ndarray) -> Column:
         n = len(offsets)
@@ -46,6 +50,15 @@ class FieldGen:
                 return Column.from_list(dtype, [str(v) for v in vals])
             return Column(dtype, vals.astype(dtype.np_dtype))
         r = splitmix64(offsets.astype(np.uint64) + np.uint64(self.seed << 32))
+        if self.kind == "zipf":
+            span = np.int64(max(1, self.end - self.start))
+            u = (r >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+            rank = np.floor(np.power(1.0 - u, -1.0 / (self.s - 1.0)))
+            rank = np.clip(rank, 1.0, float(span)).astype(np.int64)
+            vals = self.start + rank - 1
+            if dtype.np_dtype == np.dtype(object):
+                return Column.from_list(dtype, [str(v) for v in vals])
+            return Column(dtype, vals.astype(dtype.np_dtype))
         if self.values is not None:
             idx = (r % np.uint64(len(self.values))).astype(np.int64)
             return Column.from_list(dtype, [self.values[i] for i in idx])

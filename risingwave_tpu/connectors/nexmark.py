@@ -103,6 +103,12 @@ class NexmarkConfig:
     # auctions stay open for this many events' worth of time
     auction_duration_events: int = 200
     strings_on: bool = True  # generating varchar columns costs host time
+    # "" = nexmark's hot/cold picks; "zipf:<s>" (s > 1, e.g. "zipf:1.5",
+    # SQL: WITH (nexmark.key.dist='zipf:1.5')) reshapes the bid
+    # auction/bidder picks into a power law — reproducible Zipfian
+    # workloads for skew tests/bench. Device twin: device/nexmark_gen.py
+    # (bit-identical streams).
+    key_dist: str = ""
 
 
 def _event_kinds(event_ids: np.ndarray) -> np.ndarray:
@@ -135,6 +141,20 @@ def _mulhi_bound(r: np.ndarray, m: np.ndarray) -> np.ndarray:
     carry = (m00 >> sh) + (m01 & mask) + (m10 & mask)
     return (m11 + (m01 >> sh) + (m10 >> sh)
             + (carry >> sh)).astype(np.int64)
+
+
+def _zipf_ordinal(rand_pick: np.ndarray, n_entities: np.ndarray,
+                  s: float) -> np.ndarray:
+    """Power-law entity ordinal (pmf ~ rank^-s): bounded-Pareto inverse
+    CDF, rank = floor((1-u)^(-1/(s-1))) clipped to [1, n]; ordinal 0 =
+    the hottest entity, stationary as n grows. Mirrors
+    `device/nexmark_gen.py::_zipf_ordinal` EXACTLY (same f64 expression
+    over the same rand draws) — host/device streams stay bit-identical."""
+    u = (rand_pick.astype(np.uint64) >> np.uint64(11)
+         ).astype(np.float64) * (2.0 ** -53)
+    rank = np.floor(np.power(1.0 - u, -1.0 / (s - 1.0)))
+    rank = np.minimum(rank, n_entities.astype(np.float64))
+    return np.maximum(rank, 1.0).astype(np.int64) - 1
 
 
 def _auction_count_before(event_ids: np.ndarray) -> np.ndarray:
@@ -228,23 +248,34 @@ class NexmarkGenerator:
         ts = self._timestamps(event_ids)
         n_auction = np.maximum(_auction_count_before(event_ids), 1)
         n_person = np.maximum(_person_count_before(event_ids), 1)
-        r = self._rand(event_ids, 20)
-        hot_a = (r % np.uint64(100)) < np.uint64(90)
-        r2 = self._rand(event_ids, 21)
-        hot_span = np.maximum(n_auction // HOT_AUCTION_RATIO, 1)
-        auction_ord = np.where(
-            hot_a,
-            n_auction - 1 - _mulhi_bound(r2, hot_span),
-            _mulhi_bound(r2, n_auction))
+        if self.cfg.key_dist:
+            # power-law picks (device twin: nexmark_gen._zipf_ordinal,
+            # identical f64 expression over the same rand draws — the
+            # streams stay bit-identical across host/device paths)
+            from ..device.nexmark_gen import key_dist_s
+            s = key_dist_s(self.cfg.key_dist)
+            auction_ord = _zipf_ordinal(self._rand(event_ids, 21),
+                                        n_auction, s)
+            bidder_ord = _zipf_ordinal(self._rand(event_ids, 23),
+                                       n_person, s)
+        else:
+            r = self._rand(event_ids, 20)
+            hot_a = (r % np.uint64(100)) < np.uint64(90)
+            r2 = self._rand(event_ids, 21)
+            hot_span = np.maximum(n_auction // HOT_AUCTION_RATIO, 1)
+            auction_ord = np.where(
+                hot_a,
+                n_auction - 1 - _mulhi_bound(r2, hot_span),
+                _mulhi_bound(r2, n_auction))
+            r3 = self._rand(event_ids, 22)
+            hot_b = (r3 % np.uint64(100)) < np.uint64(90)
+            r4 = self._rand(event_ids, 23)
+            bspan = np.maximum(n_person // HOT_BIDDER_RATIO, 1)
+            bidder_ord = np.where(
+                hot_b,
+                n_person - 1 - _mulhi_bound(r4, bspan),
+                _mulhi_bound(r4, n_person))
         auction = (FIRST_AUCTION_ID + auction_ord).astype(np.int64)
-        r3 = self._rand(event_ids, 22)
-        hot_b = (r3 % np.uint64(100)) < np.uint64(90)
-        r4 = self._rand(event_ids, 23)
-        bspan = np.maximum(n_person // HOT_BIDDER_RATIO, 1)
-        bidder_ord = np.where(
-            hot_b,
-            n_person - 1 - _mulhi_bound(r4, bspan),
-            _mulhi_bound(r4, n_person))
         bidder = (FIRST_PERSON_ID + bidder_ord).astype(np.int64)
         price = 100 + (self._rand(event_ids, 24) % np.uint64(10_000)).astype(np.int64)
         cols = [Column(T.INT64, auction), Column(T.INT64, bidder),

@@ -57,6 +57,13 @@ Commands:
                               up" and proves zero-compile warm starts;
                               --wait SECS lets in-flight background
                               compiles land first
+    skew [JOB]                key-skew summary per fused job: node
+                              skew_ratio, per-shard load under the
+                              current routing bounds, top-K hot keys,
+                              adopted hot-key replication policy, and a
+                              vnode-occupancy sparkline — read from the
+                              skew_stats.json mirror, so it works on a
+                              DEAD data dir (--json for the raw rows)
     dlq [JOB]                 poison-pill dead-letter queue: list the
                               quarantined input rows (default — reads
                               the durable table directly, works on a
@@ -369,6 +376,78 @@ def cmd_fused_stats(args) -> int:
     return 0
 
 
+def cmd_skew(args) -> int:
+    """Key-skew summary of every fused job (`rw_key_skew`, offline):
+    per-node skew_ratio + per-shard load under the current routing
+    bounds, the top-K hot keys, the adopted hot-key replication policy,
+    and a vnode-occupancy sparkline. Reads the `skew_stats.json` mirror
+    each job writes beside epoch_profile.jsonl at every checkpoint —
+    works on a DEAD data dir, the `compile-status --offline` contract
+    (the file IS the offline surface; there is no live mode to need)."""
+    from ..device.fused import SKEW_FILE
+    from ..device.skew_stats import SK_BUCKETS, sparkline
+    path = os.path.join(args.data_dir, SKEW_FILE)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        # ValueError: a crash can leave the snapshot truncated — the
+        # dead-dir contract degrades gracefully, never tracebacks
+        print(f"no skew snapshot ({path} missing or unreadable — the "
+              "data dir predates skew mirroring, ran with skew_stats "
+              "off, or never reached a checkpoint)")
+        return 1
+    jobs = doc.get("jobs", {})
+    if args.job is not None:
+        jobs = {k: v for k, v in jobs.items() if k == args.job}
+        if not jobs:
+            print(f"no skew snapshot for job {args.job!r}")
+            return 1
+    if args.json:
+        print(json.dumps(jobs, indent=2, sort_keys=True))
+        return 0
+    for name, rec in sorted(jobs.items()):
+        print(f"job {name}  shards={rec.get('mesh_shards', 1)}  "
+              f"events={rec.get('committed_events', 0)}  "
+              f"rebalances={rec.get('rebalances', 0)}")
+        vb = rec.get("vnode_bounds")
+        if vb:
+            print(f"  vnode bounds: {vb}")
+        rows = [tuple(r) for r in rec.get("rows", [])]
+        nodes = sorted({(r[0], r[1]) for r in rows})
+        for ni, tname in nodes:
+            sub = [r for r in rows if r[0] == ni and r[1] == tname]
+            occ = [0] * SK_BUCKETS
+            for r in sub:
+                if r[2] == "vnode_occ":
+                    occ[int(r[3])] = int(r[5])
+            ratio = next((r[6] for r in sub if r[2] == "skew_ratio"),
+                         None)
+            shard = next((r[6] for r in sub if r[2] == "shard_skew"),
+                         None)
+            line = f"  node {ni} {tname}: occ {sparkline(occ)}"
+            if ratio is not None:
+                line += f"  skew_ratio={ratio:.2f}x"
+            if shard is not None:
+                line += f"  shard_skew={shard:.2f}x"
+            print(line)
+            hot = [r for r in sub if r[2] == "hot_key"]
+            for r in sorted(hot, key=lambda r: r[3]):
+                print(f"    hot key #{r[3]}: key={r[4]} "
+                      f"rows/epoch={r[5]}")
+            pol = [r for r in sub if r[2] == "hot_policy"]
+            if pol:
+                keys = [r[4] for r in sorted(pol, key=lambda r: r[3])]
+                print(f"    replicating side {pol[0][5]} for hot keys "
+                      f"{keys}")
+            loads = [r for r in sub if r[2] == "shard_load"]
+            if loads:
+                print("    shard loads: " + " ".join(
+                    f"{int(r[5])}" for r in
+                    sorted(loads, key=lambda r: r[3])))
+    return 0
+
+
 def cmd_compile_status(args) -> int:
     """AOT compile-service state per fused job (the warmup-wall
     dashboard). Opens a full Database: DDL replay rebuilds the fused
@@ -535,6 +614,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="tail epoch_profile.jsonl live "
                          "(rotation-aware) instead of summarizing")
     sp.set_defaults(fn=cmd_profile)
+    sp = sub.add_parser("skew")
+    sp.add_argument("job", nargs="?", default=None)
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--json", action="store_true",
+                    help="raw snapshot JSON instead of the summary")
+    sp.set_defaults(fn=cmd_skew)
     sp = sub.add_parser("compile-status")
     sp.add_argument("job", nargs="?", default=None)
     sp.add_argument("--data-dir", required=True)

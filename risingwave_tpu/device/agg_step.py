@@ -196,13 +196,13 @@ def _outputs(spec: DeviceAggSpec, vals: Sequence[jax.Array]
     return outs, nulls
 
 
-def epoch_core(spec: DeviceAggSpec, state: SortedState,
-               keys: jax.Array, signs: jax.Array, mask: jax.Array,
-               inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
-    """The (un-jitted) epoch pipeline, shared by the single-chip step below
-    and the shard-local body of parallel/sharded_agg.py."""
-    deltas = _row_deltas(spec, signs, mask, inputs)
-    ukeys, udeltas, ucount = batch_reduce(keys, mask, deltas, spec.kinds)
+def _core_tail(spec: DeviceAggSpec, state: SortedState,
+               ukeys: jax.Array, udeltas, ucount: jax.Array):
+    """The merge half of the epoch pipeline: unique per-key deltas ->
+    state merge + old/new change set. Shared by the raw-row path
+    (`epoch_core`) and the pre-combined path (`epoch_core_combined`),
+    which arrive at the same unique-delta representation from different
+    inputs."""
     old_found, old_vals = lookup(state, ukeys)
     new_state, needed = merge(state, ukeys, udeltas, spec.kinds)
     new_found, new_vals = lookup(new_state, ukeys)
@@ -219,6 +219,58 @@ def epoch_core(spec: DeviceAggSpec, state: SortedState,
         "old_vals": tuple(old_vals), "new_vals": tuple(new_vals),
     }
     return new_state, needed, changes
+
+
+def epoch_core(spec: DeviceAggSpec, state: SortedState,
+               keys: jax.Array, signs: jax.Array, mask: jax.Array,
+               inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """The (un-jitted) epoch pipeline, shared by the single-chip step below
+    and the shard-local body of parallel/sharded_agg.py."""
+    deltas = _row_deltas(spec, signs, mask, inputs)
+    ukeys, udeltas, ucount = batch_reduce(keys, mask, deltas, spec.kinds)
+    return _core_tail(spec, state, ukeys, udeltas, ucount)
+
+
+def precombine_core(spec: DeviceAggSpec,
+                    keys: jax.Array, signs: jax.Array, mask: jax.Array,
+                    inputs: Tuple[Tuple[jax.Array, jax.Array], ...]):
+    """Local pre-combine ("Global Hash Tables Strike Back!": per-partition
+    pre-aggregation before the global merge): collapse an epoch's raw
+    rows to ONE partial-aggregate row per unique group key. Returns
+    (ukeys, ucnt, udeltas): key-sorted with EMPTY_KEY padding, live rows
+    a prefix; `ucnt` is the exact raw-row count behind each combined row
+    (the downstream rows_in stat and the heavy-hitter evidence).
+    Exactness: the per-key delta columns combine by the SAME associative
+    reductions (`spec.kinds`) the state merge applies, so combining here
+    and re-combining after the exchange is bit-identical to merging raw
+    rows — the caller guarantees integer-only SUM columns (float sums
+    are order-sensitive) and no multiset side state."""
+    live = mask & (signs != 0)
+    deltas = _row_deltas(spec, signs, mask, inputs)
+    cnt = jnp.where(live, 1, 0).astype(jnp.int64)
+    ukeys, uvals, _ = batch_reduce(keys, live, [cnt] + list(deltas),
+                                   (ReduceKind.SUM,) + spec.kinds)
+    return ukeys, uvals[0], tuple(uvals[1:])
+
+
+def epoch_core_combined(spec: DeviceAggSpec, state: SortedState,
+                        keys: jax.Array, counts: jax.Array,
+                        dvals, mask: jax.Array):
+    """Epoch pipeline over PRE-COMBINED rows: each input row is already a
+    (key, raw-row count, per-column partial delta) tuple — one per key
+    per upstream partition (several partitions' partials for one key may
+    arrive under mesh sharding; the batch_reduce here re-combines them).
+    Returns (new_state, needed, changes) exactly like `epoch_core`, plus
+    changes["rows_in"] = total raw rows behind the combined input (the
+    flow stat the raw path would have counted)."""
+    ukeys, uvals, ucount = batch_reduce(
+        keys, mask, [counts.astype(jnp.int64)] + list(dvals),
+        (ReduceKind.SUM,) + spec.kinds)
+    new_state, needed, ch = _core_tail(spec, state, ukeys, uvals[1:],
+                                       ucount)
+    ch["rows_in"] = jnp.sum(uvals[0])
+    ch["in_counts"] = uvals[0]
+    return new_state, needed, ch
 
 
 def epoch_core_full(spec: DeviceAggSpec, state: DeviceAggState,

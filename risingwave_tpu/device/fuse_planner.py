@@ -27,8 +27,8 @@ from ..core.dtypes import DataType, TypeKind
 from ..expr.expression import Expr, FunctionCall, InputRef, Literal
 from .fused import (AggNode, Delta, FilterNode, FusedJob, FusedProgram,
                     HopNode, JoinNode, MapNode, MVKeyedNode, MVPairNode,
-                    MVPull, Node, PackPlan, SourceNode, node_shape_key,
-                    plan_shape_hash)
+                    MVPull, Node, PackPlan, PrecombineNode, SourceNode,
+                    node_shape_key, plan_shape_hash)
 
 NUM = ("num",)
 TS = ("ts",)
@@ -156,6 +156,16 @@ def _range_of(e: Expr, ranges) -> Optional[Tuple[int, int, int]]:
 # ---------------------------------------------------------------------------
 
 
+def _env_bool(name: str, default: bool) -> bool:
+    """RW_* operational overrides for the skew-defense knobs: force on or
+    off without code changes (the RW_SKEW_STATS pattern)."""
+    import os as _os
+    v = _os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "off")
+
+
 class _Fuser:
     def __init__(self, device_cfg, epoch_events_cap: Optional[int] = None):
         self.nodes: List[Node] = []
@@ -163,6 +173,12 @@ class _Fuser:
         self.epoch_events: Optional[int] = epoch_events_cap
         self._source_cache: Dict[int, Meta] = {}
         self.max_events: Optional[int] = None
+        # local pre-combine (skew defense 1): duplicate-key agg input
+        # rows combine to one partial row per key before the state
+        # merge / ICI exchange. Armed per agg when exactly combinable.
+        self.precombine = _env_bool(
+            "RW_AGG_PRECOMBINE",
+            getattr(device_cfg, "agg_precombine", True))
 
     def add(self, node: Node) -> int:
         self.nodes.append(node)
@@ -341,12 +357,38 @@ class _Fuser:
             pk_pack = PackPlan.plan(out_rng)
             if pk_pack is None:
                 raise FuseReject("agg change-row identity not packable")
-        node = AggNode(m.idx, gidx, calls, pack, spec, self.capacity,
+        in_idx = m.idx
+        if self.precombine and self._combinable(spec):
+            # skew defense 1 (local pre-combine): a stateless combine
+            # stage collapses the epoch's duplicate-key rows to one
+            # partial-aggregate row per key BEFORE the agg — and, under
+            # mesh sharding, before the ICI exchange (the agg's shard
+            # spec then routes the combined delta by its packed key)
+            in_idx = self.add(PrecombineNode(m.idx, gidx, calls, pack,
+                                             spec))
+        node = AggNode(in_idx, gidx, calls, pack, spec, self.capacity,
                        pk_pack)
+        if in_idx != m.idx:
+            node.enable_precombine()
         idx = self.add(node)
         return Meta(idx, out_dt, out_dec, out_rng,
                     rows_bound=2 * m.rows_bound, append_only=False,
                     agg=node)
+
+    @staticmethod
+    def _combinable(spec) -> bool:
+        """Exact pre-combine eligibility: the per-key deltas must combine
+        by associative, order-independent reductions — which rules out
+        retractable min/max multisets (multiset entries key by (group,
+        value), not group) and float SUM columns (float addition is not
+        associative bit-for-bit; combining locally would break the
+        raw-path bit-identity contract)."""
+        from .sorted_state import ReduceKind
+        if spec.minputs:
+            return False
+        return not any(k == ReduceKind.SUM
+                       and np.issubdtype(np.dtype(dt), np.floating)
+                       for k, dt in zip(spec.kinds, spec.dtypes))
 
     def _join(self, execu) -> Meta:
         from ..ops.device_join import DeviceHashJoinExecutor
@@ -475,14 +517,11 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                       f.capacity))
             pull = MVPull("pair", mv_idx, m.dtypes, m.decoders)
         ee = f.epoch_events or 8192 * 64
-        import os as _os
-        skew_on = getattr(device_cfg, "skew_stats", True)
-        env = _os.environ.get("RW_SKEW_STATS")
-        if env is not None:
-            # operational kill switch / force-on without code changes
-            # (tier-1 pins it off for compile budget; the dedicated skew
-            # tests force it on)
-            skew_on = env.strip().lower() not in ("0", "false", "off")
+        # operational kill switch / force-on without code changes
+        # (tier-1 pins it off for compile budget; the dedicated skew
+        # tests force it on)
+        skew_on = _env_bool("RW_SKEW_STATS",
+                            getattr(device_cfg, "skew_stats", True))
         if skew_on:
             # arm key-skew telemetry on every keyed node BEFORE the
             # exchange is armed (the host-spliced "exch" stat must stay
@@ -504,6 +543,17 @@ def try_fuse(execu, ns, device_cfg, name: str,
                 if node.shard_spec().exchanges:
                     node.enable_exchange(
                         cap0, slot_bytes=8 * n * _exchange_row_width(node))
+        hot_on = _env_bool("RW_HOT_KEY_REP",
+                           getattr(device_cfg, "hot_key_rep", True))
+        if mesh is not None and skew_on and hot_on:
+            # skew defense 2 (hot-key replication): joins become
+            # candidates for the checkpoint-time hot-key policy — the
+            # heavy-hitter counters ARE the evidence, so the defense
+            # needs skew telemetry armed. Candidate-arming only: the
+            # exchange routes normally until a policy lands hot_keys.
+            for node in f.nodes:
+                if isinstance(node, JoinNode):
+                    node.hotrep = True
         program = FusedProgram(f.nodes, ee, mesh=mesh)
         ph = plan_shape_hash(program.nodes, program.epoch_events,
                              mesh.devices.size if mesh is not None else 1)
@@ -534,7 +584,16 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                             False),
                         compile_buckets=getattr(device_cfg,
                                                 "compile_buckets", 4),
-                        plan_hash=ph)
+                        plan_hash=ph,
+                        rebalance=_env_bool(
+                            "RW_VNODE_REBALANCE",
+                            getattr(device_cfg, "vnode_rebalance", True))
+                        and skew_on,
+                        rebalance_threshold=getattr(
+                            device_cfg, "rebalance_threshold", 2.0),
+                        hot_key_rep=hot_on and skew_on,
+                        hot_key_frac=getattr(device_cfg,
+                                             "hot_key_frac", 0.125))
     except FuseReject:
         return None
 
@@ -572,6 +631,10 @@ def _exchange_row_width(node) -> int:
         elif isinstance(node, JoinNode):
             # a join side's input delta carries exactly its val columns
             w = (len(node.l_val_dtypes), len(node.r_val_dtypes))[ex.input]
+        elif isinstance(node, AggNode) and node.combined:
+            # pre-combined delta: packed key + raw-row count + one
+            # partial delta per payload column
+            w = 2 + len(node.spec.kinds)
         else:
             w = 3
         widths.append(w + 1 + (1 if ex.carry_pk else 0))

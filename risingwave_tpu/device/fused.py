@@ -130,6 +130,9 @@ class ShardExchange:
     key_idx: Tuple[int, ...]
     carry_pk: bool = False
     ref_idx: Optional[Tuple[int, ...]] = None
+    # the routing key column already IS the packed key (pre-combined agg
+    # deltas carry it as column 0) — the exchange must not re-pack it
+    packed: bool = False
 
 
 @dataclass(frozen=True)
@@ -296,6 +299,22 @@ class Node:
     # vnode-occupancy histogram + per-epoch top-K heavy hitters inside
     # their traced step when armed (enable_skew). False everywhere else.
     skew: bool = False
+    # hot-key replication policy (device/shard_exec.py; JoinNode only):
+    # keys (40-bit-truncated, matching the heavy-hitter evidence) whose
+    # rows the exchange special-cases — input `hot_rep_side`'s rows
+    # BROADCAST to every shard, the other input's rows salt round-robin
+    # by row identity. Routing-only: the node's local step is unchanged.
+    # Adopted exclusively through FusedJob's checkpoint-time policy
+    # switch (rebuild-replay), so placement stays consistent with the
+    # state the shards already hold. Part of the EXCHANGE trace salt,
+    # never of `_mut_sig` (node-step executables must survive a policy
+    # change untouched — that is the zero-compile contract).
+    hot_keys: Tuple[int, ...] = ()
+    hot_rep_side: int = 1
+    # armed by the planner when DeviceConfig.hot_key_rep is on AND the
+    # node's exchanges carry pks (joins): makes the node a candidate for
+    # the checkpoint-time hot-key policy (no-op until hot_keys lands)
+    hotrep: bool = False
 
     def init_state(self):
         return None
@@ -416,6 +435,30 @@ def _node_step(node: Node, epoch_events: int, state, ins, extra):
 
 
 _JIT_STEP = None
+_STACK_JIT = None
+_FOLD_JIT = None
+
+
+def _stack_stats(stats: Tuple):
+    """Jitted stack of the per-epoch stat scalars (one dispatched
+    program per epoch; the jit cache keys on the tuple length)."""
+    import jax
+    import jax.numpy as jnp
+    global _STACK_JIT
+    if _STACK_JIT is None:
+        _STACK_JIT = jax.jit(lambda xs: jnp.stack(xs))
+    return _STACK_JIT(stats)
+
+
+def _fold_stats(vec, acc, sum_mask):
+    """Jitted accumulator combine: sum slots add, max slots high-water."""
+    import jax
+    import jax.numpy as jnp
+    global _FOLD_JIT
+    if _FOLD_JIT is None:
+        _FOLD_JIT = jax.jit(
+            lambda v, a, m: jnp.where(m, a + v, jnp.maximum(a, v)))
+    return _FOLD_JIT(vec, acc, sum_mask)
 
 
 from .capacity import bucket as _bucket  # noqa: E402  (pow2 sizing)
@@ -631,11 +674,69 @@ def _chain_nodes(nodes: List[Node]) -> Tuple[List[Node], Dict[int, int]]:
     return new_nodes, remap
 
 
+class PrecombineNode(Node):
+    """Local pre-combine stage ahead of an AggNode (the "Global Hash
+    Tables Strike Back!" per-partition pre-aggregation): the epoch's raw
+    input rows collapse to one partial-aggregate row per unique group
+    key BEFORE the agg's state merge — and, under mesh sharding, BEFORE
+    the ICI exchange, which is the skew defense: a hot key costs one
+    combined row per (source shard, epoch) on the wire and in the owning
+    shard's merge, instead of every raw row. Output delta layout:
+    cols = [packed group key, raw-row count, *per-column partial deltas
+    (spec.kinds layout)], live rows compacted to a prefix. Stateless;
+    runs shard-local (never exchanged itself). The planner inserts it
+    only for exactly-combinable aggs: no retractable min/max multisets,
+    no float SUM columns (float addition is order-sensitive — combining
+    locally would break bit-identity with the raw path)."""
+
+    stat_names = ("rows_in", "rows_out", "packbad")
+    stat_sums = ("rows_in", "rows_out")
+
+    def __init__(self, input: int, group_idx: Sequence[int], calls,
+                 pack: PackPlan, spec):
+        self.inputs = (input,)
+        self.group_idx = list(group_idx)
+        self.calls = list(calls)
+        self.pack = pack
+        self.spec = spec
+
+    def _sig(self):
+        return ("pre", tuple(self.group_idx),
+                tuple((c.kind, c.arg.index if c.arg is not None else None)
+                      for c in self.calls),
+                self.pack, self.spec)
+
+    def apply(self, state, ins, extra, epoch_events):
+        import jax.numpy as jnp
+        from .agg_step import precombine_core
+        d = ins[0]
+        live = d.mask & (d.sign != 0)
+        gcols = [d.cols[i] for i in self.group_idx]
+        packbad = self.pack.check(gcols, live)
+        keys = self.pack.pack(gcols)
+        inputs = []
+        for c in self.calls:
+            if c.arg is None:
+                z = jnp.zeros_like(keys)
+                inputs.append((z, jnp.ones(z.shape, bool)))
+            else:
+                inputs.append((d.cols[c.arg.index],
+                               jnp.ones(keys.shape, bool)))
+        from .sorted_state import EMPTY_KEY
+        ukeys, ucnt, udeltas = precombine_core(
+            self.spec, keys, d.sign, d.mask, tuple(inputs))
+        out_live = ukeys != EMPTY_KEY
+        out = Delta([ukeys, ucnt] + list(udeltas),
+                    jnp.where(out_live, 1, 0).astype(jnp.int32), out_live)
+        return state, out, [_nrows(live), _nrows(out_live), packbad], None
+
+
 class AggNode(Node):
     """epoch_core_full behind a packed group key; emits the change stream
     as a signed delta (old rows retract, new rows insert; unchanged groups
     suppressed). Change-set internals are exposed via ctx for a terminal
-    keyed MV."""
+    keyed MV. With `combined` armed (enable_precombine), the input is a
+    PrecombineNode's partial-aggregate delta instead of raw rows."""
 
     def __init__(self, input: int, group_idx: Sequence[int], calls,
                  pack: PackPlan, spec, capacity: int,
@@ -658,6 +759,10 @@ class AggNode(Node):
         # aux is pruned to the entries the MV apply reads (XLA DCEs the
         # rest). Set by FusedProgram's consumer analysis.
         self.emit_out = True
+        # True after enable_precombine: the input delta is a
+        # PrecombineNode's partial-aggregate layout ([key, count,
+        # *deltas]) instead of raw rows
+        self.combined = False
         self.stat_names = tuple(["needed", "touched"]
                                 + [f"ms{i}" for i in range(len(spec.minputs))]
                                 + ["packbad", "rows_in", "rows_out"])
@@ -669,7 +774,28 @@ class AggNode(Node):
             self.skew = True
             self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
 
+    def enable_precombine(self) -> None:
+        """Arm the pre-combined input mode (planner-called, once, BEFORE
+        the program is built — the combined layout changes the traced
+        step, so it is part of the structural signature). The planner
+        guarantees the spec is exactly combinable (no multisets, no
+        float SUM columns); assert the invariant here."""
+        import numpy as np
+        from .sorted_state import ReduceKind
+        assert not self.spec.minputs, "pre-combine over multiset state"
+        assert not any(k == ReduceKind.SUM
+                       and np.issubdtype(np.dtype(dt), np.floating)
+                       for k, dt in zip(self.spec.kinds, self.spec.dtypes)
+                       ), "pre-combine over a float SUM column"
+        self.combined = True
+
     def shard_spec(self):
+        if self.combined:
+            # the pre-combined delta carries its packed group key as
+            # column 0 — route by it verbatim; every column (key, count,
+            # partial deltas) is read by the merge, so all ship
+            return ShardSpec("vnode",
+                             (ShardExchange(0, (0,), packed=True),))
         # state partitions by the vnode of the packed group key; the one
         # input shuffles rows to their group's owning shard first. Only
         # the columns apply() reads (group key + agg args) ship over ICI
@@ -776,6 +902,12 @@ class AggNode(Node):
                tuple((c.kind, c.arg.index if c.arg is not None else None)
                      for c in self.calls),
                self.pack, self.pk_pack, self.spec, self.emit_out)
+        # the combined-input mode reads a different delta layout — a
+        # whole different trace. Conditional for the same reason as
+        # "skew" below: un-armed signatures stay byte-identical to
+        # previous releases.
+        if self.combined:
+            sig = sig + ("pre",)
         # skew telemetry extends the traced step (and the stats layout):
         # an armed node must never share an executable with an un-armed
         # twin. Appended conditionally so un-armed signatures — and the
@@ -793,34 +925,61 @@ class AggNode(Node):
 
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
-        from .agg_step import local_epoch_step
+        from .agg_step import DeviceAggState, local_epoch_step
         d = ins[0]
-        gcols = [d.cols[i] for i in self.group_idx]
-        packbad = self.pack.check(gcols, d.mask & (d.sign != 0))
-        keys = self.pack.pack(gcols)
-        inputs = []
-        for c in self.calls:
-            if c.arg is None:
-                z = jnp.zeros_like(keys)
-                inputs.append((z, jnp.ones(z.shape, bool)))
-            else:
-                inputs.append((d.cols[c.arg.index],
-                               jnp.ones(keys.shape, bool)))
-        new_state, _needed, ch = local_epoch_step(
-            self.spec, state, keys, d.sign, d.mask, tuple(inputs))
-        needed, ms_needed = _needed
-        rows_in = _nrows(d.mask & (d.sign != 0))
-        stats_tail = [m.astype(jnp.int64) for m in ms_needed]
-        sk: List[Any] = []
-        if self.skew:
-            # vnode-occupancy of the LIVE group table + this epoch's
-            # top-K hot group keys, riding the stats vector (max across
-            # epochs; pmax across shards — exact, vnode blocks are
-            # disjoint). See device/skew_stats.py.
-            from .skew_stats import epoch_topk, vnode_occupancy
-            from .sorted_state import EMPTY_KEY
-            sk = vnode_occupancy(new_state.main.keys, EMPTY_KEY) \
-                + epoch_topk(keys, d.mask & (d.sign != 0), EMPTY_KEY)
+        if self.combined:
+            # pre-combined input ([key, raw-row count, *partial deltas],
+            # PrecombineNode layout): re-combine cross-partition partials
+            # and merge — no packing (key pre-packed, bounds pre-checked
+            # upstream), no multisets (enable_precombine forbids them)
+            from .agg_step import epoch_core_combined
+            keys = d.cols[0]
+            cnt = d.cols[1]
+            dvals = list(d.cols[2:2 + len(self.spec.kinds)])
+            live = d.mask & (d.sign != 0)
+            new_main, needed, ch = epoch_core_combined(
+                self.spec, state.main, keys, cnt, dvals, live)
+            new_state = DeviceAggState(new_main, ())
+            packbad = jnp.zeros((), jnp.int64)
+            rows_in = ch["rows_in"].astype(jnp.int64)
+            stats_tail: List[Any] = []
+            sk: List[Any] = []
+            if self.skew:
+                # heavy hitters from the EXACT combined per-key counts
+                # (weighted_topk) — same evidence the raw path's
+                # sort/segment pass produces, one top_k cheaper
+                from .skew_stats import vnode_occupancy, weighted_topk
+                from .sorted_state import EMPTY_KEY
+                sk = vnode_occupancy(new_main.keys, EMPTY_KEY) \
+                    + weighted_topk(ch["keys"], ch["in_counts"],
+                                    EMPTY_KEY)
+        else:
+            gcols = [d.cols[i] for i in self.group_idx]
+            packbad = self.pack.check(gcols, d.mask & (d.sign != 0))
+            keys = self.pack.pack(gcols)
+            inputs = []
+            for c in self.calls:
+                if c.arg is None:
+                    z = jnp.zeros_like(keys)
+                    inputs.append((z, jnp.ones(z.shape, bool)))
+                else:
+                    inputs.append((d.cols[c.arg.index],
+                                   jnp.ones(keys.shape, bool)))
+            new_state, _needed, ch = local_epoch_step(
+                self.spec, state, keys, d.sign, d.mask, tuple(inputs))
+            needed, ms_needed = _needed
+            rows_in = _nrows(d.mask & (d.sign != 0))
+            stats_tail = [m.astype(jnp.int64) for m in ms_needed]
+            sk = []
+            if self.skew:
+                # vnode-occupancy of the LIVE group table + this epoch's
+                # top-K hot group keys, riding the stats vector (max
+                # across epochs; pmax across shards — exact, vnode
+                # blocks are disjoint). See device/skew_stats.py.
+                from .skew_stats import epoch_topk, vnode_occupancy
+                from .sorted_state import EMPTY_KEY
+                sk = vnode_occupancy(new_state.main.keys, EMPTY_KEY) \
+                    + epoch_topk(keys, d.mask & (d.sign != 0), EMPTY_KEY)
         if not self.emit_out:
             # terminal agg: only the MV apply reads the change set — keep
             # just what it needs; the delta stream is never materialized
@@ -1230,6 +1389,15 @@ class FusedProgram:
         # programs (the ICI shuffle stage) — FusedJob splits it out of
         # the dispatch phase so ICI cost is attributable
         self.last_exchange_s = 0.0
+        # vnode-block bounds the exchange routes by: None = the uniform
+        # `vnode_block_bounds` layout; a rebalanced job carries the
+        # custom bounds chosen at a checkpoint barrier. Routing-only
+        # policy — node-step traces never see it (zero-compile switch).
+        self.vnode_bounds: Optional[Tuple[int, ...]] = None
+        # aval mirror of each exchange stage's last input delta, keyed
+        # (node idx, exchange idx) — what the policy pre-warm lowers the
+        # re-routed exchange against (shard_exec.prewarm_exchange)
+        self._exch_sds: Dict[Tuple[int, int], Any] = {}
         # an agg whose only consumers are terminal MV appliers never needs
         # its change-delta stream (they read the aux change set instead)
         delta_consumed: Dict[int, bool] = {}
@@ -1314,11 +1482,13 @@ class FusedProgram:
                 # (dispatch is async — this wall is enqueue cost, the
                 # device-side ICI time lands in device_sync like all
                 # device compute)
-                from .shard_exec import exchange_delta
+                from .shard_exec import delta_sds, exchange_delta
                 t0x = _time.perf_counter()
                 for xi, ex in enumerate(node.shard_spec().exchanges):
+                    self._exch_sds[(i, xi)] = delta_sds(ins[ex.input])
                     ins[ex.input], need = exchange_delta(
-                        mesh, node, xi, ins[ex.input])
+                        mesh, node, xi, ins[ex.input],
+                        bounds=self.vnode_bounds)
                     exch_need = need if exch_need is None \
                         else jnp.maximum(exch_need, need)
                 exchange_s += _time.perf_counter() - t0x
@@ -1369,7 +1539,16 @@ class FusedProgram:
                 s = list(s) + [exch_need]
             stats.extend(s)
         self.last_exchange_s = exchange_s
-        vec = jnp.stack(stats) if stats \
+        # ONE jitted program stacks the stat scalars. The eager
+        # `jnp.stack` this replaces dispatched ~2 tiny programs PER
+        # SCALAR (expand_dims each, then concatenate) — on a sharded
+        # program those are dozens of per-epoch collective-bearing
+        # mini-programs whose rendezvous, in flight together with the
+        # node steps, can deadlock XLA:CPU's thread pool on small hosts
+        # (observed: skew-armed q5 at 8 virtual devices wedging in an
+        # AllReduce rendezvous); on any backend they are pure dispatch
+        # overhead
+        vec = _stack_stats(tuple(stats)) if stats \
             else jnp.zeros((1,), jnp.int64)
         return tuple(new_states), vec
 
@@ -1384,8 +1563,9 @@ class FusedProgram:
 
         def step(states, event_lo, stats_acc):
             new_states, vec = self.epoch(states, event_lo)
-            acc = jnp.where(sum_mask, stats_acc + vec,
-                            jnp.maximum(stats_acc, vec))
+            # jitted fold (see the _stack_stats rationale): one program
+            # instead of three eager ops per epoch
+            acc = _fold_stats(vec, stats_acc, sum_mask)
             return new_states, acc
 
         return step
@@ -1413,6 +1593,38 @@ _JS_CAP_STRIDE = 16          # minimum per-node key stride; a program
                              # whose widest node has more capacity slots
                              # gets a wider stride (deterministic from the
                              # plan, so recovery decodes the same keys)
+# Skew-routing policy rows (barrier-time vnode rebalancing + hot-key
+# replication): the chosen routing must survive restart — recovery
+# replays history through the exchange, and replaying under different
+# bounds than the persisted capacities were sized for would re-climb
+# the growth ladder. Values are VERSIONED (policy seq in the high bits)
+# because recovery max-combines duplicate keys: the newest policy's
+# rows always win, and every policy change rewrites EVERY slot.
+_JS_POLICY_SEQ = 4           # bare policy sequence number
+_JS_VB_BASE = 5              # + s: inner bound s+1; value = seq<<16|bound
+_JS_VB_MAX = 10              # keys 5..14 stay clear of _JS_CAP_BASE —
+                             # bounds persist only for mesh_shards <= 11
+_JS_REBALANCES = 15          # cumulative adopted policy switches
+_JS_HOT_BASE = 1 << 40       # + node*(SK_TOPK+1) + rank; value =
+                             # seq<<41 | key40<<1 | present. The extra
+                             # rank slot (rank == SK_TOPK) holds
+                             # seq<<2 | hot_rep_side<<1 | armed.
+
+# offline skew snapshot beside epoch_profile.jsonl (risectl skew)
+SKEW_FILE = "skew_stats.json"
+
+# live skew-policy pre-warm threads (FusedJob._stage_policy): tracked so
+# a test session can join them before interpreter teardown — a daemon
+# thread dying inside an XLA compile at exit aborts the process
+_PREWARM_THREADS: List[Any] = []
+
+
+def join_prewarm_threads(timeout: float = 30.0) -> None:
+    import time as _time
+    deadline = _time.monotonic() + timeout
+    for t in list(_PREWARM_THREADS):
+        t.join(max(0.0, deadline - _time.monotonic()))
+    _PREWARM_THREADS[:] = [t for t in _PREWARM_THREADS if t.is_alive()]
 
 
 class FusedJob:
@@ -1442,7 +1654,9 @@ class FusedJob:
                  predictive: bool = True, hbm_budget_mb: int = 4096,
                  profile: bool = True, aot_compile: bool = False,
                  compile_buckets: int = 4,
-                 plan_hash: Optional[str] = None):
+                 plan_hash: Optional[str] = None,
+                 rebalance: bool = True, rebalance_threshold: float = 2.0,
+                 hot_key_rep: bool = True, hot_key_frac: float = 0.125):
         import jax.numpy as jnp
         from ..utils.profile import JobProfiler
         self.name = name
@@ -1506,6 +1720,22 @@ class FusedJob:
         # attempts reset on a successful checkpoint
         self.recoveries = 0
         self._recovery_attempts = 0
+        # barrier-time skew-routing policy (vnode rebalancing + hot-key
+        # replication — the skew defenses that change EXCHANGE routing):
+        # decided at checkpoints from the window's skew evidence, pre-
+        # warmed in the background, adopted at a later checkpoint via
+        # rebuild-replay. Single-chip programs never retune.
+        self.rebalance = rebalance and program.mesh is not None
+        self.rebalance_threshold = float(rebalance_threshold)
+        self.hot_key_rep = hot_key_rep and program.mesh is not None
+        self.hot_key_frac = float(hot_key_frac)
+        self.rebalances = 0          # adopted policy switches
+        self._policy_seq = 0
+        # staged policy: (bounds, {node idx: (hot_keys, side)}, ready)
+        self._pending_policy: Optional[Tuple] = None
+        # data directory (database attaches it): offline skew snapshots
+        # land here beside epoch_profile.jsonl
+        self.data_dir: Optional[str] = None
         # key stride of the capacity rows: plan-derived (deterministic on
         # recovery), widened past the minimum when a node has more slots
         self._js_stride = max([_JS_CAP_STRIDE]
@@ -1823,7 +2053,8 @@ class FusedJob:
         job-state key schema (see _JS_*)."""
         rows = [(_JS_REPLAYS, self.growth_replays),
                 (_JS_RETRACES, self.retraces),
-                (_JS_GROWTHS, self.growths)]
+                (_JS_GROWTHS, self.growths),
+                (_JS_REBALANCES, self.rebalances)]
         stride = self._js_stride
         for i, node in enumerate(self.program.nodes):
             cur = node.cap_current()
@@ -1884,8 +2115,24 @@ class FusedJob:
         # failures per window, not per job lifetime)
         self._epoch_log.clear()
         self._recovery_attempts = 0
+        # skew defenses that change exchange routing adopt HERE — the
+        # only point where committed == counter and the whole history is
+        # deterministically replayable under the new policy
+        self._maybe_retune(epoch)
+        self._write_skew_snapshot()
 
     # ---- MV materialization --------------------------------------------
+    def _pull_need(self) -> int:
+        """Live-row high-water of the terminal MV node (per shard): the
+        max of the job-lifetime totals and the current window — the
+        window vector resets at checkpoints, so a post-drain SELECT
+        must read the lifetime high-water."""
+        vec = np.maximum(self._stat_totals, self._last_stats) \
+            if len(self._stat_totals) == len(self._last_stats) \
+            else self._last_stats
+        return self.program.node_stats(
+            self.pull.node_idx, vec).get("needed", 0)
+
     def _pull_rows(self) -> List[Tuple]:
         import jax
         mesh = self.program.mesh
@@ -1896,9 +2143,16 @@ class FusedJob:
             if mesh is not None:
                 # per-shard sorted runs merge by ascending packed key —
                 # keys are globally unique (each lives on its vnode's
-                # shard), so the merged order IS the 1-shard order
+                # shard), so the merged order IS the 1-shard order. The
+                # merge is an IN-PROGRAM all_gather + device-side live
+                # compaction: ONE device_get per SELECT regardless of
+                # shard count (the bound comes from the "needed" stat
+                # the sync already pulled; a stale bound falls back to
+                # the capacity-sliced second pull inside)
                 from .shard_exec import merge_keyed_pull
-                keys, cols, nulls = merge_keyed_pull(st, mesh, dts)
+                keys, cols, nulls = merge_keyed_pull(
+                    st, mesh, dts,
+                    live_bound=self._pull_need() * self.mesh_shards)
             else:
                 keys, cols, nulls = mv_rows(st, dts)
             gcols_np = _np_unpack(self.pull.agg.pack, keys)
@@ -1914,7 +2168,9 @@ class FusedJob:
             side = self.states[self.pull.node_idx]
             if mesh is not None:
                 from .shard_exec import merge_pair_pull
-                n, vals = merge_pair_pull(side, mesh)
+                n, vals = merge_pair_pull(
+                    side, mesh,
+                    live_bound=self._pull_need() * self.mesh_shards)
             else:
                 n = int(side.count)
                 vals = jax.device_get([v[:n] if hasattr(v, "shape") else v
@@ -1964,6 +2220,12 @@ class FusedJob:
         self.growth_replays = rows.get(_JS_REPLAYS, 0)
         self.retraces = rows.get(_JS_RETRACES, 0)
         self.growths = rows.get(_JS_GROWTHS, 0)
+        self.rebalances = rows.get(_JS_REBALANCES, 0)
+        # skew-routing policy must reinstall BEFORE the replay: the
+        # persisted capacities were sized under it
+        self._policy_seq = rows.get(_JS_POLICY_SEQ, 0)
+        if self._policy_seq and self.program.mesh is not None:
+            self._restore_policy(rows)
         preset = False
         for i, node in enumerate(self.program.nodes):
             cur = node.cap_current()
@@ -1985,6 +2247,10 @@ class FusedJob:
         self._dispatch_range(0, target)
         self.counter = target
         self.sync()
+        # the replay's pulled stats seed the job-lifetime totals — the
+        # rw_fused_node_stats / rw_key_skew surfaces are truthful right
+        # after recovery, not one checkpoint later
+        self._accum_totals(self._last_stats)
         self.snapshot = (self.states, target)
         self.stats_acc = self._zero_stats
         self.committed = target
@@ -1992,6 +2258,294 @@ class FusedJob:
             self._persisted = {tuple(r): None
                                for r in self.mv_state_table.iter_all()}
         self._last_persist = -1     # mirror may be stale: refresh next ckpt
+
+    # ---- skew-routing policy (vnode rebalance + hot-key replication) ----
+    def _current_bounds(self) -> Tuple[int, ...]:
+        """The vnode-block bounds the exchange currently routes by."""
+        from ..core.vnode import VNODE_COUNT
+        from ..parallel.mesh import vnode_block_bounds
+        if self.program.vnode_bounds is not None:
+            return self.program.vnode_bounds
+        return tuple(int(v) for v in vnode_block_bounds(
+            self.mesh_shards, VNODE_COUNT))
+
+    def _maybe_retune(self, epoch: int) -> None:
+        """Checkpoint-time skew-policy loop: read the window's skew
+        evidence (vnode-occupancy histograms, heavy-hitter counters —
+        already on host from the sync), decide whether routing should
+        change (rebalanced vnode-block bounds and/or per-join hot-key
+        sets), PRE-WARM the re-routed exchange executables in the
+        background, and adopt a staged policy at the first checkpoint
+        that finds its pre-warm finished. Node-step executables are
+        untouched by design (routing never enters `_mut_sig`), so the
+        whole switch is zero-fresh-compile."""
+        if self.program.mesh is None \
+                or not (self.rebalance or self.hot_key_rep):
+            return
+        if self._pending_policy is not None:
+            bounds, hot_map, ready = self._pending_policy
+            if ready.is_set():
+                self._pending_policy = None
+                self._apply_policy(epoch, bounds, hot_map)
+            return
+        from .skew_stats import (SK_BUCKETS, SK_TOPK, balanced_bounds,
+                                 shard_skew_ratio, unpack_hot)
+        # lifetime high-water evidence, not just the last checkpoint
+        # window: occupancy/heavy-hitter slots combine by max, and the
+        # window vector zeroes at quiescent (post-drain) checkpoints
+        vec = np.maximum(self._stat_totals, self._last_stats) \
+            if len(self._stat_totals) == len(self._last_stats) \
+            else self._last_stats
+        occ_total = [0] * SK_BUCKETS
+        hot_map: Dict[int, Tuple[Tuple[int, ...], int]] = {}
+        for i, node in enumerate(self.program.nodes):
+            if not node.skew or node.exch is None:
+                continue
+            st = self.program.node_stats(i, vec)
+            for b in range(SK_BUCKETS):
+                occ_total[b] += st.get(f"skv{b}", 0)
+            if self.hot_key_rep and node.hotrep:
+                hots = []
+                for r in range(SK_TOPK):
+                    key40, cnt = unpack_hot(st.get(f"skh{r}", 0))
+                    if cnt >= self.hot_key_frac \
+                            * self.program.epoch_events:
+                        hots.append(key40)
+                hk = tuple(sorted(set(hots)))
+                if hk and hk != node.hot_keys:
+                    # replicate the SMALLER build side (broadcasting the
+                    # dimension-like side is cheap; salting the firehose
+                    # side is the win), keep it sticky once chosen
+                    side = 0 if st.get("need_a", 0) \
+                        <= st.get("need_b", 0) else 1
+                    hot_map[i] = (hk, side)
+        new_bounds = None
+        cur = self._current_bounds()
+        if self.rebalance and sum(occ_total) > 0 \
+                and shard_skew_ratio(occ_total, cur) \
+                > self.rebalance_threshold:
+            nb = balanced_bounds(occ_total, self.mesh_shards)
+            if nb != cur:
+                new_bounds = nb
+        if new_bounds is None and not hot_map:
+            return
+        self._stage_policy(new_bounds or cur, hot_map)
+
+    def _stage_policy(self, bounds: Tuple[int, ...],
+                      hot_map: Dict[int, Tuple[Tuple[int, ...], int]]
+                      ) -> None:
+        """Stage a routing-policy change: compile every re-routed
+        exchange program on a background thread (against the avals the
+        last epoch actually dispatched), then let a later checkpoint
+        adopt it — the AOT-compile-service pattern, applied to the
+        exchange seam so the switch itself never compiles."""
+        import threading
+        from ..core.vnode import VNODE_COUNT
+        from ..parallel.mesh import vnode_block_bounds
+        mesh = self.program.mesh
+        ready = threading.Event()
+        # normalize to the exact trace-salt form dispatch will use after
+        # adoption: uniform bounds ride as None (the pre-policy salt), so
+        # a hot-only policy pre-warms against the bounds it will keep
+        uniform = tuple(int(v) for v in vnode_block_bounds(
+            self.mesh_shards, VNODE_COUNT))
+        salt_bounds = None if tuple(bounds) == uniform else tuple(bounds)
+        work = []
+        for i, node in enumerate(self.program.nodes):
+            if node.exch is None:
+                continue
+            hk, side = hot_map.get(i, (node.hot_keys, node.hot_rep_side))
+            for xi in range(len(node.shard_spec().exchanges)):
+                sds = self.program._exch_sds.get((i, xi))
+                if sds is not None:
+                    work.append((node, xi, sds, hk, side))
+
+        def run():
+            from .shard_exec import prewarm_exchange
+            for node, xi, sds, hk, side in work:
+                try:
+                    prewarm_exchange(mesh, node, xi, sds,
+                                     bounds=salt_bounds,
+                                     hot_keys=hk, hot_rep_side=side)
+                except Exception:
+                    # pre-warm is advisory: a failed lower falls back to
+                    # an inline compile at the switch, never blocks it
+                    pass
+            ready.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"rw-skew-prewarm-{self.name}")
+        _PREWARM_THREADS[:] = [x for x in _PREWARM_THREADS
+                               if x.is_alive()]
+        _PREWARM_THREADS.append(t)
+        t.start()
+        self._pending_policy = (tuple(bounds), hot_map, ready)
+
+    def _apply_policy(self, epoch: int, bounds: Tuple[int, ...],
+                      hot_map: Dict[int, Tuple[Tuple[int, ...], int]]
+                      ) -> None:
+        """Adopt a staged routing policy at this checkpoint: swap the
+        bounds/hot-sets, persist them (restart must replay under the
+        same routing the capacities were sized for), then rebuild-replay
+        — the in-place-recovery maneuver: empty state at current (>=
+        high-water) capacities, regenerate the committed history under
+        the NEW routing, re-anchor the snapshot. Deterministic sources
+        make the result bit-identical; unchanged node signatures make it
+        zero-fresh-compile."""
+        import time as _time
+        from ..core.vnode import VNODE_COUNT
+        from ..parallel.mesh import vnode_block_bounds
+        from ..utils.metrics import REGISTRY
+        t0 = _time.perf_counter()
+        uniform = tuple(int(v) for v in vnode_block_bounds(
+            self.mesh_shards, VNODE_COUNT))
+        self.program.vnode_bounds = None if tuple(bounds) == uniform \
+            else tuple(bounds)
+        for i, (hk, side) in hot_map.items():
+            node = self.program.nodes[i]
+            node.hot_keys = tuple(hk)
+            node.hot_rep_side = int(side)
+        self._policy_seq += 1
+        # counted BEFORE persisting: the commit that records policy seq
+        # N must also carry rebalances == N's count, or a crash before
+        # the next checkpoint under-reports adopted switches
+        self.rebalances += 1
+        self._persist_policy(epoch)
+        target = self.committed
+        self.states = self.program.init_states()
+        self.stats_acc = self._zero_stats
+        self.counter = 0
+        self.snapshot = (self.states, 0)
+        if target:
+            self._dispatch_range(0, target)
+            self.counter = target
+            self.sync()
+        self.snapshot = (self.states, target)
+        self.stats_acc = self._zero_stats
+        # the superseded policy's pre-warmed exchange executables are
+        # dead weight now — drop them (keyed by node shape, so only
+        # this plan's stale salts go)
+        from .shard_exec import prune_exchange_aot
+        prune_exchange_aot(
+            self.program.mesh,
+            [(n, self.program.vnode_bounds)
+             for n in self.program.nodes if n.exch is not None])
+        REGISTRY.counter(
+            "fused_rebalances_total",
+            "checkpoint-time skew-routing policy switches (vnode "
+            "rebalance / hot-key replication)",
+            labels=("job",)).labels(self.name).inc()
+        REGISTRY.histogram(
+            "fused_rebalance_seconds",
+            "wall seconds one skew-policy rebuild-replay took").observe(
+            _time.perf_counter() - t0)
+
+    def _persist_policy(self, epoch: int) -> None:
+        """Write the routing policy into the job state table (versioned
+        values — see the _JS_* schema note). Every slot rewrites on
+        every change so recovery's max-combine always reconstructs one
+        consistent policy generation."""
+        if self.job_state_table is None:
+            return
+        from .skew_stats import SK_KEY_MASK, SK_TOPK
+        seq = self._policy_seq
+        rows = [(_JS_POLICY_SEQ, seq),
+                (_JS_REBALANCES, self.rebalances)]
+        n = self.mesh_shards
+        bounds = self._current_bounds()
+        if 0 < n - 1 <= _JS_VB_MAX:
+            for s in range(n - 1):
+                rows.append((_JS_VB_BASE + s,
+                             (seq << 16) | int(bounds[s + 1])))
+        for i, node in enumerate(self.program.nodes):
+            if not node.hotrep:
+                continue
+            base = _JS_HOT_BASE + i * (SK_TOPK + 1)
+            for r in range(SK_TOPK):
+                v = seq << 41
+                if r < len(node.hot_keys):
+                    v |= ((node.hot_keys[r] & SK_KEY_MASK) << 1) | 1
+                rows.append((base + r, v))
+            rows.append((base + SK_TOPK,
+                         (seq << 2) | (int(node.hot_rep_side) << 1) | 1))
+        dirty = False
+        for k, v in rows:
+            if self._js_written.get(k) != v:
+                self.job_state_table.insert((k, v))
+                self._js_written[k] = v
+                dirty = True
+        if dirty:
+            self.job_state_table.commit(epoch)
+
+    def _restore_policy(self, rows: Dict[int, int]) -> None:
+        """Recovery-side decode of `_persist_policy`'s rows: reinstall
+        the routing policy BEFORE the history replay, so the replayed
+        exchange routes exactly like the run that sized the persisted
+        capacities."""
+        from ..core.vnode import VNODE_COUNT
+        from ..parallel.mesh import vnode_block_bounds
+        from .skew_stats import SK_KEY_MASK, SK_TOPK
+        n = self.mesh_shards
+        if 0 < n - 1 <= _JS_VB_MAX:
+            inner = [rows.get(_JS_VB_BASE + s) for s in range(n - 1)]
+            if all(v is not None for v in inner):
+                bounds = (0,) + tuple(v & 0xFFFF for v in inner) \
+                    + (VNODE_COUNT,)
+                if all(bounds[s] <= bounds[s + 1] for s in range(n)) \
+                        and bounds[-2] <= VNODE_COUNT:
+                    uniform = tuple(int(v) for v in vnode_block_bounds(
+                        n, VNODE_COUNT))
+                    self.program.vnode_bounds = \
+                        None if bounds == uniform else bounds
+        for i, node in enumerate(self.program.nodes):
+            base = _JS_HOT_BASE + i * (SK_TOPK + 1)
+            srow = rows.get(base + SK_TOPK)
+            if srow is None or not (srow & 1):
+                continue
+            node.hot_rep_side = (srow >> 1) & 1
+            hots = []
+            for r in range(SK_TOPK):
+                v = rows.get(base + r, 0)
+                if v & 1:
+                    hots.append((v >> 1) & SK_KEY_MASK)
+            node.hot_keys = tuple(sorted(set(hots)))
+
+    def _write_skew_snapshot(self) -> None:
+        """Offline skew surface (`risectl skew`): mirror the rw_key_skew
+        rows + routing policy into the data dir at every checkpoint —
+        the dead-data-dir contract of epoch_profile.jsonl and
+        compile_manifest.json, applied to skew evidence."""
+        if not self.data_dir \
+                or not any(n.skew for n in self.program.nodes):
+            return
+        import json
+        import os
+        import time as _time
+        path = os.path.join(self.data_dir, SKEW_FILE)
+        doc: Dict[str, Any] = {"jobs": {}}
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            pass
+        doc.setdefault("jobs", {})
+        doc["jobs"][self.name] = {
+            "ts": _time.time(),
+            "epoch_events": self.program.epoch_events,
+            "mesh_shards": self.mesh_shards,
+            "committed_events": self.committed,
+            "vnode_bounds": (list(self._current_bounds())
+                             if self.program.mesh is not None else None),
+            "rebalances": self.rebalances,
+            "rows": [list(r) for r in self.skew_report()],
+        }
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass
 
     # ---- AOT pre-warm ----------------------------------------------------
     def prewarm(self) -> None:
@@ -2163,6 +2717,26 @@ class FusedJob:
                 key, count = unpack_hot(st.get(f"skh{r}", 0))
                 if count > 0:
                     out.append((i, tname, "hot_key", r, key, count, None))
+            if self.program.mesh is not None:
+                # per-SHARD load implied by the histogram under the
+                # CURRENT routing bounds — the quantity vnode
+                # rebalancing actually evens out (skew_ratio above is
+                # bounds-independent raw key skew)
+                from .skew_stats import shard_loads, shard_skew_ratio
+                bounds = self._current_bounds()
+                loads = shard_loads(occ, bounds)
+                tot = sum(loads)
+                for s, ld in enumerate(loads):
+                    out.append((i, tname, "shard_load", s, None,
+                                int(ld), ld / tot if tot else 0.0))
+                out.append((i, tname, "shard_skew", 0, None, int(tot),
+                            shard_skew_ratio(occ, bounds)))
+            if node.hot_keys:
+                # adopted hot-key replication policy (value = the side
+                # whose rows broadcast)
+                for r, hk in enumerate(node.hot_keys):
+                    out.append((i, tname, "hot_policy", r, hk,
+                                node.hot_rep_side, None))
         return out
 
     def node_skew_ratio(self, i: int) -> Optional[float]:

@@ -46,11 +46,31 @@ class GenCfg(NamedTuple):
     base_time_usecs: int
     inter_event_gap_usecs: int
     auction_duration_events: int
+    # "" = the nexmark hot/cold entity picks; "zipf:<s>" (s > 1) reshapes
+    # the bid auction/bidder picks into a power law — reproducible
+    # skewed workloads (host twin: connectors/nexmark.py, bit-identical)
+    key_dist: str = ""
 
     @staticmethod
     def from_config(cfg: NexmarkConfig) -> "GenCfg":
         return GenCfg(cfg.seed, cfg.base_time_usecs,
-                      cfg.inter_event_gap_usecs, cfg.auction_duration_events)
+                      cfg.inter_event_gap_usecs,
+                      cfg.auction_duration_events,
+                      getattr(cfg, "key_dist", ""))
+
+
+def key_dist_s(key_dist: str) -> float:
+    """Parse 'zipf:<s>' -> s (shared by host and device generators).
+    Only s > 1 is supported: the ordinal comes from the bounded-Pareto
+    inverse CDF, which needs a finite -1/(s-1) exponent."""
+    kind, _, sv = key_dist.partition(":")
+    if kind != "zipf":
+        raise ValueError(f"unknown key_dist {key_dist!r} "
+                         "(supported: 'zipf:<s>', s > 1)")
+    s = float(sv) if sv else 1.5
+    if s <= 1.0:
+        raise ValueError(f"zipf exponent must be > 1, got {s}")
+    return s
 
 
 def _rand(cfg: GenCfg, ids, salt: int):
@@ -114,6 +134,20 @@ def _hot_pick(rand_hot, rand_pick, n_entities, hot_ratio: int, hot_mod: int):
     return jnp.where(hot, ord_hot, ord_cold)
 
 
+def _zipf_ordinal(rand_pick, n_entities, s: float):
+    """Power-law entity ordinal (pmf ~ rank^-s, bounded-Pareto inverse
+    CDF): rank = floor((1-u)^(-1/(s-1))) clipped to [1, n]. Ordinal 0
+    (the FIRST entity) is the hottest — stationary as the entity count
+    grows, so the hot key is the same key all run long. Pure f64
+    floor/pow over exactly-representable inputs; the host twin
+    (connectors/nexmark.py `_zipf_ordinal`) computes the identical
+    expression, and tests assert the streams are bit-identical."""
+    u = (rand_pick >> _U(11)).astype(jnp.float64) * (2.0 ** -53)
+    rank = jnp.floor(jnp.power(1.0 - u, -1.0 / (s - 1.0)))
+    rank = jnp.minimum(rank, n_entities.astype(jnp.float64))
+    return jnp.maximum(rank, 1.0).astype(jnp.int64) - 1
+
+
 def gen_table(cfg: GenCfg, table: str, event_ids) -> Dict[str, jnp.ndarray]:
     """All columns of `table` for these event ids, as int64 arrays.
 
@@ -161,12 +195,20 @@ def gen_table(cfg: GenCfg, table: str, event_ids) -> Dict[str, jnp.ndarray]:
     if table == "bid":
         n_auction = jnp.maximum(_auction_count_before(event_ids), 1)
         n_person = jnp.maximum(_person_count_before(event_ids), 1)
-        auction_ord = _hot_pick(_rand(cfg, event_ids, 20),
-                                _rand(cfg, event_ids, 21),
-                                n_auction, HOT_AUCTION_RATIO, hot_mod=100)
-        bidder_ord = _hot_pick(_rand(cfg, event_ids, 22),
-                               _rand(cfg, event_ids, 23),
-                               n_person, HOT_BIDDER_RATIO, hot_mod=100)
+        if cfg.key_dist:
+            s = key_dist_s(cfg.key_dist)
+            auction_ord = _zipf_ordinal(_rand(cfg, event_ids, 21),
+                                        n_auction, s)
+            bidder_ord = _zipf_ordinal(_rand(cfg, event_ids, 23),
+                                       n_person, s)
+        else:
+            auction_ord = _hot_pick(_rand(cfg, event_ids, 20),
+                                    _rand(cfg, event_ids, 21),
+                                    n_auction, HOT_AUCTION_RATIO,
+                                    hot_mod=100)
+            bidder_ord = _hot_pick(_rand(cfg, event_ids, 22),
+                                   _rand(cfg, event_ids, 23),
+                                   n_person, HOT_BIDDER_RATIO, hot_mod=100)
         ch = _mod(_rand(cfg, event_ids, 25), len(_CH_POOL))
         return {
             "auction": (FIRST_AUCTION_ID + auction_ord).astype(jnp.int64),

@@ -144,11 +144,42 @@ def sharded_resize(node, state, caps, mesh):
 # ---------------------------------------------------------------------------
 
 
-def _exchange_local(mesh, node, xi: int, d, abstract: bool):
+def _route_dest(vn, n: int, bounds: Optional[Tuple[int, ...]]):
+    """Owning shard of each vnode under the routing policy: the uniform
+    contiguous-block formula (`shard_of_vnode`) when `bounds` is None,
+    otherwise the custom (rebalanced) block bounds — shard s owns
+    [bounds[s], bounds[s+1]); empty blocks (equal consecutive bounds)
+    are legal and are the point of a rebalance: a hot histogram bucket
+    gets a shard to itself."""
+    import jax.numpy as jnp
+    if bounds is None:
+        return shard_of_vnode(vn.astype(jnp.int64), n, VNODE_COUNT
+                              ).astype(jnp.int32)
+    dest = jnp.zeros(vn.shape, jnp.int32)
+    for b in bounds[1:-1]:
+        dest = dest + (vn >= b).astype(jnp.int32)
+    return dest
+
+
+def _exchange_local(mesh, node, xi: int, d, abstract: bool,
+                    bounds: Optional[Tuple[int, ...]] = None,
+                    hot_keys: Tuple[int, ...] = (), hot_side: int = 1):
     """Shard-local body: hash rows to their owning shard's vnode block,
     bucket into the [n_shards, exch] send buffer, all_to_all, flatten.
     The routing key columns and whether row identity rides along come
     from the node's declarative shard spec (`Node.shard_spec`).
+
+    Routing policy (all trace-static, all exchange-only — node steps
+    never see it): `bounds` overrides the uniform vnode-block layout
+    (barrier-time rebalancing); `hot_keys` (40-bit-truncated, the
+    heavy-hitter evidence format) arms hot-key replication on pk-
+    carrying exchanges: input `hot_side`'s hot rows BROADCAST to every
+    shard (build rows replicate), the other input's hot rows salt
+    round-robin by row identity (probe work spreads; a row and its
+    later retraction share a pk, hence a shard). Every pair of one hot
+    key is still produced on exactly one shard — the shard owning the
+    salted-side row — so netting and the pair MV stay exact.
+
     `abstract=True` is the shape-faithful mirror used for AOT aval walks
     (collectives replaced by shape-identities; needs no mesh axis)."""
     import jax
@@ -158,11 +189,27 @@ def _exchange_local(mesh, node, xi: int, d, abstract: bool):
     n = mesh.devices.size
     exch = node.exch
     ex = node.shard_spec().exchanges[xi]
-    key = node.pack.pack([d.cols[i] for i in ex.key_idx])
+    if ex.packed:
+        # pre-combined deltas carry the packed key verbatim (column 0)
+        key = d.cols[ex.key_idx[0]]
+    else:
+        key = node.pack.pack([d.cols[i] for i in ex.key_idx])
     vn = compute_vnodes_jnp(key, VNODE_COUNT)
-    dest = shard_of_vnode(vn.astype(jnp.int64), n, VNODE_COUNT
-                          ).astype(jnp.int32)
+    dest = _route_dest(vn, n, bounds)
     live = d.mask & (d.sign != 0)
+    bcast = None
+    if hot_keys:
+        from .skew_stats import SK_KEY_MASK
+        k40 = key & SK_KEY_MASK
+        is_hot = jnp.zeros(key.shape, bool)
+        for hk in hot_keys:
+            is_hot = is_hot | (k40 == hk)
+        is_hot = is_hot & live
+        if xi == hot_side or not ex.carry_pk or d.pk is None:
+            bcast = is_hot                 # replicated (build) side
+        else:
+            # salted (probe) side: deterministic by row identity
+            dest = jnp.where(is_hot, (d.pk % n).astype(jnp.int32), dest)
     # only the columns the node declares it reads ship over ICI; the
     # routed delta zero-fills the rest (never touched by declaration)
     ncols = len(d.cols)
@@ -173,18 +220,32 @@ def _exchange_local(mesh, node, xi: int, d, abstract: bool):
         arrays.append(d.pk)
     onehot = (dest[None, :] == jnp.arange(n, dtype=jnp.int32)[:, None]) \
         & live[None, :]
+    if bcast is not None:
+        onehot = onehot | bcast[None, :]
     counts = jnp.sum(onehot, axis=1)
     # max bucket fill = the "exch" capacity stat; > exch means rows were
-    # dropped this epoch -> sync detects overflow, grows, replays
+    # dropped this epoch -> sync detects overflow, grows, replays.
+    # Replicated copies count per destination — their HBM is real.
     need = jnp.max(counts).astype(jnp.int64)
     pos = jnp.cumsum(onehot, axis=1) - 1
-    posr = jnp.take_along_axis(pos, dest[None, :].astype(jnp.int32),
-                               axis=0)[0]
-    rdest = jnp.where(live, dest, n)      # OOB rows drop out of the set
     bufs = []
-    for a in arrays:
-        buf = jnp.zeros((n, exch), dtype=a.dtype)
-        bufs.append(buf.at[rdest, posr].set(a, mode="drop"))
+    if bcast is None:
+        # single-destination fast path (no hot keys): one [B] scatter
+        posr = jnp.take_along_axis(pos, dest[None, :].astype(jnp.int32),
+                                   axis=0)[0]
+        rdest = jnp.where(live, dest, n)  # OOB rows drop out of the set
+        for a in arrays:
+            buf = jnp.zeros((n, exch), dtype=a.dtype)
+            bufs.append(buf.at[rdest, posr].set(a, mode="drop"))
+    else:
+        # multi-destination scatter: a broadcast row occupies its slot
+        # in EVERY destination bucket, in the same row order
+        dd = jnp.arange(n, dtype=jnp.int32)[:, None]
+        idx = jnp.where(onehot, pos, exch)     # OOB -> dropped
+        for a in arrays:
+            buf = jnp.zeros((n, exch), dtype=a.dtype)
+            bufs.append(buf.at[dd, idx].set(
+                jnp.broadcast_to(a[None], (n,) + a.shape), mode="drop"))
     if abstract:
         recv = bufs                        # all_to_all is shape-preserving
     else:
@@ -203,28 +264,34 @@ def _exchange_local(mesh, node, xi: int, d, abstract: bool):
     return out, need
 
 
-def exchange_apply(mesh, node, xi: int, delta, abstract: bool = False):
+def exchange_apply(mesh, node, xi: int, delta, abstract: bool = False,
+                   bounds: Optional[Tuple[int, ...]] = None,
+                   hot_keys: Tuple[int, ...] = (), hot_side: int = 1):
     """Global-view exchange of one input delta: route every live row to
-    the shard owning its key's vnode block. Returns (routed delta with
+    the shard owning its key's vnode block (under the routing policy —
+    see `_exchange_local`). Returns (routed delta with
     [n_shards, n_shards * exch] rows per shard, max-bucket-fill stat)."""
     import jax
 
     if abstract:
         import jax.numpy as jnp
         n = mesh.devices.size
-        out, need = _exchange_local(mesh, node, xi, _drop(delta), True)
+        out, need = _exchange_local(mesh, node, xi, _drop(delta), True,
+                                    bounds, hot_keys, hot_side)
         lift = lambda t: jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), t)
         return lift(out), need
 
     def local(d):
-        out, need = _exchange_local(mesh, node, xi, _drop(d), False)
+        out, need = _exchange_local(mesh, node, xi, _drop(d), False,
+                                    bounds, hot_keys, hot_side)
         return _lift1(out), need
 
     # specs need only the output TREE STRUCTURE (one P(shard) per leaf);
     # the abstract body mirrors it exactly
     out_sds = jax.eval_shape(
-        lambda d: _exchange_local(mesh, node, xi, _drop(d), True), delta)
+        lambda d: _exchange_local(mesh, node, xi, _drop(d), True,
+                                  bounds, hot_keys, hot_side), delta)
     fn = _shard_map(local, mesh=mesh,
                     in_specs=(_spec_sharded(delta),),
                     out_specs=(_spec_sharded(out_sds[0]),
@@ -234,6 +301,36 @@ def exchange_apply(mesh, node, xi: int, delta, abstract: bool = False):
 
 
 _EXCH_JIT = {}
+# pre-compiled exchange executables (the checkpoint-time policy switch
+# pre-warms its re-routed exchanges here — `prewarm_exchange`), keyed by
+# (mesh fingerprint, node shape, stage, full routing salt, input avals).
+_EXCH_AOT: dict = {}
+# dispatch accounting: `inline` counts DISTINCT signatures that took the
+# trace-on-dispatch path (a policy switch must add none — that is the
+# zero-fresh-compile assertion), `aot_hits` counts pre-warmed dispatches
+EXCH_STATS = {"aot_hits": 0, "calls": 0}
+_EXCH_INLINE: set = set()
+
+
+def delta_sds(tree):
+    """ShapeDtypeStruct mirror (sharding-carrying) of a live delta — the
+    avals `prewarm_exchange` lowers the re-routed exchange against."""
+    import jax
+
+    def sds(l):
+        return jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                    sharding=getattr(l, "sharding", None))
+
+    return jax.tree_util.tree_map(sds, tree)
+
+
+def _exch_key(mesh, node, xi: int, salt, delta_tree) -> Tuple:
+    import jax
+    from .fused import node_shape_key
+    leaves, treedef = jax.tree_util.tree_flatten(delta_tree)
+    avals = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+    return (mesh_fingerprint(mesh), node_shape_key(node), xi, salt,
+            avals, str(treedef))
 
 
 def _exchange_jit(mesh):
@@ -241,19 +338,90 @@ def _exchange_jit(mesh):
     fn = _EXCH_JIT.get(mesh)
     if fn is None:
         fn = jax.jit(
-            lambda delta, *, node, xi, salt:
-            exchange_apply(mesh, node, xi, delta),
-            static_argnames=("node", "xi", "salt"))
+            lambda delta, *, node, xi, salt, bounds, hot_keys, hot_side:
+            exchange_apply(mesh, node, xi, delta, bounds=bounds,
+                           hot_keys=hot_keys, hot_side=hot_side),
+            static_argnames=("node", "xi", "salt", "bounds", "hot_keys",
+                             "hot_side"))
         _EXCH_JIT[mesh] = fn
     return fn
 
 
-def exchange_delta(mesh, node, xi: int, delta):
-    """Jitted exchange dispatch (cached per mesh; static on the node's
-    structural signature + mutable-capacity salt, so an `exch` growth
-    re-traces exactly this small program and nothing else)."""
+def _exch_salt(node, bounds) -> Tuple:
+    """Full routing salt of one exchange dispatch: the node's mutable-
+    capacity salt plus everything the routing policy can change."""
+    return (node._mut_sig(), bounds, node.hot_keys, node.hot_rep_side)
+
+
+def exchange_delta(mesh, node, xi: int, delta,
+                   bounds: Optional[Tuple[int, ...]] = None):
+    """Exchange dispatch: a pre-warmed executable when the policy switch
+    staged one (zero compile), else the jitted path (cached per mesh;
+    static on the node's structural signature + mutable-capacity salt +
+    routing policy, so an `exch` growth or a policy change re-traces
+    exactly this small program and nothing else)."""
+    EXCH_STATS["calls"] += 1
+    salt = _exch_salt(node, bounds)
+    key = _exch_key(mesh, node, xi, salt, delta)
+    compiled = _EXCH_AOT.get(key)
+    if compiled is not None:
+        EXCH_STATS["aot_hits"] += 1
+        return compiled(delta)
+    _EXCH_INLINE.add(key)
     return _exchange_jit(mesh)(delta, node=node, xi=xi,
-                               salt=node._mut_sig())
+                               salt=node._mut_sig(), bounds=bounds,
+                               hot_keys=node.hot_keys,
+                               hot_side=node.hot_rep_side)
+
+
+def prewarm_exchange(mesh, node, xi: int, sds_delta,
+                     bounds: Optional[Tuple[int, ...]] = None,
+                     hot_keys: Tuple[int, ...] = (),
+                     hot_rep_side: int = 1) -> None:
+    """AOT-compile one exchange stage under a PROSPECTIVE routing policy
+    (background work for the checkpoint-time policy switch): lower the
+    same trace `exchange_delta` would take, against the avals of the
+    last dispatched delta, and park the executable where the post-switch
+    dispatch finds it — the compile-service pattern, applied to the one
+    program a routing change re-traces."""
+    salt = (node._mut_sig(), bounds, tuple(hot_keys), int(hot_rep_side))
+    key = _exch_key(mesh, node, xi, salt, sds_delta)
+    if key in _EXCH_AOT:
+        return
+    fn = _exchange_jit(mesh)
+    lowered = fn.lower(sds_delta, node=node, xi=xi, salt=node._mut_sig(),
+                       bounds=bounds, hot_keys=tuple(hot_keys),
+                       hot_side=int(hot_rep_side))
+    _EXCH_AOT[key] = lowered.compile()
+
+
+def prune_exchange_aot(mesh, nodes_bounds) -> None:
+    """Drop pre-warmed exchange executables superseded by an adopted
+    routing policy: for each given (node, bounds), entries keyed by that
+    node's SHAPE whose salt differs from the node's CURRENT routing salt
+    are dead weight (without this, every policy switch would retain the
+    previous policy's compiled executables forever). Shape-keyed, so
+    other plans' entries are untouched; a structurally identical twin
+    job still on the old policy merely re-traces once (correct, rare)."""
+    from .fused import node_shape_key
+    meshfp = mesh_fingerprint(mesh)
+    live = {}
+    for node, bounds in nodes_bounds:
+        live.setdefault(node_shape_key(node), set()).add(
+            _exch_salt(node, bounds))
+    for key in [k for k in _EXCH_AOT
+                if k[0] == meshfp and k[1] in live
+                and k[3] not in live[k[1]]]:
+        del _EXCH_AOT[key]
+
+
+def exchange_stats() -> dict:
+    """Exchange-dispatch accounting (tests assert a policy switch adds
+    zero `inline_keys` — no fresh exchange trace at the switch)."""
+    return {"inline_keys": len(_EXCH_INLINE),
+            "aot_hits": EXCH_STATS["aot_hits"],
+            "prewarmed": len(_EXCH_AOT),
+            "calls": EXCH_STATS["calls"]}
 
 
 # ---------------------------------------------------------------------------
@@ -379,15 +547,74 @@ def sharded_node_step(mesh, node, epoch_events: int, state, ins, extra):
 # ---------------------------------------------------------------------------
 
 
-def merge_keyed_pull(states, mesh, col_dtypes):
-    """Gather a sharded keyed-MV state: all shards' live prefixes in one
-    batched pull, merged by ascending packed key — keys are globally
-    unique (each lives on its vnode's shard) and every shard's run is
-    already sorted, so the merge reproduces the 1-shard `mv_rows` order
-    exactly (bit-identity)."""
+_GATHER_JIT = {}
+
+
+def _gather_jit(mesh, kind: str, nc: int, m: int):
+    """Jitted device-side gather+merge of a sharded terminal-MV state:
+    flatten the shard axis, sort live rows to the front IN MERGED KEY
+    ORDER (keys/pair identities are globally unique and EMPTY_KEY pads
+    sort last), slice to the static live bound `m`, and replicate the
+    result — so the host pays ONE device_get per SELECT regardless of
+    shard count, instead of a counts round-trip plus per-shard prefix
+    fetches."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    key = (mesh_fingerprint(mesh), kind, nc, m)
+    fn = _GATHER_JIT.get(key)
+    if fn is not None:
+        return fn
+    rep = NamedSharding(mesh, P())
+
+    if kind == "keyed":
+        def gather(st):
+            keys = st.keys.reshape(-1)
+            order = jnp.argsort(keys)[:m]      # unique keys; pads last
+            cols = [st.vals[1 + 2 * i].reshape(-1)[order]
+                    for i in range(nc)]
+            nulls = [st.vals[2 + 2 * i].reshape(-1)[order]
+                     for i in range(nc)]
+            return (jnp.sum(st.count), keys[order], cols, nulls)
+    else:
+        def gather(side):
+            from .sorted_state import sort_cols
+            jk = side.jk.reshape(-1)
+            pk = side.pk.reshape(-1)
+            (jks, _pks), vals = sort_cols(
+                [jk, pk], [v.reshape(-1) for v in side.vals])
+            return (jnp.sum(side.count), [v[:m] for v in vals])
+
+    fn = jax.jit(gather, out_shardings=rep)
+    _GATHER_JIT[key] = fn
+    return fn
+
+
+def merge_keyed_pull(states, mesh, col_dtypes, live_bound=None):
+    """Gather a sharded keyed-MV state merged by ascending packed key —
+    keys are globally unique (each lives on its vnode's shard), so the
+    merged order IS the 1-shard `mv_rows` order (bit-identity).
+
+    With `live_bound` (caller's high-water live-row estimate, from the
+    "needed" stat the sync already pulled), the merge runs IN-PROGRAM:
+    device-side sort + compaction + replication, ONE device_get total.
+    A stale bound (device holds more live rows than estimated) falls
+    back to the two-round-trip host merge — correctness never depends
+    on the estimate."""
     import jax
     n = mesh.devices.size
     nc = len(col_dtypes)
+    if live_bound:
+        from .capacity import bucket
+        cap_total = n * states.keys.shape[1]
+        m = min(cap_total, bucket(max(1, int(live_bound)), lo=256))
+        total, keys, cols, nulls = jax.device_get(
+            _gather_jit(mesh, "keyed", nc, m)(states))
+        total = int(total)
+        if total <= m:
+            return (np.asarray(keys)[:total],
+                    [np.asarray(c)[:total] for c in cols],
+                    [np.asarray(u)[:total] for u in nulls])
     counts = [int(c) for c in np.asarray(jax.device_get(states.count))]
     # one batched transfer for all shards' live prefixes — per-shard
     # mv_rows pulls would pay n_shards * (1 + 2 * n_cols) host syncs
@@ -409,13 +636,23 @@ def merge_keyed_pull(states, mesh, col_dtypes):
     return keys[order], cols, nulls
 
 
-def merge_pair_pull(side, mesh):
+def merge_pair_pull(side, mesh, live_bound=None):
     """Gather a sharded pair-MV JoinSide: per-shard live prefixes merged
     by (jk, pk) — the sort key of the single-chip sorted multimap, and a
     globally unique pair identity, so the merged order is bit-identical
-    to the 1-shard pull."""
+    to the 1-shard pull. With `live_bound`, the merge runs in-program
+    (ONE device_get — see merge_keyed_pull); a stale bound falls back."""
     import jax
     n = mesh.devices.size
+    if live_bound:
+        from .capacity import bucket
+        cap_total = n * side.jk.shape[1]
+        m = min(cap_total, bucket(max(1, int(live_bound)), lo=256))
+        total, vals = jax.device_get(
+            _gather_jit(mesh, "pair", len(side.vals), m)(side))
+        total = int(total)
+        if total <= m:
+            return total, [np.asarray(v)[:total] for v in vals]
     # counts first, then per-shard LIVE prefixes only — a grown pair
     # capacity must not make every SELECT transfer n_shards x capacity
     # padded rows for each column

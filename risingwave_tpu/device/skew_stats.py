@@ -98,10 +98,126 @@ def epoch_topk(keys, live, empty_key) -> List:
     return out
 
 
+def weighted_topk(keys, counts, empty_key) -> List:
+    """Top-K (count, key) from ALREADY-COMBINED (key, count) rows — the
+    pre-combined agg path (`PrecombineNode`) arrives with exact per-key
+    epoch counts, so the sort/segment pass of `epoch_topk` is redundant:
+    pack and take the K largest. Rows with key == empty_key or count <= 0
+    drop out."""
+    import jax
+    import jax.numpy as jnp
+    n = keys.shape[0]
+    packed = jnp.where(
+        (counts > 0) & (keys != empty_key),
+        (jnp.minimum(counts.astype(jnp.int64), SK_COUNT_MAX) << SK_SHIFT)
+        | (keys & SK_KEY_MASK),
+        0)
+    top, _ = jax.lax.top_k(packed, min(SK_TOPK, n))
+    out = [top[i] for i in range(min(SK_TOPK, n))]
+    out += [jnp.zeros((), jnp.int64)] * (SK_TOPK - len(out))
+    return out
+
+
 def unpack_hot(packed: int) -> Tuple[int, int]:
     """Host-side decode of one heavy-hitter slot -> (key40, count)."""
     packed = int(packed)
     return packed & SK_KEY_MASK, packed >> SK_SHIFT
+
+
+# ---------------------------------------------------------------------------
+# host-side policy math: occupancy histogram -> shard loads -> new bounds
+# ---------------------------------------------------------------------------
+
+
+def shard_loads(bucket_counts, bounds, vnode_count: int = VNODE_COUNT
+                ) -> List[float]:
+    """Per-shard load implied by the SK_BUCKETS-bucket occupancy
+    histogram under the given vnode-block `bounds` (len n_shards + 1,
+    bounds[0]=0, bounds[-1]=vnode_count). A histogram bucket that
+    straddles a block boundary splits proportionally (keys are assumed
+    uniform WITHIN a bucket — the histogram is the finest evidence the
+    traced step exports)."""
+    nb = len(bucket_counts)
+    per_bucket = vnode_count / float(nb)
+    loads = []
+    for s in range(len(bounds) - 1):
+        lo, hi = float(bounds[s]), float(bounds[s + 1])
+        load = 0.0
+        for b, c in enumerate(bucket_counts):
+            blo, bhi = b * per_bucket, (b + 1) * per_bucket
+            ov = min(hi, bhi) - max(lo, blo)
+            if ov > 0:
+                load += c * ov / per_bucket
+        loads.append(load)
+    return loads
+
+
+def shard_skew_ratio(bucket_counts, bounds,
+                     vnode_count: int = VNODE_COUNT) -> float:
+    """max/mean of the per-shard loads under `bounds` — the straggler
+    predictor the rebalancer thresholds on (vs `skew_ratio`, which is
+    bounds-independent raw key skew)."""
+    loads = shard_loads(bucket_counts, bounds, vnode_count)
+    total = sum(loads)
+    if total <= 0:
+        return 0.0
+    return max(loads) / (total / len(loads))
+
+
+def balanced_bounds(bucket_counts, n_shards: int,
+                    vnode_count: int = VNODE_COUNT) -> Tuple[int, ...]:
+    """Contiguous vnode-block bounds that even out the observed bucket
+    loads: boundaries land at histogram-bucket granularity (the evidence
+    resolution), each placed where the load prefix crosses the next
+    1/n_shards quantile. Contiguity is preserved (rescale and the
+    sorted-run state layout depend on it); blocks may be EMPTY (equal
+    consecutive bounds) when one bucket dominates — that is the point:
+    the hot bucket gets a shard to itself."""
+    nb = len(bucket_counts)
+    per_bucket = vnode_count // nb
+    counts = [int(c) for c in bucket_counts]
+    if sum(counts) <= 0 or n_shards <= 1:
+        from ..parallel.mesh import vnode_block_bounds
+        return tuple(int(v) for v in vnode_block_bounds(n_shards,
+                                                        vnode_count))
+
+    def blocks_needed(cap: int) -> int:
+        blocks, acc = 1, 0
+        for c in counts:
+            if acc + c > cap:
+                blocks += 1
+                acc = 0
+            acc += c
+        return blocks
+
+    # minimize the max block load (binary search on the answer + greedy
+    # feasibility — optimal for contiguous partitions)
+    lo, hi = max(counts), sum(counts)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if blocks_needed(mid) <= n_shards:
+            hi = mid
+        else:
+            lo = mid + 1
+    bounds, acc = [0], 0
+    for b, c in enumerate(counts):
+        if acc + c > lo and len(bounds) < n_shards:
+            bounds.append(b * per_bucket)
+            acc = 0
+        acc += c
+    bounds += [vnode_count] * (n_shards + 1 - len(bounds))
+    return tuple(bounds)
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(counts) -> str:
+    """Unicode sparkline of a histogram (risectl skew)."""
+    hi = max([c for c in counts] + [1])
+    return "".join(_SPARK[min(len(_SPARK) - 1,
+                              int(c * len(_SPARK) / hi)) if c else 0]
+                   for c in counts)
 
 
 def skew_ratio(bucket_counts) -> float:

@@ -465,21 +465,55 @@ class Database:
         if connector == "dml":
             return ListReader([])
         if connector == "nexmark":
-            from ..connectors.nexmark import NexmarkGenerator
+            from ..connectors.nexmark import NexmarkConfig, NexmarkGenerator
             table = stmt.with_options.get("nexmark.table", "bid").lower()
             maxe = stmt.with_options.get("nexmark.max.events")
             per = int(stmt.with_options.get("nexmark.chunk.size", "8192"))
+            kd = stmt.with_options.get("nexmark.key.dist", "")
             if self._nexmark_gen is None:
-                self._nexmark_gen = NexmarkGenerator()
+                # key_dist (e.g. 'zipf:1.5') reshapes the bid
+                # auction/bidder picks into a power-law — reproducible
+                # skewed workloads for tests and bench. The generator is
+                # shared across this database's nexmark sources (one
+                # event clock), so the FIRST nexmark source pins it.
+                self._nexmark_gen = NexmarkGenerator(
+                    NexmarkConfig(key_dist=kd) if kd else None)
+            elif kd and self._nexmark_gen.cfg.key_dist != kd:
+                raise ValueError(
+                    "nexmark sources share one generator; key.dist "
+                    f"{kd!r} conflicts with "
+                    f"{self._nexmark_gen.cfg.key_dist!r}")
             cols = [c.name for c in stmt.columns]
             return NexmarkReader(table, self._nexmark_gen,
                                  events_per_poll=per,
                                  max_events=int(maxe) if maxe else None,
                                  columns=cols)
         if connector == "datagen":
+            from ..connectors.datagen import FieldGen
             per = int(float(stmt.with_options.get("rows.per.poll", "1024")))
             maxr = stmt.with_options.get("datagen.max.rows")
-            return DatagenReader(schema, rows_per_chunk=per,
+            # fields.<col>.kind = 'sequence' | 'random' | 'zipf:<s>'
+            # (+ fields.<col>.start/end/seed) — the reference's datagen
+            # field options; zipf makes skewed keys reproducible
+            fields: Dict[str, FieldGen] = {}
+            for k, v in stmt.with_options.items():
+                if not k.startswith("fields.") or not k.endswith(".kind"):
+                    continue
+                col = k[len("fields."):-len(".kind")]
+                opts = stmt.with_options
+                kind, s = str(v), 1.5
+                if kind.startswith("zipf"):
+                    kind, _, sv = kind.partition(":")
+                    s = float(sv) if sv else 1.5
+                    kind = "zipf"
+                fields[col] = FieldGen(
+                    kind=kind,
+                    start=int(opts.get(f"fields.{col}.start", "0")),
+                    end=int(opts.get(f"fields.{col}.end", str(2**31))),
+                    seed=int(opts.get(f"fields.{col}.seed", "0")),
+                    s=s)
+            return DatagenReader(schema, fields=fields or None,
+                                 rows_per_chunk=per,
                                  max_rows=int(maxr) if maxr else None)
         if connector in ("fs", "filesystem", "posix_fs"):
             from ..connectors.base import SplitSourceReader, make_parser
@@ -642,6 +676,9 @@ class Database:
                 self.catalog.create(obj)
                 self._fused[stmt.name] = job
                 job.profiler.attach(self._data_dir)
+                # skew snapshots (risectl skew, offline-capable) mirror
+                # beside epoch_profile.jsonl at every checkpoint
+                job.data_dir = self._data_dir
                 job.freshness = self._freshness
                 if job.compile_service is not None and self._data_dir:
                     # mirror the compile manifest into the data dir so

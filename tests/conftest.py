@@ -16,6 +16,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # have. Pin it OFF suite-wide; the dedicated skew tests
 # (test_observability2.py) force it back on per test.
 os.environ.setdefault("RW_SKEW_STATS", "0")
+# Same budget call for the agg pre-combine stage (an extra traced
+# program per fused agg): pinned OFF suite-wide, forced on per test by
+# the dedicated skew-defense tests (test_skew_ops.py). Production
+# default stays ON (DeviceConfig.agg_precombine).
+os.environ.setdefault("RW_AGG_PRECOMBINE", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -76,6 +81,11 @@ def pytest_sessionfinish(session, exitstatus):
     try:
         from risingwave_tpu.device.compile_service import shutdown
         shutdown(join=True, timeout=60.0)
+    except ImportError:
+        pass
+    try:
+        from risingwave_tpu.device.fused import join_prewarm_threads
+        join_prewarm_threads(timeout=30.0)
     except ImportError:
         pass
     from risingwave_tpu.utils.metrics import REGISTRY, lint_registry

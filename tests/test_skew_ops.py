@@ -1,0 +1,516 @@
+"""Skew-proof device operators (ISSUE 13).
+
+The contract under test: three defenses — local pre-combine
+(`PrecombineNode` + AggNode combined mode), hot-key replication
+(exchange-level broadcast/salt routing for heavy-hitter join keys), and
+barrier-time vnode rebalancing (`FusedJob._maybe_retune` driven by the
+`rw_key_skew` evidence) — are each gated by a `DeviceConfig` knob, each
+BIT-IDENTICAL to the unskewed path (row order included), and the routing
+switch is zero-fresh-compile and survives a checkpoint/recovery cycle.
+Plus the satellites: Zipfian datagen (host/device bit-identical),
+`risectl skew` offline, and the policy math.
+
+The conftest pins RW_SKEW_STATS / RW_AGG_PRECOMBINE off suite-wide for
+compile budget; every test here forces what it needs back on via
+monkeypatch (the env is read at CREATE time).
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.config import DeviceConfig
+from risingwave_tpu.core.vnode import VNODE_COUNT
+from risingwave_tpu.sql import Database
+
+N = 4096
+CHUNK = 32          # fused epoch = 64 * CHUNK = 2048 events
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='{c}', nexmark.key.dist='{kd}')")
+AUCTION_SRC = ("CREATE SOURCE auction (id BIGINT, item_name VARCHAR,"
+               " description VARCHAR, initial_bid BIGINT, reserve BIGINT,"
+               " date_time TIMESTAMP, expires TIMESTAMP, seller BIGINT,"
+               " category BIGINT, extra VARCHAR) WITH (connector='nexmark',"
+               " nexmark.table='auction', nexmark.max.events='{n}',"
+               " nexmark.chunk.size='{c}')")
+PERSON_SRC = ("CREATE SOURCE person (id BIGINT, name VARCHAR,"
+              " email_address VARCHAR, credit_card VARCHAR, city VARCHAR,"
+              " state VARCHAR, date_time TIMESTAMP, extra VARCHAR)"
+              " WITH (connector='nexmark', nexmark.table='person',"
+              " nexmark.max.events='{n}', nexmark.chunk.size='{c}')")
+
+Q1_MV = ("CREATE MATERIALIZED VIEW q1a AS SELECT bidder,"
+         " count(*) AS n, sum(price) AS dol, max(price) AS top"
+         " FROM bid GROUP BY bidder")
+Q3_MV = ("CREATE MATERIALIZED VIEW q3a AS SELECT b.auction, b.price,"
+         " a.seller, a.category FROM bid b JOIN auction a"
+         " ON b.auction = a.id WHERE b.price > 500")
+Q5_MV = """CREATE MATERIALIZED VIEW q5 AS
+SELECT AuctionBids.auction, AuctionBids.num FROM (
+    SELECT bid.auction, count(*) AS num, window_start AS starttime
+    FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+    GROUP BY window_start, bid.auction
+) AS AuctionBids
+JOIN (
+    SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+    FROM (
+        SELECT count(*) AS num, window_start AS starttime_c
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY bid.auction, window_start
+    ) AS CountBids
+    GROUP BY CountBids.starttime_c
+) AS MaxBids
+ON AuctionBids.starttime = MaxBids.starttime_c
+   AND AuctionBids.num >= MaxBids.maxn"""
+
+
+def _arm(monkeypatch, skew="1", pre="1", hot="1", reb="1"):
+    monkeypatch.setenv("RW_SKEW_STATS", skew)
+    monkeypatch.setenv("RW_AGG_PRECOMBINE", pre)
+    monkeypatch.setenv("RW_HOT_KEY_REP", hot)
+    monkeypatch.setenv("RW_VNODE_REBALANCE", reb)
+
+
+def _run(mv_sql, name, shards, srcs=(), kd="zipf:4", n=N, capacity=2048,
+         aot=False, data_dir=None, keep=False, threshold=1.2,
+         settle=True):
+    """One fused run: CREATE, drive to drain, let any staged skew policy
+    adopt, return (sorted-as-served rows, job[, db])."""
+    db = Database(device=DeviceConfig(capacity=capacity,
+                                      mesh_shards=shards,
+                                      aot_compile=aot, compile_buckets=0,
+                                      rebalance_threshold=threshold),
+                  data_dir=data_dir)
+    for s in srcs or (BID_SRC,):
+        db.run(s.format(n=n, c=CHUNK, kd=kd))
+    db.run(mv_sql)
+    job = db.catalog.get(name).runtime["fused_job"]
+    assert job is not None, f"{name} must fuse"
+    for _ in range(n // (64 * CHUNK) + 3):
+        db.tick()
+    job.sync()
+    if settle:
+        # a staged policy adopts at the first checkpoint that finds its
+        # background pre-warm finished — drive until settled
+        for _ in range(60):
+            if job._pending_policy is None:
+                break
+            time.sleep(0.1)
+            db.tick()
+        db.tick()
+    rows = db.query(f"SELECT * FROM {name}")
+    return (rows, job, db) if keep else (rows, job, None)
+
+
+# ---------------------------------------------------------------------------
+# policy math (host-side, fast)
+# ---------------------------------------------------------------------------
+
+
+def test_balanced_bounds_properties():
+    from risingwave_tpu.device.skew_stats import (SK_BUCKETS,
+                                                  balanced_bounds,
+                                                  shard_loads,
+                                                  shard_skew_ratio)
+    from risingwave_tpu.parallel.mesh import vnode_block_bounds
+    rng = np.random.RandomState(7)
+    for n in (2, 3, 8):
+        for _ in range(20):
+            occ = rng.randint(0, 50, SK_BUCKETS).tolist()
+            b = balanced_bounds(occ, n)
+            # contiguous cover: monotone, 0..VNODE_COUNT, right length
+            assert len(b) == n + 1 and b[0] == 0 and b[-1] == VNODE_COUNT
+            assert all(b[i] <= b[i + 1] for i in range(n))
+            # bucket granularity (the evidence resolution)
+            per = VNODE_COUNT // SK_BUCKETS
+            assert all(v % per == 0 for v in b)
+            # never worse than the uniform layout — comparable only
+            # when the uniform bounds are themselves bucket-aligned
+            # (a non-dividing n splits buckets fractionally, which a
+            # bucket-granular partition cannot express)
+            uni = tuple(int(v) for v in vnode_block_bounds(n))
+            if sum(occ) and all(v % per == 0 for v in uni):
+                assert max(shard_loads(occ, b)) \
+                    <= max(shard_loads(occ, uni)) + 1e-9
+            if sum(occ):
+                assert shard_skew_ratio(occ, b) >= 1.0 - 1e-9
+
+
+def test_balanced_bounds_isolates_hot_bucket():
+    from risingwave_tpu.device.skew_stats import (balanced_bounds,
+                                                  shard_loads)
+    occ = [0] * 16
+    occ[5] = 90          # one bucket dominates
+    occ[0] = occ[11] = 5
+    b = balanced_bounds(occ, 8)
+    loads = shard_loads(occ, b)
+    assert max(loads) == 90          # can't split below a bucket...
+    assert sorted(loads)[-2] <= 5    # ...but nothing shares its shard
+
+
+def test_shard_loads_split_straddling_bucket():
+    from risingwave_tpu.device.skew_stats import shard_loads
+    # 3 shards over 256 vnodes: bucket 5 ([80, 96)) straddles the
+    # 85/86 boundary — its count splits proportionally
+    occ = [0] * 16
+    occ[5] = 32
+    loads = shard_loads(occ, (0, 85, 170, 256))
+    assert abs(loads[0] - 32 * 5 / 16) < 1e-9
+    assert abs(loads[1] - 32 * 11 / 16) < 1e-9
+    assert loads[2] == 0
+
+
+def test_sparkline_shape():
+    from risingwave_tpu.device.skew_stats import sparkline
+    s = sparkline([0, 1, 8, 4])
+    assert len(s) == 4 and s[0] == "▁" and s[2] == "█"
+
+
+# ---------------------------------------------------------------------------
+# Zipfian datagen (satellite): host == device, SQL plumbing, FieldGen
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skew
+def test_zipf_host_device_bit_identity():
+    import jax.numpy as jnp
+    from risingwave_tpu.connectors.nexmark import (NexmarkConfig,
+                                                   NexmarkGenerator,
+                                                   _event_kinds)
+    from risingwave_tpu.device.nexmark_gen import GenCfg, gen_table
+    cfg = NexmarkConfig(key_dist="zipf:1.5")
+    ids = np.arange(50_000, dtype=np.int64)
+    bids = ids[_event_kinds(ids) == 2]
+    host = NexmarkGenerator(cfg).gen_bids(bids)
+    dev = gen_table(GenCfg.from_config(cfg), "bid", jnp.asarray(bids))
+    assert np.array_equal(host.columns[0].values,
+                          np.asarray(dev["auction"]))
+    assert np.array_equal(host.columns[1].values,
+                          np.asarray(dev["bidder"]))
+    # it IS a power law: rank-1 dominates, counts decay
+    _, counts = np.unique(host.columns[0].values, return_counts=True)
+    top = np.sort(counts)[::-1]
+    assert top[0] > 0.15 * counts.sum()
+    assert top[0] > 2 * top[1] > 0
+
+
+def test_zipf_key_dist_validation():
+    from risingwave_tpu.device.nexmark_gen import key_dist_s
+    assert key_dist_s("zipf:1.5") == 1.5
+    assert key_dist_s("zipf") == 1.5
+    with pytest.raises(ValueError):
+        key_dist_s("zipf:1.0")          # needs s > 1
+    with pytest.raises(ValueError):
+        key_dist_s("uniform:2")
+
+
+def test_datagen_fieldgen_zipf():
+    from risingwave_tpu.connectors.datagen import FieldGen
+    from risingwave_tpu.core import dtypes as T
+    g = FieldGen(kind="zipf", start=100, end=200, seed=3, s=2.0)
+    col = g.generate(T.INT64, np.arange(20_000, dtype=np.int64))
+    vals = np.asarray(col.values)
+    assert vals.min() >= 100 and vals.max() < 200
+    u, c = np.unique(vals, return_counts=True)
+    assert u[np.argmax(c)] == 100        # rank 1 = start, the hot key
+    assert c.max() > 0.3 * c.sum()
+    # deterministic: same seed, same stream
+    again = np.asarray(g.generate(T.INT64,
+                                  np.arange(20_000, dtype=np.int64)).values)
+    assert np.array_equal(vals, again)
+
+
+def test_datagen_sql_zipf_option():
+    db = Database()
+    db.run("CREATE SOURCE s (k BIGINT, v BIGINT) WITH ("
+           "connector='datagen', fields.k.kind='zipf:2.0',"
+           " fields.k.start='1', fields.k.end='50',"
+           " datagen.max.rows='4096', rows.per.poll='1024')")
+    db.run("CREATE MATERIALIZED VIEW zz AS SELECT k, count(*) AS c"
+           " FROM s GROUP BY k")
+    for _ in range(8):
+        db.tick()
+    counts = {int(k): int(c) for k, c in db.query("SELECT * FROM zz")}
+    assert min(counts) >= 1 and max(counts) < 50
+    total = sum(counts.values())
+    assert total == 4096
+    assert counts[1] == max(counts.values())   # start = rank 1, hot
+    assert counts[1] > 0.3 * total
+
+
+def test_nexmark_key_dist_conflict_rejected():
+    db = Database()
+    db.run(BID_SRC.format(n=256, c=32, kd="zipf:2"))
+    with pytest.raises(ValueError):
+        db.run(AUCTION_SRC.format(n=256, c=32)
+               .replace("nexmark.table='auction'",
+                        "nexmark.table='auction', "
+                        "nexmark.key.dist='zipf:3'"))
+
+
+# ---------------------------------------------------------------------------
+# defense 1: local pre-combine (1-shard; mesh identity below)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skew
+def test_precombine_bit_identity_and_noop_path(monkeypatch):
+    from risingwave_tpu.device.fused import PrecombineNode
+    _arm(monkeypatch, pre="0")
+    r_off, _, _ = _run(Q1_MV, "q1a", 1)
+    _arm(monkeypatch, pre="1")
+    r_on, job, _ = _run(Q1_MV, "q1a", 1)
+    assert any(isinstance(nd, PrecombineNode) for nd in job.program.nodes)
+    assert r_off == r_on                 # bit-identical, order included
+    # all-unique keys (person id is unique per row): pre-combine is a
+    # pure no-op pass-through — same rows either way
+    mv = ("CREATE MATERIALIZED VIEW pp AS SELECT id, count(*) AS c"
+          " FROM person GROUP BY id")
+    _arm(monkeypatch, pre="0")
+    u_off, _, _ = _run(mv, "pp", 1, srcs=(PERSON_SRC,), n=1024)
+    _arm(monkeypatch, pre="1")
+    u_on, ujob, _ = _run(mv, "pp", 1, srcs=(PERSON_SRC,), n=1024)
+    assert any(isinstance(nd, PrecombineNode) for nd in ujob.program.nodes)
+    assert u_off == u_on and len(u_on) > 0
+    # unique keys: combined rows == raw rows (rows_out == rows_in)
+    pre_i = next(i for i, nd in enumerate(ujob.program.nodes)
+                 if isinstance(nd, PrecombineNode))
+    st = ujob.program.node_stats(pre_i, ujob._stat_totals)
+    assert st["rows_out"] == st["rows_in"] > 0
+
+
+@pytest.mark.skew
+def test_precombine_skipped_for_exact_minmax(monkeypatch):
+    # retractable min/max (multiset state) is NOT exactly combinable by
+    # group alone — the planner must keep the raw path
+    from risingwave_tpu.device.fused import AggNode, PrecombineNode
+    _arm(monkeypatch)
+    mv = ("CREATE MATERIALIZED VIEW mm AS SELECT starttime_c,"
+          " max(num) AS maxn FROM ("
+          "   SELECT count(*) AS num, window_start AS starttime_c"
+          "   FROM HOP(bid, date_time, INTERVAL '2' SECOND,"
+          "            INTERVAL '10' SECOND)"
+          "   GROUP BY bid.auction, window_start) t"
+          " GROUP BY starttime_c")
+    _, job, _ = _run(mv, "mm", 1, n=1024)
+    aggs = [nd for nd in job.program.nodes if isinstance(nd, AggNode)]
+    pres = [nd for nd in job.program.nodes
+            if isinstance(nd, PrecombineNode)]
+    # first-level count agg combines; the retractable max agg does not
+    assert any(a.combined for a in aggs)
+    assert any(not a.combined and a.spec.minputs for a in aggs)
+    assert len(pres) == sum(a.combined for a in aggs)
+
+
+# ---------------------------------------------------------------------------
+# mesh defenses: bit-identity + rebalance + zero-compile + recovery
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+@pytest.mark.skew
+def test_q1_mesh_defenses_bit_identity_and_rebalance(
+        monkeypatch, tmp_path):
+    d = str(tmp_path / "d")
+    # defenses OFF at both shard counts: the reference pair
+    _arm(monkeypatch, pre="0", hot="0", reb="0")
+    r1, _, _ = _run(Q1_MV, "q1a", 1)
+    r8_off, _, _ = _run(Q1_MV, "q1a", 8)
+    assert r1 == r8_off
+    # defenses ON at 8 shards, AOT on, persisted: the zipf:4 bidder set
+    # is small and lumpy across vnode buckets, so occupancy crosses the
+    # 1.2 threshold and the job rebalances at a checkpoint
+    _arm(monkeypatch)
+    r8_on, job, db = _run(Q1_MV, "q1a", 8, aot=True, data_dir=d,
+                          keep=True)
+    assert r8_on == r1                  # bit-identical THROUGH the switch
+    assert job.rebalances >= 1
+    assert job.program.vnode_bounds is not None
+    bounds = job.program.vnode_bounds
+    # the adopted bounds even out the per-shard load implied by the
+    # occupancy histogram (vs the uniform layout)
+    from risingwave_tpu.device.skew_stats import (SK_BUCKETS,
+                                                  shard_skew_ratio)
+    from risingwave_tpu.parallel.mesh import vnode_block_bounds
+    agg_i = next(i for i, nd in enumerate(job.program.nodes)
+                 if nd.skew and nd.exch is not None)
+    st = job.program.node_stats(agg_i, job._stat_totals)
+    occ = [st[f"skv{b}"] for b in range(SK_BUCKETS)]
+    uni = tuple(int(v) for v in vnode_block_bounds(8))
+    assert shard_skew_ratio(occ, bounds) \
+        <= shard_skew_ratio(occ, uni) + 1e-9
+    # rw_key_skew carries the shard-load surface
+    skew_rows = db.query("SELECT * FROM rw_key_skew WHERE job = 'q1a'")
+    assert any(r[3] == "shard_load" for r in skew_rows)
+    assert any(r[3] == "shard_skew" for r in skew_rows)
+    # ---- survives a checkpoint/recovery cycle -----------------------
+    for _ in range(3):
+        db.tick()
+    r_live = db.query("SELECT * FROM q1a")
+    db2 = Database(device=DeviceConfig(capacity=2048, mesh_shards=8,
+                                       aot_compile=True,
+                                       compile_buckets=0,
+                                       rebalance_threshold=1.2),
+                   data_dir=d)
+    job2 = db2.catalog.get("q1a").runtime["fused_job"]
+    assert job2.program.vnode_bounds == bounds
+    assert job2.rebalances >= 1
+    assert db2.query("SELECT * FROM q1a") == r_live == r1
+
+
+@pytest.mark.mesh
+@pytest.mark.skew
+def test_rebalance_switch_is_zero_fresh_compile(monkeypatch):
+    from risingwave_tpu.device import shard_exec
+    from risingwave_tpu.device.compile_service import get_service
+    # rebalancing held OFF while the job drives to drain, so every
+    # node-step signature compiles up front and the measurement window
+    # below brackets EXACTLY the stage -> pre-warm -> adopt sequence
+    _arm(monkeypatch, hot="0", reb="0")
+    db = Database(device=DeviceConfig(capacity=2048, mesh_shards=8,
+                                      aot_compile=True, compile_buckets=0,
+                                      rebalance_threshold=1.2))
+    db.run(BID_SRC.format(n=N, c=CHUNK, kd="zipf:4"))
+    db.run(Q1_MV)
+    job = db.catalog.get("q1a").runtime["fused_job"]
+    svc = get_service()
+    for _ in range(N // (64 * CHUNK) + 2):
+        db.tick()
+    job.sync()
+    db.tick()                            # a checkpoint with fresh stats
+    svc.wait_idle(60)
+    before = svc.summary()
+    e_before = shard_exec.exchange_stats()
+    job.rebalance = True                 # open the policy loop
+    for _ in range(200):
+        if job.rebalances:
+            break
+        if job._pending_policy is not None:
+            assert job._pending_policy[2].wait(60), \
+                "exchange pre-warm hung"
+        db.tick()
+        time.sleep(0.02)
+    assert job.rebalances >= 1, "skew policy never adopted"
+    after = svc.summary()
+    e_after = shard_exec.exchange_stats()
+    # zero fresh compiles at the switch: no node-step compile was
+    # requested (the signatures never changed) and the re-routed
+    # exchange dispatched on its pre-warmed executable
+    assert after["compiles"] == before["compiles"]
+    assert after["pending"] == 0
+    assert e_after["inline_keys"] == e_before["inline_keys"]
+    assert e_after["aot_hits"] > e_before["aot_hits"]
+
+
+# ---------------------------------------------------------------------------
+# defense 2: hot-key replication (99%-one-key join)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+@pytest.mark.skew
+def test_q3_hot_key_replication_99pct_one_key(monkeypatch):
+    from risingwave_tpu.device.fused import JoinNode
+    # zipf:8 ~> 99% of bids hit auction rank 1 — the one-hot-key case
+    _arm(monkeypatch, reb="0")
+    r1, _, _ = _run(Q3_MV, "q3a", 1, srcs=(BID_SRC, AUCTION_SRC),
+                    kd="zipf:8")
+    _arm(monkeypatch, hot="0", reb="0")
+    r8_off, _, _ = _run(Q3_MV, "q3a", 8, srcs=(BID_SRC, AUCTION_SRC),
+                        kd="zipf:8")
+    _arm(monkeypatch, reb="0")
+    r8_on, job, _ = _run(Q3_MV, "q3a", 8, srcs=(BID_SRC, AUCTION_SRC),
+                         kd="zipf:8")
+    joins = [nd for nd in job.program.nodes if isinstance(nd, JoinNode)]
+    assert joins and all(nd.hotrep for nd in joins)
+    armed = [nd for nd in joins if nd.hot_keys]
+    assert armed, "heavy hitter never promoted to a hot key"
+    # the hot key is the dominant auction (packed key = id - offset = 0
+    # for the first auction) and the dimension side (auction) broadcasts
+    assert armed[0].hot_keys == (0,)
+    assert armed[0].hot_rep_side == 1
+    assert job.rebalances >= 1           # the policy switch happened
+    assert len(r1) > 0
+    assert r1 == r8_off == r8_on         # bit-identical, order included
+
+
+# ---------------------------------------------------------------------------
+# q5: every defense at once on the hardest fused shape
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+@pytest.mark.skew
+def test_q5_all_defenses_bit_identity(monkeypatch):
+    _arm(monkeypatch, pre="0", hot="0", reb="0")
+    r1, _, _ = _run(Q5_MV, "q5", 1)
+    _arm(monkeypatch)
+    r8, job, _ = _run(Q5_MV, "q5", 8)
+    from risingwave_tpu.device.fused import AggNode
+    assert any(getattr(nd, "combined", False) for nd in job.program.nodes
+               if isinstance(nd, AggNode))
+    assert r1 == r8
+
+
+# ---------------------------------------------------------------------------
+# satellite: risectl skew (offline, dead data dir)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+@pytest.mark.skew
+def test_ctl_skew_offline(monkeypatch, tmp_path, capsys):
+    from risingwave_tpu import ctl
+    _arm(monkeypatch, hot="0")
+    d = str(tmp_path / "d")
+    _run(Q1_MV, "q1a", 8, data_dir=d)
+    # the database object is GONE — the dir is dead, the snapshot stays
+    rc = ctl.main(["skew", "--data-dir", d])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "job q1a" in out and "skew_ratio" in out
+    assert "occ" in out
+    rc = ctl.main(["skew", "q1a", "--data-dir", d, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert "q1a" in doc
+    assert any(r[2] == "vnode_occ" for r in doc["q1a"]["rows"])
+    assert ctl.main(["skew", "nosuch", "--data-dir", d]) == 1
+    assert ctl.main(["skew", "--data-dir", str(tmp_path)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: device-side gather for sharded MV SELECT pulls
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.mesh
+@pytest.mark.skew
+def test_sharded_pull_gather_matches_host_merge(monkeypatch):
+    _arm(monkeypatch, hot="0", reb="0")
+    # zipf:1.3 keeps hundreds of distinct groups live, so the stale-
+    # bound fallback below is genuinely exercised (total > 256)
+    rows, job, db = _run(Q1_MV, "q1a", 8, kd="zipf:1.3", keep=True)
+    # the in-program gather path served the SELECT; force the host-merge
+    # fallback (no live bound) and compare bit-for-bit
+    from risingwave_tpu.device.shard_exec import merge_keyed_pull
+    st = job.states[job.pull.node_idx]
+    dts = [c.acc_dtype for c in job.pull.agg.spec.calls]
+    k_host, c_host, u_host = merge_keyed_pull(st, job.program.mesh, dts)
+    need = job._pull_need()
+    assert need > 0
+    k_dev, c_dev, u_dev = merge_keyed_pull(st, job.program.mesh, dts,
+                                           live_bound=need * 8)
+    assert np.array_equal(k_host, k_dev)
+    for a, b in zip(c_host, c_dev):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(u_host, u_dev):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    # a stale (too-small) bound falls back to the host merge — same rows
+    k_fb, _, _ = merge_keyed_pull(st, job.program.mesh, dts, live_bound=1)
+    assert np.array_equal(k_fb, k_host)
