@@ -821,6 +821,107 @@ def stage_chaos_mttr(n_events):
     return {"chaos_mttr": out}
 
 
+def stage_overload(n_rows):
+    """Workload: overload survival (ISSUE 14) — the same bounded datagen
+    MV + file sink at 1x/2x/10x offered load (rows per poll scaled).
+    Records freshness p50/p99 + eps + admission lag + shed counts per
+    arm. The 10x arm additionally stalls the sink for a deterministic
+    window (`overload.slow_sink`, RW_LOAD_SHED on) so the record shows
+    the full ladder: escalation transitions, audited sheds, and the
+    recovery back to `normal` once the stall clears."""
+    from risingwave_tpu.config import ROBUSTNESS
+    from risingwave_tpu.utils import failpoint as fp
+    from risingwave_tpu.utils.overload import PRESSURE
+    saved = {k: getattr(ROBUSTNESS, k)
+             for k in ("overload_hold_s", "overload_window_s",
+                       "load_shed")}
+    ROBUSTNESS.overload_hold_s = 0.05
+    ROBUSTNESS.overload_window_s = 2.0
+    out = {}
+    try:
+        _overload_arms(n_rows, out)
+    finally:
+        fp.reset()
+        PRESSURE.reset()
+        for k, v in saved.items():
+            setattr(ROBUSTNESS, k, v)
+    out["note"] = ("offered load scaled by rows.per.poll; 10x arm runs "
+                   "with RW_LOAD_SHED=true + a deterministic "
+                   "overload.slow_sink stall window — shed_rows are "
+                   "audited in rw_shed_log (accounted = MV rows + shed "
+                   "rows cover every offered row); freshness blocks = "
+                   "rw_mv_freshness p50/p99 per arm (the eps-vs-"
+                   "freshness trade the cadence stretch makes)")
+    return {"overload": out}
+
+
+def _overload_arms(n_rows, out):
+    import tempfile
+    import time as _t
+    from risingwave_tpu.config import ROBUSTNESS
+    from risingwave_tpu.sql import Database
+    from risingwave_tpu.utils import failpoint as fp
+    from risingwave_tpu.utils.overload import PRESSURE
+    for mult in (1, 2, 10):
+        stress = mult == 10
+        ROBUSTNESS.load_shed = stress
+        PRESSURE.reset()
+        fp.reset()
+        db = Database()
+        db.run("CREATE SOURCE s (v BIGINT) WITH (connector='datagen',"
+               f" rows.per.poll='{64 * mult}',"
+               f" datagen.max.rows='{n_rows}')")
+        db.run("CREATE MATERIALIZED VIEW mo AS SELECT count(*) AS n,"
+               " sum(v) AS sv FROM s")
+        sink_path = os.path.join(tempfile.mkdtemp(prefix="rw_ovl_"),
+                                 "out.jsonl")
+        db.run(f"CREATE SINK so FROM mo WITH (connector='fs',"
+               f" fs.path='{sink_path}', format='jsonl')")
+        if stress:
+            # stall the first ~30 delivery attempts: the ladder must
+            # escalate under the stall and recover after it clears
+            fp.arm("overload.slow_sink", 1.0, 0, 30)
+        worst = 0
+        t0 = _t.perf_counter()
+        done = 0
+        for tick in range(4000):
+            db.tick()
+            for c in db._overload.controllers.values():
+                worst = max(worst, c.rung)
+            if tick % 16 == 15:
+                rows = db.query("SELECT n FROM mo")
+                done = int(rows[0][0] or 0) if rows else 0
+                bucket = db._overload.buckets["s"]
+                if done + bucket.shed_rows >= n_rows and all(
+                        c.rung == 0
+                        for c in db._overload.controllers.values()):
+                    break
+        dt = max(1e-9, _t.perf_counter() - t0)
+        bucket = db._overload.buckets["s"]
+        shed_entries = db._shed_log.entries()
+        transitions = sum(len(c.transitions)
+                          for c in db._overload.controllers.values())
+        fp.reset()
+        out[f"x{mult}"] = {
+            "offered_rows": n_rows,
+            "rows_per_poll": 64 * mult,
+            "admitted_rows": bucket.admitted_rows,
+            "deferred_polls": bucket.deferred,
+            "lag_polls": bucket.lag,
+            "shed_rows": bucket.shed_rows,
+            "shed_windows": len(shed_entries),
+            "eps": round(done / dt),
+            "wall_s": round(dt, 2),
+            "ladder_transitions": transitions,
+            "worst_state": ["normal", "throttled", "degraded",
+                            "shedding"][worst],
+            "recovered_to_normal": all(
+                c.rung == 0 for c in db._overload.controllers.values()),
+            "freshness": db._freshness.summary(),
+            "accounted": done + bucket.shed_rows == n_rows,
+        }
+
+
 # ---------------------------------------------------------------------------
 # the un-killable harness
 # ---------------------------------------------------------------------------
@@ -836,6 +937,7 @@ _STAGES = {
     "skew_q4": stage_skew_q4,
     "skew_qx": stage_skew_qx,
     "chaos_mttr": stage_chaos_mttr,
+    "overload": stage_overload,
 }
 
 
@@ -982,7 +1084,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r13.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r14.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -1008,6 +1110,7 @@ def main():
         h.run_stage("shards_qx", (65_536,), 90)
         h.run_stage("skew_q4", (131_072,), 120)
         h.run_stage("chaos_mttr", (262_144,), 90)
+        h.run_stage("overload", (50_000,), 60)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
         # compile of a fused epoch program set is ~200-400s on the remote-
@@ -1054,6 +1157,9 @@ def main():
         # recovery MTTR under chaos (fault-tolerance v3): worker SIGKILL
         # respawn + fused device-fault in-place recovery, both timed
         h.run_stage("chaos_mttr", (Q4_SQL_EVENTS[0] // 4,), 300)
+        # overload survival sweep (ISSUE 14): freshness p50/p99 + eps +
+        # shed counts at 1x/2x/10x offered load, ladder + audit asserted
+        h.run_stage("overload", (500_000,), 240)
     h.emit()
 
 

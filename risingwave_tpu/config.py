@@ -191,6 +191,50 @@ class RobustnessConfig:
     # <= 0 disables reaping (observe-only, the pre-supervision-v2
     # behavior).
     wedge_kill_factor: float = 3.0
+    # ---- overload control plane (credit flow + degradation ladder) ----
+    # initial credit (in chunks) a receiver grants each exchange stream,
+    # and the unit the producer-side queue bound derives from (queue
+    # capacity = 4x credits). Lower = tighter memory bound + earlier
+    # backpressure; higher = more in-flight pipelining.
+    exchange_credits: int = 256
+    # master gate for the graceful-degradation ladder
+    # (normal -> throttled -> degraded -> shedding). Off: the ladder
+    # observes (pressure gauge, rw_overload stays 'normal') but never
+    # throttles, stretches, or sheds.
+    overload_ladder: bool = True
+    # sliding window the credit-stall fraction is computed over
+    overload_window_s: float = 5.0
+    # pressure thresholds with a dead band between them (hysteresis):
+    # >= high sustained for hold_s escalates one rung; <= low sustained
+    # for hold_s recovers one rung; in between nothing moves.
+    overload_high: float = 0.5
+    overload_low: float = 0.1
+    overload_hold_s: float = 2.0
+    # epoch-cadence stretch factor on the degraded/shedding rungs: fused
+    # jobs dispatch this many epochs per barrier (same AOT executables —
+    # zero fresh compiles), host sources allow this many times the
+    # per-epoch chunk bound — bigger batches, fewer barrier overheads,
+    # freshness p99 traded against eps (rw_mv_freshness measures it).
+    overload_stretch: int = 4
+    # the ladder's top rung: shed oldest unadmitted source windows into
+    # the durable audited rw_shed_log table. DEFAULT OFF — with shedding
+    # off the ladder caps at 'degraded' and results stay bit-identical
+    # (throttling and stretch only re-time work, never change it).
+    load_shed: bool = False
+    # front-door SELECT admission: pgwire statements past this many
+    # in-flight SELECTs get a clean SQLSTATE 53000 rejection instead of
+    # queueing unboundedly on the coordinator lock. <= 0 disables the
+    # gate (the repo's knob-off convention).
+    select_concurrency: int = 64
+    # sink spool bound (rows buffered in one checkpoint window) past
+    # which the sink reports pressure to the ladder; a stalled external
+    # sink parks its backlog in the DURABLE sink log (disk), never RSS.
+    sink_spool_rows: int = 65536
+    # coordinator-side fused epoch event log byte cap: entries past it
+    # spill beside epoch_profile.jsonl and reload transparently on
+    # in-place recovery — a degraded-mode (stretched-cadence) job must
+    # not trade queue growth for event-log growth.
+    fused_epoch_log_bytes: int = 1 << 20
     # supervised stateful respawn refresh mode: True (default) seeds the
     # respawned worker with state as of its last DELIVERED epoch
     # (un-applying the retained crash-window input), replays the window,

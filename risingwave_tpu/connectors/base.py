@@ -186,6 +186,12 @@ class SplitSourceReader(SourceReader):
         self.reader = reader
         self.parser = parser
         self.records_per_poll = records_per_poll
+        # admission batch throttle in (0, 1]: the overload control plane
+        # (ops/source._poll_gated) shrinks the per-poll batch together
+        # with the poll cadence when downstream credit starves — the
+        # unread records stay in the split at their offset, which IS the
+        # backpressure reaching the connector
+        self.throttle = 1.0
         self.offsets: Dict[str, Any] = {}
         self._rr: int = 0   # round-robin cursor over the live split list
         # wall of the last successful poll — the source->MV freshness
@@ -199,10 +205,12 @@ class SplitSourceReader(SourceReader):
         if not splits:
             return None
         # round-robin: give every split a chance before returning None
+        budget = max(1, int(self.records_per_poll
+                            * min(1.0, max(0.0, self.throttle))))
         for probe in range(len(splits)):
             s = splits[(self._rr + probe) % len(splits)]
             records, nxt = self.reader.read(
-                s, self.offsets.get(s.split_id), self.records_per_poll)
+                s, self.offsets.get(s.split_id), budget)
             if records:
                 read_ts = time.time()
                 self._rr = (self._rr + probe + 1) % len(splits)

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, Iterator, List, Optional, Tuple
 
 from ..core.chunk import StreamChunk
@@ -36,8 +37,16 @@ from ..core.schema import Schema
 from ..ops.executor import Executor
 from ..ops.message import Barrier, Message
 from ..state.state_table import StateTable
+from ..utils.failpoint import declare, failpoint
 
 _FORMATS = ("jsonl", "json", "ndjson", "csv")
+
+declare("overload.slow_sink",
+        "stalled-external-sink chaos: while armed, sink delivery is "
+        "deferred (the external system is 'unavailable') — the backlog "
+        "parks in the DURABLE sink log, the sink reports `stalled` in "
+        "liveness, and the overload ladder sees full sink pressure; "
+        "disarming delivers the backlog at the next checkpoint")
 
 
 def _json_default(v):
@@ -185,6 +194,15 @@ class SinkExecutor(Executor):
         self.sink = sink
         self.log_table = log_table
         self._pending: List[Tuple[int, Tuple]] = []
+        # slow-sink isolation (overload control plane): `stalled` flips
+        # while external delivery is deferred (overload.slow_sink chaos,
+        # or a real delivery failure) — surfaced in rw_worker_liveness
+        # and read by the overload manager as full sink pressure. The
+        # backlog parks in the DURABLE sink log (disk), never RSS; the
+        # in-memory window spool is bounded by `pending_rows()` feeding
+        # the ladder, which throttles the sources upstream.
+        self.stalled = False
+        self.last_delivery_ts = time.time()
         self._dtypes = [f.dtype for f in input.schema.fields]
         self.pk_indices = list(pk_indices) if pk_indices else None
         self._mirror: dict = {}
@@ -253,6 +271,32 @@ class SinkExecutor(Executor):
         self._mirror_dirty.clear()
         self.mirror_table.commit(epoch)
 
+    def pending_rows(self) -> int:
+        """Rows spooled in the current checkpoint window (the in-memory
+        spool the overload manager bounds against RW_SINK_SPOOL_ROWS)."""
+        return len(self._pending)
+
+    def _mark_stalled(self) -> None:
+        if not self.stalled:
+            from ..utils.metrics import REGISTRY
+            REGISTRY.counter(
+                "sink_stalls_total",
+                "times a sink's external delivery stalled",
+                labels=("sink",)).labels(self.name).inc()
+        self.stalled = True
+
+    def _stall(self) -> bool:
+        """True while the external system is 'unavailable' (armed
+        overload.slow_sink). Delivery defers — the durable log keeps the
+        backlog on disk — and the stalled flag feeds liveness plus the
+        overload ladder. A REAL delivery failure (OSError out of the
+        external append/rename) takes the same path via the callers'
+        except clauses."""
+        if failpoint("overload.slow_sink"):
+            self._mark_stalled()
+            return True
+        return False
+
     def deliver_durable(self) -> None:
         """Ship every log epoch that the store has made durable. Called by
         the barrier loop right after `store.commit_epoch` (the
@@ -260,6 +304,8 @@ class SinkExecutor(Executor):
         next checkpoint barrier (covers recovery)."""
         if self.log_table is None:
             return
+        if self._stall():
+            return                       # backlog stays in the durable log
         durable = getattr(self.log_table.store, "committed_epoch", 0)
         by_epoch: dict = {}
         for row in list(self.log_table.iter_all()):
@@ -274,9 +320,19 @@ class SinkExecutor(Executor):
             if epoch > self.sink.committed_epoch:
                 pairs = [(sign, decode_row(payload, self._dtypes))
                          for _, sign, payload, _ in entries]
-                self.sink.deliver(epoch, pairs)
+                try:
+                    self.sink.deliver(epoch, pairs)
+                except OSError:
+                    # real external failure (disk full, unmounted path):
+                    # isolate like the chaos stall — backlog stays in
+                    # the durable log, retried next checkpoint — instead
+                    # of crashing the coordinator tick
+                    self._mark_stalled()
+                    return
             for _, _, _, row in entries:   # delivered or already manifested
                 self.log_table.delete(row)
+        self.stalled = False
+        self.last_delivery_ts = time.time()
 
     def execute(self) -> Iterator[Message]:
         for msg in self.input.execute():
@@ -291,9 +347,19 @@ class SinkExecutor(Executor):
             elif isinstance(msg, Barrier) and msg.is_checkpoint:
                 epoch = msg.epoch.curr
                 if self.log_table is None:
-                    # non-durable runtime: deliver directly (tests/ephemeral)
-                    self.sink.deliver(epoch, self._pending)
-                    self._pending.clear()
+                    # non-durable runtime: deliver directly (tests/
+                    # ephemeral); a stalled external defers delivery and
+                    # the window accumulates in the bounded spool (the
+                    # ladder throttles the sources against it)
+                    if not self._stall():
+                        try:
+                            self.sink.deliver(epoch, self._pending)
+                        except OSError:
+                            self._mark_stalled()
+                        else:
+                            self._pending.clear()
+                            self.stalled = False
+                            self.last_delivery_ts = time.time()
                 else:
                     self.deliver_durable()
                     if epoch > self.sink.committed_epoch:

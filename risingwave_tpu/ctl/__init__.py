@@ -307,6 +307,7 @@ def cmd_failpoints(args) -> int:
     RW_FAILPOINTS environment variable (spawned workers inherit it)."""
     from ..utils import failpoint as fp
     # imported for their declare() side effects
+    import risingwave_tpu.connectors.sink  # noqa: F401
     import risingwave_tpu.runtime.exchange_net  # noqa: F401
     import risingwave_tpu.runtime.remote_fragments  # noqa: F401
     import risingwave_tpu.runtime.worker  # noqa: F401

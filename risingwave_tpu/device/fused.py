@@ -55,6 +55,86 @@ declare("fused.checkpoint_commit",
         "fail a fused job-state checkpoint commit")
 
 
+EPOCH_LOG_SPILL = "epoch_log_spill.jsonl"
+
+
+class _EpochLog:
+    """Bounded coordinator-side epoch event log — the retained crash
+    window an in-place recovery re-dispatches. Entries are tiny
+    ((event_lo, events) pairs), but a degraded-mode job under stretched
+    cadence with a long checkpoint window must not trade queue growth
+    for event-log growth: past `RW_FUSED_EPOCH_LOG_BYTES` the oldest
+    half spills to a jsonl file beside epoch_profile.jsonl and reloads
+    transparently when `entries()` (recovery) asks for the full window.
+    `clear()` (the checkpoint trim) drops both tiers. Without a data
+    directory there is nowhere durable to spill, so the log stays
+    in-memory (the pre-bound behavior)."""
+
+    ENTRY_BYTES = 16               # accounting unit per (lo, events) pair
+
+    def __init__(self, cap_bytes: int, dir_of):
+        self.cap_entries = max(8, int(cap_bytes) // self.ENTRY_BYTES)
+        self._dir_of = dir_of      # () -> Optional[data_dir]; late-bound
+        self._mem: List[Tuple[int, int]] = []
+        self.spilled = 0           # entries currently in the spill file
+        self.spill_total = 0       # lifetime spilled entries
+
+    def _spill_path(self) -> Optional[str]:
+        import os
+        d = self._dir_of()
+        return os.path.join(d, EPOCH_LOG_SPILL) if d else None
+
+    def append(self, lo: int, events: int) -> None:
+        self._mem.append((int(lo), int(events)))
+        if len(self._mem) <= self.cap_entries:
+            return
+        path = self._spill_path()
+        if path is None:
+            return                 # no data dir: in-memory fallback
+        import json
+        cut = len(self._mem) // 2
+        # first spill of a window truncates: a stale file from a crashed
+        # predecessor must never splice into this window
+        with open(path, "w" if self.spilled == 0 else "a") as f:
+            for pair in self._mem[:cut]:
+                f.write(json.dumps(pair) + "\n")
+        self.spilled += cut
+        self.spill_total += cut
+        del self._mem[:cut]
+
+    def entries(self) -> List[Tuple[int, int]]:
+        """The full retained window, oldest first (spill tier, then
+        memory) — what `_recover_in_place` replays."""
+        import json
+        import os
+        out: List[Tuple[int, int]] = []
+        if self.spilled:
+            path = self._spill_path()
+            if path and os.path.exists(path):
+                with open(path) as f:
+                    for ln in f:
+                        ln = ln.strip()
+                        if ln:
+                            lo, ev = json.loads(ln)
+                            out.append((int(lo), int(ev)))
+        out.extend(self._mem)
+        return out
+
+    def clear(self) -> None:
+        import os
+        self._mem.clear()
+        path = self._spill_path()
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self.spilled = 0
+
+    def __len__(self) -> int:
+        return self.spilled + len(self._mem)
+
+
 def _is_device_fault(e: BaseException) -> bool:
     """Failures the in-place recovery path may absorb: injected fused.*
     failpoints and the runtime errors jax surfaces on a genuine
@@ -1714,8 +1794,20 @@ class FusedJob:
         # per epoch dispatched since the last checkpoint — the retained
         # crash window an IN-PLACE recovery re-dispatches (sources are
         # deterministic, so the log of ranges IS the log of events).
-        # Trimmed at every checkpoint commit.
-        self._epoch_log: List[Tuple[int, int]] = []
+        # Trimmed at every checkpoint commit; BOUNDED — entries past
+        # RW_FUSED_EPOCH_LOG_BYTES spill beside epoch_profile.jsonl and
+        # reload transparently on recovery (stretched cadence must not
+        # trade queue growth for event-log growth).
+        from ..config import ROBUSTNESS as _rob
+        self._epoch_log = _EpochLog(_rob.fused_epoch_log_bytes,
+                                    lambda: self.data_dir)
+        # overload ladder (utils/overload): epochs dispatched per
+        # barrier. >1 on the degraded/shedding rungs — same AOT-cached
+        # executable every dispatch, so a cadence-stretch transition is
+        # zero-fresh-compile by construction; results stay bit-identical
+        # (the MV is a function of the event counter, not of where the
+        # barrier boundaries fell).
+        self.cadence_stretch = 1
         # in-place recoveries from device-path failures (no DDL replay);
         # attempts reset on a successful checkpoint
         self.recoveries = 0
@@ -1788,8 +1880,16 @@ class FusedJob:
         # post-drain checkpoint still lands in the phase totals)
         prof = self.profiler if self.profiler.enabled \
             and not self.drained else None
+        # overload cadence stretch: dispatch `stretch` epochs under this
+        # one barrier (bigger batch per barrier overhead; freshness p99
+        # traded against eps, measured by rw_mv_freshness)
+        stretch = max(1, int(self.cadence_stretch))
+        e = self.program.epoch_events
+        planned = stretch * e
+        if self.max_events is not None:
+            planned = min(planned, max(0, self.max_events - self.counter))
         if prof is not None:
-            prof.begin_epoch(self.counter, self.program.epoch_events)
+            prof.begin_epoch(self.counter, planned or e)
         # fault-tolerance v3: a device-path failure anywhere in the
         # barrier's work (dispatch, sync, growth replay, commit — real
         # exception or armed fused.* failpoint) recovers IN PLACE and the
@@ -1798,18 +1898,24 @@ class FusedJob:
         # checkpoint sync) must not dispatch the epoch twice — recovery
         # already re-dispatched it from the epoch event log.
         dispatched = False
+        todo = stretch
         while True:
             try:
                 if not self.drained and not dispatched:
-                    self._dispatch_epoch(prof)
+                    # `todo` survives a mid-stretch device fault: the
+                    # recovery replays what WAS logged, the retry then
+                    # dispatches only the epochs still owed this barrier
+                    while todo > 0 and not self.drained:
+                        self._dispatch_epoch(prof)
+                        todo -= 1
                     dispatched = True
                 if barrier.is_checkpoint:
                     self._checkpoint(barrier.epoch.curr)
                 break
-            except Exception as e:
-                if not _is_device_fault(e):
+            except Exception as err:
+                if not _is_device_fault(err):
                     raise
-                self._recover_in_place(e)
+                self._recover_in_place(err)
         if prof is not None:
             prof.end_epoch()
         if self.profiler.enabled and barrier.is_checkpoint:
@@ -1847,7 +1953,7 @@ class FusedJob:
             if ex > 0.0:
                 prof.phase("exchange", ex)
             prof.phase("dispatch", dt - ex)
-        self._epoch_log.append((self.counter, self.program.epoch_events))
+        self._epoch_log.append(self.counter, self.program.epoch_events)
         self.counter += self.program.epoch_events
 
     def _recover_in_place(self, err: BaseException) -> None:
@@ -1870,7 +1976,9 @@ class FusedJob:
             raise err
         t_rec = _time.perf_counter()
         target = self.committed
-        window = list(self._epoch_log)
+        # the full retained window — spilled prefix reloaded from disk
+        # plus the in-memory tail (the epoch-log byte bound's contract)
+        window = self._epoch_log.entries()
         # the log must be contiguous from the committed counter — a torn
         # log cannot be replayed exactly, so escalate instead of guessing
         expect = target
@@ -2183,8 +2291,21 @@ class FusedJob:
 
     def mv_rows_now(self) -> List[Tuple]:
         """Query serving: sync and pull the CURRENT MV rows (full schema,
-        hidden stream-key columns included)."""
-        self.sync()
+        hidden stream-key columns included). A device fault during the
+        SELECT's sync routes through the same `_is_device_fault` ->
+        `_recover_in_place` path as the barrier loop and the query
+        retries — a transient device fault must not surface an
+        XlaRuntimeError to pgwire (the PR 12 SELECT-path residual)."""
+        while True:
+            try:
+                self.sync()
+                break
+            except Exception as e:
+                if not _is_device_fault(e):
+                    raise
+                # bounded by RW_FUSED_RECOVERY_ATTEMPTS: past the bound
+                # _recover_in_place re-raises and the error surfaces
+                self._recover_in_place(e)
         return self._pull_rows()
 
     def _persist_mv(self, epoch: int) -> None:
@@ -2209,6 +2330,9 @@ class FusedJob:
         counter, presize every node from its persisted capacity high-water
         mark (the replay then performs ZERO growth replays), and
         regenerate state device-side (offset rewind)."""
+        # a fresh process must not splice a crashed predecessor's spilled
+        # epoch-log tail into its own window
+        self._epoch_log.clear()
         if self.job_state_table is None:
             return
         rows: Dict[int, int] = {}

@@ -81,10 +81,19 @@ class ThreadedChannel(Channel):
             return True
 
     def send(self, msg: Message) -> None:
+        import time
         with self.cv:
+            t0 = None
             while isinstance(msg, StreamChunk) \
                     and self._data_len() >= self.capacity and not self.closed:
+                if t0 is None:
+                    t0 = time.monotonic()
                 self.cv.wait(1.0)
+            if t0 is not None:
+                # a result drain stalled on a full merge channel — the
+                # coordinator is the slow party; feed the overload ladder
+                from ..utils.overload import PRESSURE
+                PRESSURE.note("result_channel", time.monotonic() - t0)
             if self.closed and isinstance(msg, StreamChunk):
                 return               # consumer gone; chunks are droppable
             self.buf.append(msg)

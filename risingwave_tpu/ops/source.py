@@ -19,8 +19,14 @@ from ..core.epoch import EpochPair, now_epoch
 from ..core.schema import Schema
 from ..core import dtypes as T
 from ..state.state_table import StateTable
+from ..utils.failpoint import declare, failpoint
 from .executor import Executor
 from .message import Barrier, BarrierKind, Message, Mutation, MutationKind, Watermark
+
+declare("overload.burst",
+        "ingest-burst chaos: while armed, each source epoch admits 10x "
+        "the normal chunk budget — the deterministic offered-load spike "
+        "the overload ladder must absorb")
 
 
 class SourceReader:
@@ -135,6 +141,11 @@ class SourceExecutor(Executor):
         self._recovered = False
         # wall of the FIRST chunk of the current epoch (freshness stamp)
         self._first_chunk_ts: Optional[float] = None
+        # source admission control (utils/overload.AdmissionBucket, set
+        # by the Database for connector sources): a per-epoch token
+        # bucket whose rate follows the downstream overload ladder. None
+        # = ungated (DML tables, ad-hoc scans) — exactly the old path.
+        self.admission = None
 
     def _persist_splits(self, epoch: int) -> None:
         if self.split_state_table is None:
@@ -154,6 +165,33 @@ class SourceExecutor(Executor):
         if states:
             self.reader.seek(states)
 
+    def _poll_gated(self) -> Optional[StreamChunk]:
+        """Admission-gated reader poll. `defer` skips the poll entirely
+        — the unread data stays AT the connector (file offset, generator
+        cursor), which is backpressure propagated to the source itself.
+        `shed` (shedding rung + RW_LOAD_SHED only) polls the window and
+        drops it, recording the audited gap through the bucket's shed
+        sink (`rw_shed_log`)."""
+        adm = self.admission
+        if adm is None:
+            return self.reader.poll()
+        verdict = adm.admit()
+        if verdict == "defer":
+            return None
+        # batch throttle rides along with cadence throttle: readers that
+        # expose a `throttle` knob shrink their per-poll batch too
+        if hasattr(self.reader, "throttle"):
+            self.reader.throttle = adm.factor
+        chunk = self.reader.poll()
+        if chunk is None or chunk.cardinality == 0:
+            return chunk
+        if verdict == "shed":
+            adm.note_shed(self.injector.epoch.curr,
+                          int(chunk.cardinality))
+            return None
+        adm.note_admitted(int(chunk.cardinality))
+        return chunk
+
     def _stamp_ingest(self) -> None:
         """First chunk of the current epoch: remember when its data came
         off the connector (the reader's poll wall when it reports one,
@@ -172,11 +210,17 @@ class SourceExecutor(Executor):
         # cannot starve barriers; reference bounds this with channel capacity).
         max_chunks_before_barrier = 64
         drained = 0
+        burst = 1
         while True:
             if self.queue:
-                if (not paused and drained < max_chunks_before_barrier
+                # cadence stretch (degraded rung): bigger epochs amortize
+                # barrier overhead; burst chaos: 10x the offered budget
+                stretch = (self.admission.stretch
+                           if self.admission is not None else 1)
+                limit = max_chunks_before_barrier * max(1, stretch) * burst
+                if (not paused and drained < limit
                         and self.queue[0].kind != BarrierKind.INITIAL):
-                    chunk = self.reader.poll()
+                    chunk = self._poll_gated()
                     if chunk is not None and chunk.cardinality > 0:
                         drained += 1
                         self._stamp_ingest()
@@ -184,6 +228,14 @@ class SourceExecutor(Executor):
                         continue
                 drained = 0
                 b = self.queue.popleft()
+                burst = 10 if failpoint("overload.burst") else 1
+                # per-EPOCH admission refill at the sealing barrier: the
+                # budget is `capacity * factor` poll tokens, scaled by
+                # the same stretch/burst multipliers the drain limit
+                # uses (the overload manager re-rates `factor` per tick)
+                if self.admission is not None:
+                    self.admission.epoch_refill(
+                        max(1, self.admission.stretch) * burst)
                 if b.kind == BarrierKind.INITIAL:
                     self._recover_splits()
                 if b.is_checkpoint:
@@ -206,7 +258,7 @@ class SourceExecutor(Executor):
                 # no data while paused; force the runner to tick barriers
                 self.injector.inject()
                 continue
-            chunk = self.reader.poll()
+            chunk = self._poll_gated()
             if chunk is not None and chunk.cardinality > 0:
                 self._stamp_ingest()
                 yield chunk

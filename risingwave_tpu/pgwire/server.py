@@ -297,15 +297,28 @@ class _Conn:
         if not pairs:
             return False
         for stmt, text in pairs:
+            if isinstance(stmt, (A.Select, A.SetOp)):
+                # SELECT admission: past RW_SELECT_CONCURRENCY in-flight
+                # front-door SELECTs, enter() raises AdmissionRejected
+                # (SQLSTATE 53000) — a clean refusal instead of an
+                # unbounded queue on the coordinator lock wedging the
+                # epoch loop. Counted BEFORE the lock so queued waiters
+                # consume admission slots too.
+                gate = getattr(self.db, "select_gate", None)
+                held = gate.enter() if gate is not None else False
+                try:
+                    with self.lock:
+                        rows = self.db._run_batch_select(stmt)
+                        desc = getattr(self.db, "last_description", [])
+                finally:
+                    if held:
+                        gate.leave()
+                if not suppress_desc:
+                    self._row_description(desc)
+                self._data_rows(rows, [d.kind for _, d in desc])
+                self._send(b"C", f"SELECT {len(rows)}".encode() + b"\0")
+                continue
             with self.lock:
-                if isinstance(stmt, (A.Select, A.SetOp)):
-                    rows = self.db._run_batch_select(stmt)
-                    desc = getattr(self.db, "last_description", [])
-                    if not suppress_desc:
-                        self._row_description(desc)
-                    self._data_rows(rows, [d.kind for _, d in desc])
-                    self._send(b"C", f"SELECT {len(rows)}".encode() + b"\0")
-                    continue
                 result = self.db._execute(stmt)
                 if isinstance(stmt, (A.CreateTable,
                                      A.CreateMaterializedView,
@@ -485,16 +498,24 @@ class _Conn:
                 portal["done"] = True
                 return
             stmt = stmts[0]
-            with self.lock:
-                if isinstance(stmt, (A.Select, A.SetOp)):
-                    portal["rows"] = self.db._run_batch_select(stmt)
-                    portal["desc"] = getattr(self.db, "last_description",
-                                             [])
-                else:
+            if isinstance(stmt, (A.Select, A.SetOp)):
+                gate = getattr(self.db, "select_gate", None)
+                # SQLSTATE 53000 past the bound; False = gate disabled
+                held = gate.enter() if gate is not None else False
+                try:
+                    with self.lock:
+                        portal["rows"] = self.db._run_batch_select(stmt)
+                        portal["desc"] = getattr(self.db,
+                                                 "last_description", [])
+                finally:
+                    if held:
+                        gate.leave()
+            else:
+                with self.lock:
                     result = self.db._execute(stmt)
-                    self._send(b"C", self._tag(result, 0).encode() + b"\0")
-                    portal["done"] = True
-                    return
+                self._send(b"C", self._tag(result, 0).encode() + b"\0")
+                portal["done"] = True
+                return
         rows, pos = portal["rows"], portal["pos"]
         kinds = [d.kind for _, d in portal["desc"]]
         end = len(rows) if max_rows <= 0 else min(len(rows),
@@ -530,7 +551,10 @@ class _Conn:
                     if not self._run_one(sql):
                         self._send(b"I")                 # EmptyQueryResponse
                 except Exception as e:  # noqa: BLE001 — wire must stay up
-                    self._error(f"{type(e).__name__}: {e}")
+                    # exceptions that carry their SQLSTATE (e.g. the
+                    # SELECT admission gate's 53000) surface it verbatim
+                    self._error(f"{type(e).__name__}: {e}",
+                                getattr(e, "sqlstate", "XX000"))
                 self._ready()
             elif tag == b"P":                            # Parse
                 name, rest = body.split(b"\0", 1)
@@ -595,7 +619,8 @@ class _Conn:
                     else:
                         self._execute_portal(portal, max_rows)
                 except Exception as e:  # noqa: BLE001
-                    self._error(f"{type(e).__name__}: {e}")
+                    self._error(f"{type(e).__name__}: {e}",
+                                getattr(e, "sqlstate", "XX000"))
                     skip_until_sync = True
             elif tag == b"C":                            # Close
                 kind, name = body[:1], body[1:].split(b"\0", 1)[0]

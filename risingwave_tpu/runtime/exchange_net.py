@@ -54,8 +54,16 @@ from ..ops.executor import Executor
 from ..ops.message import (Barrier, BarrierKind, Message, Mutation,
                            MutationKind, Watermark)
 from ..utils.failpoint import declare, failpoint
+from ..utils.overload import PRESSURE
 
-DEFAULT_PERMITS = 256          # initial credit per connection (in chunks)
+# initial credit per connection (in chunks) — the compiled-in default;
+# RW_EXCHANGE_CREDITS (RobustnessConfig.exchange_credits) overrides it
+# per process at channel/stream creation time
+DEFAULT_PERMITS = 256
+
+
+def _credits() -> int:
+    return max(1, ROBUSTNESS.exchange_credits)
 
 declare("exchange.connect",
         "refuse one exchange connect attempt (retry/backoff seam)")
@@ -293,10 +301,10 @@ class NetChannel:
     the producer's pump instead of buffering the whole stream."""
 
     def __init__(self, dtypes: Sequence[DataType],
-                 capacity: int = 4 * DEFAULT_PERMITS,
+                 capacity: Optional[int] = None,
                  retain_epochs: bool = False):
         self.dtypes = list(dtypes)
-        self.capacity = capacity
+        self.capacity = capacity if capacity is not None else 4 * _credits()
         self.buf: Deque[Message] = deque()
         self.cv = threading.Condition()
         self.closed = False
@@ -351,9 +359,17 @@ class NetChannel:
             if self.aborted:
                 return                      # consumer gone: drop, don't block
             if isinstance(msg, StreamChunk):
+                t0 = None
                 while self._data_len() >= self.capacity \
                         and not (self.closed or self.aborted):
+                    if t0 is None:
+                        t0 = time.monotonic()
                     self.cv.wait()
+                if t0 is not None:
+                    # the producer stalled on a full exchange queue: the
+                    # credit-starvation evidence the overload ladder acts on
+                    PRESSURE.note("exchange_queue",
+                                  time.monotonic() - t0)
                 if self.aborted:
                     return
             self.buf.append(msg)
@@ -395,7 +411,7 @@ class ExchangeServer:
         self._accept_thread.start()
 
     def register(self, channel_id: int, dtypes: Sequence[DataType],
-                 capacity: int = 4 * DEFAULT_PERMITS,
+                 capacity: Optional[int] = None,
                  retain_epochs: bool = False) -> NetChannel:
         ch = NetChannel(dtypes, capacity, retain_epochs=retain_epochs)
         self.channels[channel_id] = ch
@@ -452,7 +468,7 @@ class ExchangeServer:
         self._writer(conn, ch)
 
     def _writer(self, conn: socket.socket, ch: NetChannel) -> None:
-        permits = [DEFAULT_PERMITS]
+        permits = [_credits()]
         pcv = threading.Condition()
 
         def permit_reader():
@@ -488,10 +504,16 @@ class ExchangeServer:
                 for msg in batch:
                     if isinstance(msg, StreamChunk):
                         # credit: block until the receiver granted room
+                        t0 = None
                         with pcv:
                             while permits[0] <= 0:
+                                if t0 is None:
+                                    t0 = time.monotonic()
                                 pcv.wait()
                             permits[0] -= 1
+                        if t0 is not None:
+                            PRESSURE.note("exchange_credit",
+                                          time.monotonic() - t0)
                         _send_frame(conn, b"K",
                                     encode_chunk_columnar(msg, ch.dtypes))
                         continue

@@ -876,6 +876,24 @@ class _RemoteSetBase:
             if ch.gen == gen:
                 ch.close()
 
+    # ---- overload evidence ----------------------------------------------
+    def queue_pressure(self) -> float:
+        """Worst fill ratio across this set's exchange queues — input
+        channels (a slow WORKER backs its dispatch queue up) and result
+        channels (a slow COORDINATOR backs the drains up). Lock-free
+        snapshot in [0, 1]; the overload manager folds it into the
+        per-tick pressure signal so queues approaching their bound
+        throttle the sources BEFORE the bound blocks the barrier loop."""
+        worst = 0.0
+        for side in self.in_channels:
+            for nc in side:
+                cap = getattr(nc, "capacity", 0) or 1
+                worst = max(worst, nc._data_len() / cap)
+        for ch in getattr(self, "channels", ()):
+            cap = getattr(ch, "capacity", 0) or 1
+            worst = max(worst, ch._data_len() / cap)
+        return min(1.0, worst)
+
     # ---- liveness -------------------------------------------------------
     def _backpressured(self, i: int) -> bool:
         """Worker i's result channel holds messages the coordinator has

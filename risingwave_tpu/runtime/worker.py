@@ -42,6 +42,11 @@ from .exchange_net import ExchangeServer, MetricsFrame, RemoteInput
 
 declare("worker.crash",
         "hard-kill the worker process mid-stream (os._exit per message)")
+declare("overload.slow_worker",
+        "slow-consumer chaos: the worker sleeps ~20ms per ingested "
+        "message, so its input exchange queue fills and credit "
+        "backpressure propagates to the coordinator (the deterministic "
+        "slow-worker overload seam)")
 declare("worker.poison_pill",
         "content-triggered hard kill: RW_POISON_PILL='<col>:<value>' "
         "kills the worker on any INPUT row whose column <col> stringifies"
@@ -63,6 +68,26 @@ def _poison_spec() -> Optional[tuple]:
 
 
 from ..ops.executor import Executor as _Executor
+
+
+class _SlowGate(_Executor):
+    """Input-side shim for the `overload.slow_worker` chaos seam: sleeps
+    ~20ms per INGESTED message, so the worker's input exchange queue
+    fills and credit backpressure propagates to the coordinator — the
+    deterministic slow-consumer scenario the overload ladder must
+    absorb. Wrapped only when the point is armed in this process, so
+    production ingestion pays nothing."""
+
+    def __init__(self, input):
+        super().__init__(input.schema, "SlowGate")
+        self.append_only = input.append_only
+        self.input = input
+
+    def execute(self):
+        for msg in self.input.execute():
+            if failpoint("overload.slow_worker"):
+                time.sleep(0.02)
+            yield msg
 
 
 class _PoisonGate(_Executor):
@@ -256,6 +281,11 @@ def main(argv: List[str]) -> int:
                                 _schema(plan["in_schema_r"]),
                                 append_only=plan.get("append_only_r",
                                                      False))
+    from ..utils.failpoint import armed as _armed_points
+    if any(p.name == "overload.slow_worker" for p in _armed_points()):
+        upstream = _SlowGate(upstream)
+        if upstream2 is not None:
+            upstream2 = _SlowGate(upstream2)
     pp = _poison_spec()
     if pp is not None:
         # deterministic poison-pill chaos: die on ingestion of the

@@ -55,6 +55,10 @@ DDL_LOG_PK = (0,)
 # in the directory (rows carry the job name) and readable standalone by
 # `risectl dlq` without a Database
 DLQ_TABLE_ID = 0x7EAD
+# durable shed-window audit log (overload control plane): same reserved-
+# id pattern as the dead-letter queue — one row per source window shed
+# under RW_LOAD_SHED, readable standalone (rw_shed_log)
+SHED_TABLE_ID = 0x5EED
 
 
 class _Backfill(Executor):
@@ -208,6 +212,18 @@ class Database:
         self._dlq = DeadLetterQueue(StateTable(
             self.store, DLQ_TABLE_ID, list(DeadLetterQueue.DTYPES),
             list(DeadLetterQueue.PK)))
+        # overload control plane (utils/overload.py): the per-job
+        # degradation ladder + per-source admission buckets close the
+        # loop from credit-starvation evidence to action once per tick;
+        # the shed log audits every window dropped under RW_LOAD_SHED;
+        # the select gate bounds concurrent pgwire SELECTs. Created
+        # BEFORE catalog recovery so replayed sources wire their buckets.
+        from ..utils.overload import OverloadManager, SelectGate, ShedLog
+        self._shed_log = ShedLog(StateTable(
+            self.store, SHED_TABLE_ID, list(ShedLog.DTYPES),
+            list(ShedLog.PK)))
+        self._overload = OverloadManager()
+        self.select_gate = SelectGate()
         self._replaying = False
         self._recover_catalog()
 
@@ -404,6 +420,14 @@ class Database:
                                        name=f"Source({stmt.name})",
                                        append_only=(connector != "dml"
                                                     or stmt.append_only))
+        if connector != "dml":
+            # source admission control: a per-epoch token bucket rated by
+            # the downstream overload ladder; sheds (RW_LOAD_SHED only)
+            # audit into the durable rw_shed_log. DML buffers stay
+            # ungated — their pushes are synchronous client calls.
+            bucket = self._overload.bucket(stmt.name)
+            bucket.shed_sink = self._shed_record
+            src.admission = bucket
         if not has_pk:
             src = RowIdGenExecutor(src, row_id_index=len(fields) - 1,
                                    shard=tid & 0x3FF)
@@ -997,6 +1021,7 @@ class Database:
             raise
         self._iters.pop(stmt.name, None)
         self._freshness.forget(stmt.name)
+        self._overload.forget(stmt.name)
         dropped_job = self._fused.pop(stmt.name, None)
         if dropped_job is not None:
             # remember where its capacities topped out, keyed by plan
@@ -1150,6 +1175,12 @@ class Database:
         from ..utils.metrics import REGISTRY
         t0 = _time.perf_counter()
         self._heartbeat_workers()
+        # overload control plane: fold this instant's credit-starvation
+        # evidence (stall fractions, queue depths, sink stalls) into the
+        # per-job degradation ladders and re-rate source admission —
+        # BEFORE the barrier goes out, so this tick's dispatch already
+        # runs under the decided state (cadence stretch, throttling)
+        self._overload.tick(self)
         b = self.injector.inject()
         span = self.tracer.inject(b.epoch.curr, b.kind.value)
         # fused device jobs first: their epoch dispatch is ASYNC (no device
@@ -1234,9 +1265,31 @@ class Database:
 
     def _worker_liveness_rows(self) -> List[Tuple]:
         """rw_worker_liveness rows: per-worker heartbeat age + state (ok /
-        wedged? / dead) from the metrics-plane heartbeat frames."""
-        return [row for name, r in self._remote_sets()
+        wedged? / dead) from the metrics-plane heartbeat frames, plus one
+        row per file sink (worker='sink') whose state flips to `stalled`
+        while external delivery is deferred — slow-sink isolation's
+        liveness surface."""
+        import os as _os
+        import time as _time
+        rows = [row for name, r in self._remote_sets()
                 for row in r.liveness_rows(name)]
+        now = _time.time()
+        for obj in self.catalog.objects.values():
+            rt = obj.runtime if isinstance(obj.runtime, dict) else None
+            se = rt.get("sink_exec") if rt else None
+            if se is not None:
+                rows.append((obj.name, "sink", _os.getpid(),
+                             se.sink.committed_epoch,
+                             now - se.last_delivery_ts,
+                             "stalled" if se.stalled else "ok"))
+        return rows
+
+    def _shed_record(self, source: str, epoch: int, rows: int) -> None:
+        """AdmissionBucket shed sink: audit one shed source window into
+        the durable rw_shed_log (committed at the current epoch, durable
+        at the next checkpoint — the rw_dead_letter pattern)."""
+        self._shed_log.record(source, epoch, rows, "admission",
+                              self.injector.epoch.curr)
 
     def _heartbeat_workers(self) -> None:
         """Proactive worker liveness sweep, once per barrier tick (the
@@ -1285,21 +1338,39 @@ class Database:
         count. Call between ticks; the next barrier states the rows
         downstream exactly once."""
         from ..core.encoding import decode_row
-        ents = self._dlq.entries(job=job, status="quarantined")
-        if ids is not None:
-            idset = {int(x) for x in ids}
-            ents = [e for e in ents if int(e[0]) in idset]
-        if not ents:
-            return 0
         rset = None
         for name, r in self._remote_sets():
             if name == job:
                 rset = r
                 break
         if rset is None:
+            # resolve the worker set BEFORE filtering entries: a requeue
+            # against a job that cannot consume one must fail with the
+            # reason, not report "requeued 0 rows"
+            obj = self.catalog.objects.get(job)
+            if obj is not None and isinstance(obj.runtime, dict) \
+                    and obj.runtime.get("fused_job") is not None:
+                raise ValueError(
+                    f"cannot requeue into {job!r}: it is a FUSED device "
+                    "job — its input regenerates deterministically on "
+                    "device and there is no remote worker set to consume "
+                    "a requeue. Quarantined rows of a fused job can only "
+                    "be listed or purged (`risectl dlq " + job +
+                    " --purge ...`); see README 'Dead-letter queue'.")
+            if obj is None:
+                raise ValueError(f"cannot requeue into {job!r}: no such "
+                                 "job in the catalog")
             raise ValueError(
-                f"no live remote worker set for job {job!r} "
-                "(fused/local jobs have no dead-letter consumers)")
+                f"cannot requeue into {job!r}: the job has no live "
+                "remote worker set (local placement). Only process-"
+                "placement jobs (SET streaming_placement TO process) "
+                "have dead-letter consumers.")
+        ents = self._dlq.entries(job=job, status="quarantined")
+        if ids is not None:
+            idset = {int(x) for x in ids}
+            ents = [e for e in ents if int(e[0]) in idset]
+        if not ents:
+            return 0
         n = 0
         by_side: Dict[int, List[Tuple[int, Tuple]]] = {}
         for e in ents:

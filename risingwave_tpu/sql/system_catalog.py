@@ -148,6 +148,37 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("row", T.VARCHAR), ("status", T.VARCHAR),
                   ("ts", T.FLOAT64)),
         lambda db: _dead_letter(db)),
+    # overload control plane (utils/overload.py): per job, the current
+    # degradation-ladder state (seq=0) plus the transition history
+    # (seq>0, newest last) — state walks normal -> throttled -> degraded
+    # -> shedding and back with hysteresis; `stretch` is the live epoch-
+    # cadence multiplier, `pressure` the [0,1] credit-starvation signal
+    # the transition acted on.
+    "rw_overload": (
+        Schema.of(("job", T.VARCHAR), ("seq", T.INT64),
+                  ("state", T.VARCHAR), ("prev_state", T.VARCHAR),
+                  ("pressure", T.FLOAT64), ("stretch", T.INT64),
+                  ("since_ts", T.FLOAT64), ("ts", T.FLOAT64)),
+        lambda db: db._overload.rows()),
+    # per-source admission control: token-bucket state + the offered/
+    # admitted/deferred poll counters whose difference is the source's
+    # admission lag (backpressure debt pushed back to the connector)
+    "rw_source_admission": (
+        Schema.of(("source", T.VARCHAR), ("state", T.VARCHAR),
+                  ("factor", T.FLOAT64), ("offered", T.INT64),
+                  ("admitted", T.INT64), ("deferred", T.INT64),
+                  ("shed_rows", T.INT64), ("lag", T.INT64)),
+        lambda db: db._overload.admission_rows()),
+    # durable shed audit (RW_LOAD_SHED only): one row per source window
+    # dropped by admission control on the shedding rung — the gap is a
+    # recorded decision, never a silent loss (the rw_dead_letter
+    # pattern, minus the payload: unadmitted data has no exact bytes to
+    # requeue)
+    "rw_shed_log": (
+        Schema.of(("id", T.INT64), ("source", T.VARCHAR),
+                  ("epoch", T.INT64), ("rows", T.INT64),
+                  ("reason", T.VARCHAR), ("ts", T.FLOAT64)),
+        lambda db: db._shed_log.entries()),
 }
 
 

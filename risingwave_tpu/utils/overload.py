@@ -1,0 +1,518 @@
+"""Overload control plane: pressure sensing, admission, degradation.
+
+The engine's answer to load it cannot absorb (ISSUE 14). Three layers,
+each feeding the next:
+
+* **Pressure sensing** (`PressureBoard` + the callers' depth probes) —
+  every data-movement seam that can stall under credit/capacity
+  exhaustion reports here: a NetChannel producer blocked on a full
+  exchange queue, an exchange writer blocked on receiver permits, a
+  result drain blocked on a full merge channel. The board turns those
+  stall seconds into a [0, 1] "fraction of recent wall spent starved"
+  signal; the overload manager folds in queue-depth ratios and sink
+  stall flags, which need no blocking to be visible.
+* **Graceful-degradation ladder** (`OverloadController`, one per
+  streaming job) — an explicit state machine
+  `normal -> throttled -> degraded -> shedding` that escalates only
+  under SUSTAINED pressure (`RW_OVERLOAD_HIGH` held for
+  `RW_OVERLOAD_HOLD_S`) and de-escalates with hysteresis
+  (`RW_OVERLOAD_LOW` held just as long, one rung at a time). The top
+  rung is gated twice: `RW_LOAD_SHED` (default OFF) caps the ladder at
+  `degraded`, where the engine only re-times work — bigger epochs
+  (cadence stretch), throttled sources — and never changes results.
+* **Source admission** (`AdmissionBucket`, one per connector source) —
+  a per-epoch token bucket: `capacity * factor` poll tokens per epoch,
+  where `factor` follows the worst downstream rung. Exhausted tokens
+  DEFER polls (data waits at the connector — backpressure propagated
+  all the way to the source) or, on the `shedding` rung only, SHED the
+  would-be window: poll it, drop it, and record the gap in the durable
+  audited `rw_shed_log` table (`ShedLog`, the `rw_dead_letter`
+  pattern). Offered/admitted/deferred/shed counters make the lag
+  (offered minus admitted) a first-class per-source surface
+  (`rw_source_admission`).
+
+`SelectGate` bounds concurrent pgwire SELECTs: past
+`RW_SELECT_CONCURRENCY` in-flight statements a new one gets a clean
+SQLSTATE 53000 (`AdmissionRejected`) instead of queueing on the
+coordinator lock and wedging the epoch loop.
+
+Everything here is knob-gated and inert by default: with no pressure
+the ladder sits at `normal`, buckets refill to their full per-epoch
+budget (exactly the pre-existing 64-chunks-per-epoch source bound), and
+results are bit-identical to a build without this module.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..config import ROBUSTNESS
+from ..core import dtypes as T
+
+# the ladder's rungs, in escalation order; indices are the `rung` values
+LADDER: Tuple[str, ...] = ("normal", "throttled", "degraded", "shedding")
+# fraction of the full per-epoch source admission budget per rung
+ADMIT_FACTOR: Tuple[float, ...] = (1.0, 0.5, 0.25, 0.25)
+# cadence stretch engages from this rung upward
+_STRETCH_RUNG = 2
+
+
+class AdmissionRejected(RuntimeError):
+    """A front-door statement refused for lack of capacity — pgwire maps
+    it to SQLSTATE 53000 (insufficient_resources)."""
+
+    sqlstate = "53000"
+
+
+# ---------------------------------------------------------------------------
+# pressure sensing
+# ---------------------------------------------------------------------------
+
+
+class PressureBoard:
+    """Process-global record of credit/capacity stalls. Producers that
+    BLOCKED waiting for downstream room call `note(kind, seconds)`;
+    `fraction(window_s)` answers "what share of the recent window did
+    this process spend starved for credit" in [0, 1] — the overload
+    ladder's primary input. Thread-safe; disarmed cost is zero (callers
+    only note when they actually waited)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=8192)   # (monotonic ts, seconds)
+
+    def note(self, kind: str, seconds: float) -> None:
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            self._events.append((time.monotonic(), seconds))
+        from .metrics import REGISTRY
+        REGISTRY.counter(
+            "credit_stall_seconds_total",
+            "wall seconds producers spent blocked on exchange credit or "
+            "queue capacity, by seam", labels=("kind",)
+        ).labels(kind).inc(seconds)
+
+    def fraction(self, window_s: float) -> float:
+        now = time.monotonic()
+        lo = now - max(1e-6, window_s)
+        with self._lock:
+            # prune far-stale entries so the deque never holds history
+            # older than a few windows
+            horizon = now - 8 * max(1e-6, window_s)
+            while self._events and self._events[0][0] < horizon:
+                self._events.popleft()
+            stalled = sum(s for ts, s in self._events if ts >= lo)
+        return min(1.0, stalled / max(1e-6, window_s))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# one board per process: worker processes keep their own (their stall
+# counters reach the coordinator via the metrics-plane M frames; the
+# coordinator's LADDER only acts on coordinator-side stalls plus the
+# queue depths it can read directly)
+PRESSURE = PressureBoard()
+
+
+# ---------------------------------------------------------------------------
+# graceful-degradation ladder
+# ---------------------------------------------------------------------------
+
+
+class OverloadController:
+    """Per-job overload state machine. `observe(pressure)` once per
+    barrier tick; escalation requires the pressure to HOLD above
+    `overload_high` for `overload_hold_s` (one rung per hold period),
+    de-escalation requires it to hold below `overload_low` just as long
+    (hysteresis — a flapping signal parks in the dead band and changes
+    nothing). The `shedding` rung exists only when `RW_LOAD_SHED=true`;
+    otherwise the ladder caps at `degraded`, whose actions (cadence
+    stretch, source throttling) re-time work without changing any
+    result."""
+
+    def __init__(self, job: str):
+        self.job = job
+        self.rung = 0
+        self.pressure = 0.0
+        self.since = time.time()
+        self._above: Optional[float] = None
+        self._below: Optional[float] = None
+        # transition ring: (seq, ts, prev_state, new_state, pressure)
+        self.transitions: deque = deque(maxlen=64)
+        self._seq = 0
+
+    @property
+    def state(self) -> str:
+        return LADDER[self.rung]
+
+    @property
+    def stretch(self) -> int:
+        if self.rung >= _STRETCH_RUNG:
+            return max(1, int(ROBUSTNESS.overload_stretch))
+        return 1
+
+    @property
+    def admit_factor(self) -> float:
+        return ADMIT_FACTOR[self.rung]
+
+    def observe(self, pressure: float, now: Optional[float] = None) -> str:
+        cfg = ROBUSTNESS
+        now = time.time() if now is None else now
+        self.pressure = pressure
+        if not cfg.overload_ladder:
+            if self.rung:
+                self._move(0, pressure, now)
+            return self.state
+        if pressure >= cfg.overload_high:
+            self._below = None
+            if self._above is None:
+                self._above = now
+            elif now - self._above >= cfg.overload_hold_s:
+                cap = len(LADDER) - 1 if cfg.load_shed else _STRETCH_RUNG
+                if self.rung < cap:
+                    self._move(self.rung + 1, pressure, now)
+                self._above = now      # next rung needs its own hold
+        elif pressure <= cfg.overload_low:
+            self._above = None
+            if self.rung > 0:
+                if self._below is None:
+                    self._below = now
+                elif now - self._below >= cfg.overload_hold_s:
+                    self._move(self.rung - 1, pressure, now)
+                    self._below = now
+            else:
+                self._below = None
+        else:
+            # dead band: neither escalate nor recover (the hysteresis gap)
+            self._above = self._below = None
+        return self.state
+
+    def force(self, state: str) -> None:
+        """Jump straight to `state` (tests/operators); same bookkeeping
+        as an observed transition."""
+        self._move(LADDER.index(state), self.pressure, time.time())
+
+    def _move(self, rung: int, pressure: float, now: float) -> None:
+        if rung == self.rung:
+            return
+        prev = self.state
+        self.rung = rung
+        self.since = now
+        self._seq += 1
+        self.transitions.append((self._seq, now, prev, self.state,
+                                 pressure))
+        from .metrics import REGISTRY
+        REGISTRY.counter(
+            "overload_transitions_total",
+            "graceful-degradation ladder transitions",
+            labels=("job", "state")).labels(self.job, self.state).inc()
+        REGISTRY.gauge(
+            "overload_state",
+            "current overload rung per job (0=normal..3=shedding)",
+            labels=("job",)).labels(self.job).set(rung)
+
+    def rows(self, now: float) -> List[Tuple]:
+        """rw_overload rows for this job: seq=0 is the CURRENT state,
+        higher seqs the transition history (newest last)."""
+        out = [(self.job, 0, self.state, "", self.pressure,
+                self.stretch, self.since, now)]
+        for seq, ts, prev, new, p in self.transitions:
+            out.append((self.job, seq, new, prev, p,
+                        0, ts, ts))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# source admission
+# ---------------------------------------------------------------------------
+
+
+class AdmissionBucket:
+    """Per-source token bucket, refilled per EPOCH by the source itself
+    (`epoch_refill` at every barrier pop) and re-rated per TICK by the
+    overload manager (`factor`/`state` follow the worst downstream
+    rung). `admit()` answers per poll attempt:
+
+    * ``admit`` — a token was available; poll normally.
+    * ``defer`` — budget exhausted: skip the poll. The data stays at
+      the connector (file offset, generator cursor) — that IS the
+      backpressure reaching the source; nothing buffers.
+    * ``shed``  — budget exhausted AND the job ladder is on the
+      `shedding` rung with `RW_LOAD_SHED=true`: the caller polls the
+      window and DROPS it, recording the gap through `shed_sink` into
+      the durable `rw_shed_log` (audited data loss, never silent).
+
+    The refill floor is one token per epoch, so a throttled source
+    always trickles — throttling delays work, it never deadlocks it."""
+
+    def __init__(self, name: str, capacity: int = 64):
+        self.name = name
+        self.capacity = max(1, capacity)
+        self.tokens = self.capacity
+        self.factor = 1.0
+        self.state = "normal"
+        self.stretch = 1
+        self.shed_enabled = False
+        # callback(source, epoch, rows) wired to the database's ShedLog
+        self.shed_sink: Optional[Callable[[str, int, int], None]] = None
+        self.offered = 0          # poll attempts while data was wanted
+        self.admitted = 0         # polls granted a token
+        self.admitted_rows = 0
+        self.deferred = 0         # polls pushed back to the connector
+        self.shed_rows = 0
+        self.shed_windows = 0
+
+    @property
+    def lag(self) -> int:
+        """Offered minus admitted — the source's admission debt."""
+        return self.offered - self.admitted
+
+    def epoch_refill(self, mult: int = 1) -> None:
+        """Refill for one epoch. `mult` carries the epoch-size
+        multipliers the source applies to its poll budget — cadence
+        stretch (degraded rung: bigger epochs at the throttled RATE,
+        fewer per-barrier overheads) and the `overload.burst` chaos
+        factor (the flood must actually enter for the ladder to have
+        something to defend against; the queue bounds still hard-cap
+        it)."""
+        self.tokens = max(1, int(self.capacity * self.factor
+                                 * max(1, mult)))
+
+    def admit(self) -> str:
+        self.offered += 1
+        if self.tokens > 0:
+            self.tokens -= 1
+            self.admitted += 1
+            return "admit"
+        if self.shed_enabled and self.state == "shedding":
+            return "shed"
+        self.deferred += 1
+        return "defer"
+
+    def note_admitted(self, rows: int) -> None:
+        self.admitted_rows += int(rows)
+
+    def note_shed(self, epoch: int, rows: int) -> None:
+        self.shed_rows += int(rows)
+        self.shed_windows += 1
+        from .metrics import REGISTRY
+        REGISTRY.counter(
+            "source_shed_rows_total",
+            "rows shed at the source under RW_LOAD_SHED (audited in "
+            "rw_shed_log)", labels=("source",)
+        ).labels(self.name).inc(int(rows))
+        if self.shed_sink is not None:
+            self.shed_sink(self.name, epoch, int(rows))
+
+    def row(self) -> Tuple:
+        """rw_source_admission row."""
+        return (self.name, self.state, self.factor, self.offered,
+                self.admitted, self.deferred, self.shed_rows, self.lag)
+
+
+# ---------------------------------------------------------------------------
+# durable shed audit log (the rw_dead_letter pattern)
+# ---------------------------------------------------------------------------
+
+
+class ShedLog:
+    """Durable audit trail of every shed source window — the rows behind
+    the `rw_shed_log` system table. One row per shed window:
+    (id, source, epoch, rows, reason, ts). Rides the normal state-store
+    commit protocol (durable at the next checkpoint, survives
+    restarts). Unlike the dead-letter queue it records the GAP, not the
+    payload: shed data was never admitted, so there is nothing exact to
+    requeue — the log is the audit that the gap was a decision, not a
+    bug."""
+
+    DTYPES = (T.INT64, T.VARCHAR, T.INT64, T.INT64, T.VARCHAR, T.FLOAT64)
+    PK = (0,)
+
+    def __init__(self, table):
+        self.table = table
+        self._next_id = 1 + max(
+            [int(r[0]) for r in table.iter_all()], default=-1)
+
+    def record(self, source: str, epoch: int, rows: int, reason: str,
+               commit_epoch: int) -> int:
+        rid = self._next_id
+        self.table.insert((rid, source, int(epoch), int(rows), reason,
+                           time.time()))
+        self._next_id += 1
+        self.table.commit(commit_epoch)
+        return rid
+
+    def entries(self, source: Optional[str] = None) -> List[Tuple]:
+        return sorted(tuple(r) for r in self.table.iter_all()
+                      if source is None or r[1] == source)
+
+
+# ---------------------------------------------------------------------------
+# SELECT admission (the pgwire front door)
+# ---------------------------------------------------------------------------
+
+
+class SelectGate:
+    """Concurrency bound on front-door SELECTs. `enter()` raises
+    `AdmissionRejected` (SQLSTATE 53000) when `RW_SELECT_CONCURRENCY`
+    statements are already in flight — a clean, immediate refusal
+    instead of an unbounded queue on the coordinator lock; it returns
+    True when the caller holds a slot (pair with `leave()`) and False
+    when the gate is disabled (`RW_SELECT_CONCURRENCY <= 0`, the repo's
+    knob-off convention). The embedding process's own `Database.query`
+    API is never gated (the operator's local tooling must always
+    work)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.active = 0
+        self.rejected = 0
+
+    def enter(self) -> bool:
+        limit = ROBUSTNESS.select_concurrency
+        if limit <= 0:
+            return False
+        with self._lock:
+            if self.active >= limit:
+                self.rejected += 1
+                from .metrics import REGISTRY
+                REGISTRY.counter(
+                    "select_admission_rejected_total",
+                    "front-door SELECTs refused at the concurrency "
+                    "bound (SQLSTATE 53000)").inc()
+                raise AdmissionRejected(
+                    f"too many concurrent SELECTs "
+                    f"(RW_SELECT_CONCURRENCY={limit}); retry when "
+                    "in-flight queries drain")
+            self.active += 1
+        return True
+
+    def leave(self) -> None:
+        with self._lock:
+            self.active = max(0, self.active - 1)
+
+
+# ---------------------------------------------------------------------------
+# the per-database overload manager (closed loop, runs on the tick)
+# ---------------------------------------------------------------------------
+
+
+class OverloadManager:
+    """Owns the ladder controllers and admission buckets of one
+    Database and closes the loop once per barrier tick:
+
+    1. read the pressure evidence — stall fraction from the
+       `PressureBoard`, exchange queue-depth ratios from every remote
+       worker set, sink stall flags and spool ratios;
+    2. feed the combined [0, 1] pressure to every job's ladder
+       controller (escalate / hold / recover with hysteresis);
+    3. act — fused jobs get their cadence stretch, source buckets get
+       their admission factor/state from the WORST downstream rung.
+
+    All reads are lock-free snapshots (depth gauges, flags); the tick
+    cost is a few dict walks."""
+
+    def __init__(self) -> None:
+        self.controllers: Dict[str, OverloadController] = {}
+        self.buckets: Dict[str, AdmissionBucket] = {}
+        self.last_pressure = 0.0
+
+    def controller(self, job: str) -> OverloadController:
+        c = self.controllers.get(job)
+        if c is None:
+            c = self.controllers[job] = OverloadController(job)
+        return c
+
+    def bucket(self, source: str, capacity: int = 64) -> AdmissionBucket:
+        b = self.buckets.get(source)
+        if b is None:
+            b = self.buckets[source] = AdmissionBucket(source, capacity)
+        return b
+
+    def forget(self, name: str) -> None:
+        self.controllers.pop(name, None)
+        self.buckets.pop(name, None)
+
+    # ---- evidence -------------------------------------------------------
+    def _sink_pressure(self, db) -> float:
+        worst = 0.0
+        for obj in db.catalog.objects.values():
+            rt = obj.runtime if isinstance(obj.runtime, dict) else None
+            se = rt.get("sink_exec") if rt else None
+            if se is None:
+                continue
+            if getattr(se, "stalled", False):
+                worst = 1.0
+            else:
+                worst = max(worst, min(1.0, se.pending_rows()
+                                       / max(1, ROBUSTNESS.sink_spool_rows)))
+        return worst
+
+    def _queue_pressure(self, db) -> float:
+        worst = 0.0
+        for _name, r in db._remote_sets():
+            qp = getattr(r, "queue_pressure", None)
+            if qp is not None:
+                worst = max(worst, qp())
+        return worst
+
+    def pressure_of(self, db) -> float:
+        base = PRESSURE.fraction(ROBUSTNESS.overload_window_s)
+        return max(base, self._sink_pressure(db), self._queue_pressure(db))
+
+    # ---- the closed loop ------------------------------------------------
+    def tick(self, db) -> None:
+        now = time.time()
+        p = self.pressure_of(db)
+        self.last_pressure = p
+        from .metrics import REGISTRY
+        REGISTRY.gauge("overload_pressure",
+                       "combined credit-starvation pressure in [0,1]"
+                       ).set(p)
+        # every live streaming job gets a ladder controller
+        jobs = set(db._fused)
+        for obj in db.catalog.objects.values():
+            rt = obj.runtime if isinstance(obj.runtime, dict) else None
+            if rt is None:
+                continue
+            if obj.kind in ("mv", "sink") and rt.get("fused_job") is None:
+                jobs.add(obj.name)
+        worst = 0
+        for j in sorted(jobs):
+            ctrl = self.controller(j)
+            ctrl.observe(p, now)
+            worst = max(worst, ctrl.rung)
+            job = db._fused.get(j)
+            if job is not None:
+                job.cadence_stretch = ctrl.stretch
+        for name in list(self.controllers):
+            if name not in jobs:
+                del self.controllers[name]
+        # sources follow the worst downstream rung: the bucket rate is
+        # re-set here, the tokens themselves refill per epoch at the
+        # source (so idle-loop extra barriers can't mint extra budget)
+        state = LADDER[worst]
+        factor = ADMIT_FACTOR[worst]
+        stretch = (max(1, int(ROBUSTNESS.overload_stretch))
+                   if worst >= _STRETCH_RUNG else 1)
+        for b in self.buckets.values():
+            b.factor = factor
+            b.state = state
+            b.stretch = stretch
+            b.shed_enabled = ROBUSTNESS.load_shed
+
+    # ---- surfaces -------------------------------------------------------
+    def rows(self) -> List[Tuple]:
+        now = time.time()
+        out: List[Tuple] = []
+        for _name, ctrl in sorted(self.controllers.items()):
+            out.extend(ctrl.rows(now))
+        return out
+
+    def admission_rows(self) -> List[Tuple]:
+        return [b.row() for _n, b in sorted(self.buckets.items())]
