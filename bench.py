@@ -821,6 +821,215 @@ def stage_chaos_mttr(n_events):
     return {"chaos_mttr": out}
 
 
+INGEST_CHUNK = 4096    # epoch = 262144 events: the staged pipeline needs
+                       # MANY windows per run for the double buffer to
+                       # have anything to hide (one giant window = one
+                       # synchronous stage, no overlap to measure)
+
+
+def _ingest_arm(n_events, shards, warm_pass):
+    """One host-ingest q4 arm: eps + freshness + the pack/h2d/dispatch
+    split that proves (or disproves) the double-buffer overlap."""
+    from risingwave_tpu.config import DeviceConfig
+    from risingwave_tpu.sql import Database
+
+    def one_pass():
+        db = Database(device=DeviceConfig(capacity=1 << 18,
+                                          host_ingest=True,
+                                          mesh_shards=shards,
+                                          mv_persist_every=MV_PERSIST_EVERY),
+                      checkpoint_frequency=CKPT_EVERY)
+        db.run(BID_SRC.format(n=n_events, c=INGEST_CHUNK))
+        db.run(Q4_MV)
+        dt = drive(db, n_events, chunk=INGEST_CHUNK)
+        return db, dt
+
+    if warm_pass:
+        one_pass()
+    db, dt = one_pass()
+    job = db._fused["q4"]
+    rows = db.query("SELECT * FROM q4")
+    st = job.ingest.stats()
+    ph = job.profiler.totals
+    disp = ph.get("dispatch", 0.0)
+    return {
+        "device_eps": round(n_events / dt),
+        "events": n_events,
+        "effective_shards": job.mesh_shards,
+        "groups": len(rows),
+        "ingest": {k: (round(v, 4) if isinstance(v, float) else v)
+                   for k, v in st.items()},
+        # the overlap evidence: total H2D wall over total dispatch wall
+        # (< 1.0 means the transfer hid under dispatch), plus the
+        # dispatch-thread residual phases (pack/h2d ~ 0 when the double
+        # buffer is warm)
+        "h2d_over_dispatch": round(st["h2d_s"] / disp, 4) if disp else None,
+        "prefetched_frac": round(
+            st["prefetched"] / max(1, st["windows"]), 3),
+        "phase_s": {k: round(v, 4) for k, v in ph.items()},
+        "freshness": _freshness_stats(db),
+    }, rows
+
+
+def _copy_firehose(n_rows, producers):
+    """COPY FROM STDIN firehose: `producers` concurrent pgwire
+    connections stream text COPY batches into one table with a counting
+    MV while the coordinator ticks — rows/s through the admission gate,
+    with rw_mv_freshness as the SLO check."""
+    import socket
+    import struct
+    import threading
+    import time as _t
+    from risingwave_tpu.pgwire import PgServer
+    from risingwave_tpu.sql import Database
+    db = Database()
+    db.run("CREATE TABLE fh (v BIGINT)")
+    db.run("CREATE MATERIALIZED VIEW fh_mv AS SELECT count(*) AS n,"
+           " sum(v) AS sv FROM fh")
+    srv = PgServer(db).start()
+    per = n_rows // producers
+    batch = 4096
+
+    def produce(k):
+        s = socket.create_connection((srv.host, srv.port), timeout=30)
+        buf = b""
+
+        def recv(n):
+            nonlocal buf
+            while len(buf) < n:
+                got = s.recv(65536)
+                if not got:
+                    raise ConnectionError
+                buf += got
+            out, buf2 = buf[:n], buf[n:]
+            buf = buf2
+            return out
+
+        def until(stop):
+            while True:
+                t = recv(1)
+                (ln,) = struct.unpack(">I", recv(4))
+                recv(ln - 4)
+                if t == stop:
+                    return
+
+        body = struct.pack(">I", 196608) + b"user\0bench\0\0"
+        s.sendall(struct.pack(">I", len(body) + 4) + body)
+        until(b"Z")
+
+        def send(tag, p=b""):
+            s.sendall(tag + struct.pack(">I", len(p) + 4) + p)
+
+        send(b"Q", b"COPY fh FROM STDIN\0")
+        t = recv(1)
+        (ln,) = struct.unpack(">I", recv(4))
+        recv(ln - 4)
+        assert t == b"G", t
+        lo = k * per
+        for off in range(0, per, batch):
+            n = min(batch, per - off)
+            data = b"".join(b"%d\n" % (lo + off + i) for i in range(n))
+            send(b"d", data)
+        send(b"c")
+        until(b"Z")
+        s.close()
+
+    threads = [threading.Thread(target=produce, args=(k,), daemon=True)
+               for k in range(producers)]
+    t0 = _t.perf_counter()
+    for t in threads:
+        t.start()
+    alive = True
+    while alive:
+        # the handler threads serialize on the server's session lock —
+        # the tick loop must too (Database has no internal lock; an
+        # unlocked tick would interleave barrier processing with
+        # copy_rows' bucket read-modify-write)
+        with srv.lock:
+            db.tick()
+        alive = any(t.is_alive() for t in threads)
+    for t in threads:
+        t.join()
+    # drain: everything pushed must reach the MV
+    for _ in range(200):
+        with srv.lock:
+            db.tick()
+            got = db.query("SELECT n FROM fh_mv")
+        if got and int(got[0][0] or 0) >= producers * per:
+            break
+    dt = max(1e-9, _t.perf_counter() - t0)
+    srv.stop()
+    total = producers * per
+    n_mv, sv = db.query("SELECT n, sv FROM fh_mv")[0]
+    bucket = db._overload.bucket("fh")
+    assert int(n_mv) == total, (n_mv, total)
+    assert int(sv) == total * (total - 1) // 2, "firehose sum mismatch"
+    return {
+        "producers": producers,
+        "rows": total,
+        "copy_eps": round(total / dt),
+        "admitted_rows": bucket.admitted_rows,
+        "lag_batches": bucket.lag,
+        "mv_verified": True,
+        "freshness": db._freshness.summary(),
+    }
+
+
+def stage_ingest(n_events, firehose_rows=200_000, producers=8):
+    """Workload: line-rate host ingest (ISSUE 15) — q4 with HOST ingest
+    in the measured path, before (host executor DAG, the BENCH_r05
+    671k-eps architecture) vs after (zero-copy staged feed into the
+    fused program), at 1 and 8 shards, plus the COPY firehose arm.
+    Freshness p50/p99 rides every arm: ingest rate is only real if
+    freshness holds under it."""
+    out = {}
+    # before: the old measured path — host chunks through the executor
+    # stack (per-row Python; measured at its own smaller scale)
+    before_n = min(n_events, HOST_SQL_EVENTS)
+    eps_before, _rows, _c, _p, _w, fresh = _q4_db(False, before_n)
+    out["before_host_executor"] = {
+        "host_sql_eps": round(eps_before), "events": before_n,
+        "freshness": fresh,
+    }
+    arm1, rows1 = _ingest_arm(n_events, 1, warm_pass=True)
+    out["host_ingest_1shard"] = arm1
+    # oracle verify (the host feed must change nothing)
+    cols = nexmark_host_columns(n_events)["bid"]
+    oracle = numpy_q4(cols[0].astype(np.int64), cols[2].astype(np.int64))
+    assert len(rows1) == len(oracle)
+    for a, c, s, m in rows1:
+        assert oracle[int(a)] == (int(c), int(s), int(m)), a
+    arm1["mv_verified"] = True
+    # 8-shard arm at a quarter scale: on a CPU-only host the "8 chips"
+    # are virtual devices over one CPU, so this arm proves per-shard
+    # placement + bit-identity, not speedup (the 1-vs-8 speedup story
+    # lives in shards_q4 on real chips)
+    arm8, rows8 = _ingest_arm(max(64 * INGEST_CHUNK, n_events // 4), 8,
+                              warm_pass=False)
+    arm1q, rows1q = _ingest_arm(max(64 * INGEST_CHUNK, n_events // 4), 1,
+                                warm_pass=False)
+    assert rows8 == rows1q, "8-shard host-ingest MV diverged"
+    arm8["mv_verified"] = True
+    out["host_ingest_8shard"] = arm8
+    out["ingest_speedup_vs_host_executor"] = round(
+        arm1["device_eps"] / max(1, eps_before), 2)
+    out["firehose_copy"] = _copy_firehose(firehose_rows, producers)
+    out["note"] = (
+        "before = host executor DAG with ingest in the measured path "
+        "(the BENCH_r05 671k-eps q4_sql architecture, at its own "
+        "scale); after = zero-copy staged host feed into the fused "
+        "program (device/ingest.py), same host. h2d_over_dispatch < 1 "
+        "= the double-buffered transfer hid under dispatch; "
+        "prefetched_frac = windows staged off the dispatch thread. "
+        "firehose_copy = concurrent pgwire COPY producers through the "
+        "admission gate, MV count+sum verified exactly. On a CPU-only "
+        "host the 'device' compute shares the same CPU as the ingest "
+        "pipeline, so the before/after ratio understates what an "
+        "accelerator sees (there, staging+H2D hide under real device "
+        "dispatch and the executor-DAG baseline gains nothing).")
+    return {"ingest": out}
+
+
 def stage_overload(n_rows):
     """Workload: overload survival (ISSUE 14) — the same bounded datagen
     MV + file sink at 1x/2x/10x offered load (rows per poll scaled).
@@ -938,6 +1147,7 @@ _STAGES = {
     "skew_qx": stage_skew_qx,
     "chaos_mttr": stage_chaos_mttr,
     "overload": stage_overload,
+    "ingest": stage_ingest,
 }
 
 
@@ -953,7 +1163,8 @@ def _stage_child(name, args, out_path):
         # (1.17M vs 350k ev/s warm). Must be set before jax imports.
         if name in ("fused", "qx_device", "shards_qx", "skew_qx"):
             os.environ["RW_TPU_CHEAP_COMPILE"] = "1"
-        if name.startswith("shards") or name.startswith("skew"):
+        if name.startswith("shards") or name.startswith("skew") \
+                or name == "ingest":
             # mesh fallback for CPU-only hosts: 8 virtual devices (the
             # flag is inert when the default platform has real chips);
             # must land before jax initializes in this child
@@ -1084,7 +1295,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r14.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r15.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -1111,6 +1322,9 @@ def main():
         h.run_stage("skew_q4", (131_072,), 120)
         h.run_stage("chaos_mttr", (262_144,), 90)
         h.run_stage("overload", (50_000,), 60)
+        # >= 4 staged windows at INGEST_CHUNK so the double buffer has
+        # something to overlap even at smoke scale
+        h.run_stage("ingest", (1_048_576, 20_000, 4), 180)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
         # compile of a fused epoch program set is ~200-400s on the remote-
@@ -1160,6 +1374,13 @@ def main():
         # overload survival sweep (ISSUE 14): freshness p50/p99 + eps +
         # shed counts at 1x/2x/10x offered load, ladder + audit asserted
         h.run_stage("overload", (500_000,), 240)
+        # line-rate host ingest (ISSUE 15): q4 with host ingest in the
+        # measured path — before (executor DAG) vs after (staged feed)
+        # at 1/8 shards + the concurrent-producer COPY firehose
+        if not h.run_stage("ingest", (Q4_SQL_EVENTS[0] // 2,
+                                      500_000, 16), 900):
+            h.run_stage("ingest", (Q4_SQL_EVENTS[0] // 2,
+                                   500_000, 16), 600, " — retry (warmer)")
     h.emit()
 
 

@@ -44,6 +44,17 @@ class DeviceConfig:
     # whole-fragment fusion (device/fuse_planner.py): eligible MV plans
     # become one jitted epoch program. Off forces the per-operator path.
     fuse: bool = True
+    # host-ingest feed for fused sources (device/ingest.py): every
+    # source of a fused job becomes an IngestNode whose per-epoch input
+    # is a pre-staged device buffer — host connectors poll into reused
+    # staging buffers, a staging thread double-buffers the H2D transfer
+    # under the previous epoch's dispatch, and per-shard blocks land
+    # directly on their chips under mesh_shards > 1. Off (default) keeps
+    # deterministic sources regenerating on device (fastest for
+    # synthetic benchmarks; host ingest is the production source path).
+    # RW_HOST_INGEST overrides; a single source opts in via
+    # WITH (nexmark.ingest='host').
+    host_ingest: bool = False
     # fused jobs mirror their MV into the host state table for non-device
     # readers every N checkpoints (plus at drain/recovery). 1 = every
     # checkpoint (reference-strict); higher trades mirror freshness for

@@ -171,14 +171,41 @@ def streamchunk_from_arrow(batch, dtypes: List[DataType]) -> StreamChunk:
     return StreamChunk(ops, cols)
 
 
+def _device_representable(dtype: DataType) -> bool:
+    return dtype.kind in _FIXED or dtype.kind in (
+        TypeKind.TIMESTAMP, TypeKind.DATE, TypeKind.BOOLEAN)
+
+
 def to_jax(col: Column):
     """Device transfer with no intermediate host copy: numpy -> jax.Array
     (dlpack on CPU; the direct H2D path on an accelerator). Only
     fixed-width, non-null columns cross — the device path's contract."""
     import jax.numpy as jnp
     if not col.validity.all():
-        raise ValueError("NULLs do not cross the device seam (mask first)")
-    if col.dtype.kind not in _FIXED and col.dtype.kind not in (
-            TypeKind.TIMESTAMP, TypeKind.DATE, TypeKind.BOOLEAN):
+        raise ValueError(
+            "NULLs do not cross the device seam (mask first) — use "
+            "to_jax_masked() to carry a validity bitmap alongside "
+            "sentinel-filled values, or filter the NULL rows host-side "
+            "before the transfer")
+    if not _device_representable(col.dtype):
         raise ValueError(f"{col.dtype} has no device representation")
     return jnp.asarray(col.values)
+
+
+def to_jax_masked(col: Column, sentinel=0):
+    """Nullable fixed-width column -> (values jax.Array, valid jax.Array
+    bool mask): NULL slots are filled with `sentinel` (any in-range
+    value — downstream device code must gate on the mask, never on the
+    fill) and the validity bitmap rides along as a device bool vector.
+    The valid-path fast case stays zero-copy (`jnp.asarray` over the
+    shared numpy buffer); only a column that actually holds NULLs pays
+    one host-side `np.where` to materialize the sentinel fill."""
+    import jax.numpy as jnp
+    if not _device_representable(col.dtype):
+        raise ValueError(f"{col.dtype} has no device representation")
+    valid = np.ascontiguousarray(col.validity)
+    if valid.all():
+        return jnp.asarray(col.values), jnp.asarray(valid)
+    vals = np.where(valid, col.values,
+                    np.asarray(sentinel, dtype=np.asarray(col.values).dtype))
+    return jnp.asarray(vals), jnp.asarray(valid)

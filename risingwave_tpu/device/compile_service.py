@@ -134,6 +134,11 @@ def abstract_program_avals(nodes, epoch_events: int, mesh=None):
         ins = tuple(outs[j] for j in node.inputs)
         if node.takes_event_lo:
             extra = jax.ShapeDtypeStruct((), jnp.int64)
+        elif node.takes_feed:
+            # host-ingest feed: fixed pow2 capacity = the epoch cadence,
+            # so the staged buffers of EVERY epoch (whatever row count a
+            # poll window admitted) hit this one pre-lowered signature
+            extra = node.feed_sds(epoch_events)
         elif isinstance(node, MVKeyedNode):
             extra = auxes[node.inputs[0]]
         else:
@@ -179,6 +184,14 @@ def _abstract_sharded_avals(nodes, epoch_events: int, mesh):
         ins = tuple(ins)
         if node.takes_event_lo:
             extra = jax.ShapeDtypeStruct((), jnp.int64)
+        elif node.takes_feed:
+            # per-shard feed blocks: the stager's host-side bucketing
+            # cuts ceil-div event blocks, so each shard's buffer is the
+            # same `feed_capacity` the live device_put ships
+            from .ingest import feed_capacity
+            extra = sds_sharded(
+                lift_sds(node.feed_sds(feed_capacity(epoch_events, n))),
+                mesh)
         elif isinstance(node, MVKeyedNode):
             extra = auxes[node.inputs[0]]
         else:

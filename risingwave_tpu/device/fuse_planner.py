@@ -26,9 +26,9 @@ from ..core import dtypes as T
 from ..core.dtypes import DataType, TypeKind
 from ..expr.expression import Expr, FunctionCall, InputRef, Literal
 from .fused import (AggNode, Delta, FilterNode, FusedJob, FusedProgram,
-                    HopNode, JoinNode, MapNode, MVKeyedNode, MVPairNode,
-                    MVPull, Node, PackPlan, PrecombineNode, SourceNode,
-                    node_shape_key, plan_shape_hash)
+                    HopNode, IngestNode, JoinNode, MapNode, MVKeyedNode,
+                    MVPairNode, MVPull, Node, PackPlan, PrecombineNode,
+                    SourceNode, node_shape_key, plan_shape_hash)
 
 NUM = ("num",)
 TS = ("ts",)
@@ -179,6 +179,20 @@ class _Fuser:
         self.precombine = _env_bool(
             "RW_AGG_PRECOMBINE",
             getattr(device_cfg, "agg_precombine", True))
+        # host-ingest mode (device/ingest.py): sources become IngestNodes
+        # fed from pre-staged host buffers instead of device-regenerated
+        # events — the production source path. DeviceConfig.host_ingest
+        # (RW_HOST_INGEST override) arms it globally; a single source
+        # opts in via WITH (nexmark.ingest='host').
+        self.host_ingest = _env_bool(
+            "RW_HOST_INGEST", getattr(device_cfg, "host_ingest", False))
+        self.ingest_nodes: Dict[int, "_NexmarkDesc"] = {}
+        # every source desc by node index: if ANY source of the job opts
+        # into host feed, the REST promote too (try_fuse) — a mixed job
+        # would desync the shared event clock the moment admission
+        # throttles an ingest window (the device-datagen source would
+        # still generate a full epoch range and re-emit the overlap)
+        self.source_descs: Dict[int, "_NexmarkDesc"] = {}
 
     def add(self, node: Node) -> int:
         self.nodes.append(node)
@@ -209,7 +223,10 @@ class _Fuser:
                                      f"{type(e.reader).__name__}")
                 if e.reader.next_event:
                     raise FuseReject("source already advanced")
-                desc = _NexmarkDesc.from_reader(e.reader, e.schema)
+                nm = e.name
+                if nm.startswith("Source(") and nm.endswith(")"):
+                    nm = nm[len("Source("):-1]
+                desc = _NexmarkDesc.from_reader(e.reader, e.schema, nm)
             else:
                 raise FuseReject(f"unfusable source chain node "
                                  f"{type(e).__name__}")
@@ -227,9 +244,25 @@ class _Fuser:
             self.epoch_events = ee
         elif self.epoch_events != ee:
             raise FuseReject("sources disagree on epoch cadence")
+        if self.host_ingest or desc.ingest == "host":
+            # host-feed mode: the per-epoch input is a pre-staged device
+            # buffer (device/ingest.py) — same column metadata, so every
+            # downstream packing proof is identical to the datagen plan
+            node: Node = IngestNode(desc.table, cfg, desc.col_names,
+                                    desc.rowid_pos, desc.max_events,
+                                    desc.dtypes)
+            idx = self.add(node)
+            self.ingest_nodes[idx] = desc
+            meta = Meta(idx, list(node.dtypes), list(node.decoders),
+                        list(node.ranges),
+                        rows_bound=desc.max_events or _HORIZON,
+                        append_only=True)
+            self._source_cache[key] = meta
+            return meta
         node = SourceNode(desc.table, cfg, desc.col_names, desc.rowid_pos,
                           desc.max_events, desc.dtypes)
         idx = self.add(node)
+        self.source_descs[idx] = desc
         meta = Meta(idx, list(node.dtypes), list(node.decoders),
                     list(node.ranges),
                     rows_bound=desc.max_events or _HORIZON,
@@ -446,9 +479,13 @@ class _NexmarkDesc:
     max_events: Optional[int]
     events_per_poll: int
     cache_key: Tuple
+    # catalog source name (admission-bucket / provenance key) and the
+    # per-source ingest opt-in (WITH (nexmark.ingest='host'))
+    src_name: str = ""
+    ingest: str = ""
 
     @staticmethod
-    def from_reader(reader, schema) -> "_NexmarkDesc":
+    def from_reader(reader, schema, src_name: str = "") -> "_NexmarkDesc":
         from .nexmark_gen import GenCfg
         names = [f.name for f in schema.fields]
         rowid = names.index("_row_id") if "_row_id" in names else None
@@ -456,7 +493,8 @@ class _NexmarkDesc:
             reader.table, GenCfg.from_config(reader.gen.cfg), tuple(names),
             tuple(f.dtype for f in schema.fields), rowid,
             reader.max_events, reader.events_per_poll,
-            (reader.table, id(reader.gen)))
+            (reader.table, id(reader.gen)), src_name,
+            getattr(reader, "ingest_mode", "") or "")
 
 
 # ---------------------------------------------------------------------------
@@ -554,7 +592,43 @@ def try_fuse(execu, ns, device_cfg, name: str,
             for node in f.nodes:
                 if isinstance(node, JoinNode):
                     node.hotrep = True
+        if f.ingest_nodes and f.source_descs:
+            # one source opted into host feed: promote the job's OTHER
+            # sources too. All sources share one event clock, and a
+            # mixed job would double-ingest the datagen sources' rows
+            # the moment admission shrinks a staged window (the ingest
+            # counter would advance by less than the device-generated
+            # range). Bit-identical either way — promotion only moves
+            # where the rows are produced.
+            for idx, desc in f.source_descs.items():
+                node = IngestNode(desc.table, desc.gencfg,
+                                  desc.col_names, desc.rowid_pos,
+                                  desc.max_events, desc.dtypes)
+                f.nodes[idx] = node
+                f.ingest_nodes[idx] = desc
+            f.source_descs.clear()
+        if f.ingest_nodes:
+            # feed-column pruning: only source columns some downstream
+            # node can actually read ship over the H2D seam (must land
+            # BEFORE the program/plan hash — liveness is part of the
+            # IngestNode trace)
+            _prune_ingest_columns(f.nodes, f.ingest_nodes)
         program = FusedProgram(f.nodes, ee, mesh=mesh)
+        ingest = None
+        if f.ingest_nodes:
+            # host-ingest stager: one multiplexed event clock across the
+            # job's ingest sources, feeds keyed by POST-CHAIN node index
+            from .ingest import HostIngest, NexmarkIngestSource
+            srcs = []
+            for idx, desc in f.ingest_nodes.items():
+                srcs.append((program.remap.get(idx, idx),
+                             NexmarkIngestSource(
+                                 desc.src_name or desc.table, desc.table,
+                                 desc.gencfg, desc.col_names,
+                                 desc.rowid_pos, desc.max_events,
+                                 live=f.nodes[idx].live)))
+            ingest = HostIngest(srcs, ee, mesh=mesh,
+                                max_events=f.max_events)
         ph = plan_shape_hash(program.nodes, program.epoch_events,
                              mesh.devices.size if mesh is not None else 1)
         hints = (cap_registry or {}).get(ph) or {}
@@ -593,9 +667,86 @@ def try_fuse(execu, ns, device_cfg, name: str,
                             device_cfg, "rebalance_threshold", 2.0),
                         hot_key_rep=hot_on and skew_on,
                         hot_key_frac=getattr(device_cfg,
-                                             "hot_key_frac", 0.125))
+                                             "hot_key_frac", 0.125),
+                        ingest=ingest)
     except FuseReject:
         return None
+
+
+def _expr_col_refs(e: Expr) -> set:
+    """Every InputRef index an expression tree reads."""
+    out = set()
+    stack = [e]
+    while stack:
+        c = stack.pop()
+        if isinstance(c, InputRef):
+            out.add(c.index)
+        stack.extend(c.children() if hasattr(c, "children") else [])
+    return out
+
+
+def _prune_ingest_columns(nodes, ingest_nodes) -> None:
+    """Feed-column liveness: which of an IngestNode's output columns can
+    any downstream node actually READ? Only those ship over the H2D
+    seam (`IngestNode.set_live`) — the host-side twin of the XLA
+    dead-code elimination that makes the device generator free to
+    "generate" columns nobody uses. The walk is conservative: any
+    consumer it cannot reason about (joins read every column, pair MVs
+    store every column, unknown node kinds) keeps the whole schema
+    live. Must run BEFORE the program is built: liveness is part of the
+    node's structural signature (it shapes the feed avals)."""
+    consumers: Dict[int, List[int]] = {i: [] for i in range(len(nodes))}
+    for j, nd in enumerate(nodes):
+        for i in nd.inputs:
+            consumers[i].append(j)
+    memo: Dict[int, Optional[set]] = {}
+
+    def need(i: int, arity: int) -> Optional[set]:
+        """Live output-column set of node i (None = all), given its
+        output arity (for pass-through consumers)."""
+        if i in memo:
+            return memo[i]
+        memo[i] = None               # cycle guard: DAG, but stay safe
+        out: set = set()
+        for j in consumers[i]:
+            c = nodes[j]
+            if isinstance(c, MapNode):
+                # a Map evaluates every expression regardless of its
+                # own downstream needs — its refs are terminal
+                r: Optional[set] = set()
+                for e in c.exprs:
+                    r |= _expr_col_refs(e)
+            elif isinstance(c, FilterNode):
+                down = need(j, arity)     # output cols = input cols
+                r = None if down is None \
+                    else _expr_col_refs(c.pred) | down
+            elif isinstance(c, HopNode):
+                down = need(j, arity + 2)
+                r = None if down is None \
+                    else {c.time_col} | {x for x in down if x < arity}
+            elif isinstance(c, (AggNode, PrecombineNode)):
+                r = set(c.group_idx)
+                for call in c.calls:
+                    if call.arg is not None:
+                        r.add(call.arg.index)
+            else:
+                # JoinNode ships/stores every input column; MV pair
+                # nodes store every column; anything unrecognized keeps
+                # the schema whole
+                r = None
+            if r is None:
+                memo[i] = None
+                return None
+            out |= r
+        memo[i] = out
+        return out
+
+    for idx, _desc in ingest_nodes.items():
+        node = nodes[idx]
+        live = need(idx, len(node.col_names))
+        if live is not None:
+            node.set_live(live)
+        memo.clear()                 # arity context is per ingest root
 
 
 def _fused_mesh(device_cfg, epoch_events: int):

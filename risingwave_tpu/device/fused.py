@@ -355,6 +355,11 @@ class Node:
     between nodes only routes device-array handles; dispatch stays async.
     """
     inputs: Tuple[int, ...] = ()
+    # this node's `extra` is a HOST-STAGED device feed (device/ingest.py
+    # (count, pk, *cols) buffers) delivered per epoch by the owning
+    # FusedJob's HostIngest stager — the host-ingest twin of
+    # takes_event_lo below
+    takes_feed: bool = False
     stat_names: Tuple[str, ...] = ()
     # subset of stat_names that accumulate across epochs by SUM (row-flow
     # counters); everything else accumulates by MAX (capacity needs,
@@ -590,6 +595,102 @@ class SourceNode(Node):
         cols = [ids if i == self.rowid_pos else all_cols[nm]
                 for i, nm in enumerate(self.col_names)]
         d = Delta(cols, jnp.ones(ids.shape, jnp.int32), mask, pk=ids)
+        return state, d, [_nrows(mask)], None
+
+
+class IngestNode(Node):
+    """Host-fed twin of SourceNode (device/ingest.py): the epoch's rows
+    arrive as a PRE-STAGED device buffer — (count, pk, *cols), packed
+    and transferred by the HostIngest stager ahead of the dispatch —
+    instead of being regenerated on device. The feed buffer is a fixed
+    pow2 capacity (the epoch cadence) with the live row count masked in,
+    so every epoch shares ONE aval signature with the compile service
+    regardless of how many rows the poll window admitted. Carries the
+    same static column metadata as SourceNode (dtypes, surrogate
+    decoders, proven ranges) so downstream packing proofs are identical
+    — a host-fed program is the device-datagen program with one leaf
+    swapped."""
+
+    takes_feed = True
+    stat_names = ("rows_out",)
+    stat_sums = ("rows_out",)
+
+    def __init__(self, table: str, gencfg, col_names: Sequence[str],
+                 rowid_pos: Optional[int], max_events: Optional[int],
+                 schema_dtypes: Sequence[DataType]):
+        from .nexmark_gen import SURROGATE, column_bounds
+        self.table = table
+        self.gencfg = gencfg
+        self.col_names = list(col_names)
+        self.rowid_pos = rowid_pos
+        self.max_events = max_events
+        self.dtypes = list(schema_dtypes)
+        self.decoders = []
+        self.ranges: List[Optional[Tuple[int, int, int]]] = []
+        for i, nm in enumerate(self.col_names):
+            if i == rowid_pos:
+                self.decoders.append(NUM)
+                self.ranges.append((0, max_events or (1 << 40), 1))
+                continue
+            self.decoders.append(SURROGATE[table][nm])
+            lo, hi = column_bounds(gencfg, table, nm, max_events)
+            stride = gencfg.inter_event_gap_usecs \
+                if SURROGATE[table][nm] == ("ts",) and nm == "date_time" \
+                else 1
+            self.ranges.append((lo, hi, stride))
+        # feed-column pruning (planner-armed via set_live BEFORE the
+        # program is built): only these column positions ship over the
+        # H2D seam; the rest are proven-dead downstream and zero-fill
+        # in-trace. None = every column ships. The host-side twin of
+        # the dead-code elimination the device generator gets from XLA.
+        self.live: Optional[Tuple[int, ...]] = None
+
+    def set_live(self, live: Sequence[int]) -> None:
+        live = tuple(sorted(set(int(i) for i in live)))
+        if len(live) < len(self.col_names):
+            self.live = live
+
+    def live_names(self) -> Optional[Tuple[str, ...]]:
+        if self.live is None:
+            return None
+        return tuple(self.col_names[i] for i in self.live)
+
+    def _sig(self):
+        return ("ingest", self.table, self.gencfg, tuple(self.col_names),
+                self.rowid_pos, self.max_events, self.live)
+
+    def feed_sds(self, cap: int):
+        """ShapeDtypeStruct mirror of one (per-shard) feed — what the
+        compile service's abstract walks lower against."""
+        import jax
+        import jax.numpy as jnp
+        ncols = len(self.live) if self.live is not None \
+            else len(self.col_names)
+        col = jax.ShapeDtypeStruct((cap,), jnp.int64)
+        return ((jax.ShapeDtypeStruct((), jnp.int64),
+                 col) + (col,) * ncols)
+
+    def apply(self, state, ins, extra, epoch_events):
+        import jax.numpy as jnp
+        cnt, pk = extra[0], extra[1]
+        shipped = list(extra[2:])
+        n = pk.shape[0]
+        if self.live is None:
+            cols = shipped
+        else:
+            # dead columns never reach a downstream read (liveness is
+            # proven by the planner walk) — zero-fill keeps the delta's
+            # positional schema without paying their transfer
+            zero = jnp.zeros((n,), jnp.int64)
+            cols = [zero] * len(self.col_names)
+            for k, ci in enumerate(self.live):
+                cols[ci] = shipped[k]
+        # the staged buffer is capacity-padded; only the first `cnt`
+        # rows are this epoch's (slots past it hold stale bytes from the
+        # reused staging buffer — masked, exactly like the device
+        # generator's other-kind event slots)
+        mask = jnp.arange(n, dtype=jnp.int64) < cnt
+        d = Delta(cols, jnp.ones((n,), jnp.int32), mask, pk=pk)
         return state, d, [_nrows(mask)], None
 
 
@@ -1532,13 +1633,17 @@ class FusedProgram:
         n = self.nodes[i]
         return f"{i}:{type(n).__name__}:{hash(n) & 0xFFFFFFFF:08x}"
 
-    def epoch(self, states, event_lo):
+    def epoch(self, states, event_lo, feeds=None):
         """Host loop over per-node jitted steps: each call dispatches
         async; only device-array handles flow between nodes. With a live
         profiler, each step is wall-timed: a step flagged as pending (cold
         start / post-growth) or blocking past the compile threshold is
         recorded as a compile/retrace event — dispatch is async, so a
-        blocking step call IS trace+compile time."""
+        blocking step call IS trace+compile time.
+
+        `feeds` maps node index -> staged device feed for `takes_feed`
+        (host-ingest) nodes; the owning FusedJob's HostIngest stager
+        supplies one per dispatched epoch."""
         import jax.numpy as jnp
         from ..utils.profile import COMPILE_THRESHOLD_S
         import time as _time
@@ -1576,6 +1681,8 @@ class FusedProgram:
             if node.takes_event_lo:
                 extra = jnp.int64(event_lo) if not hasattr(
                     event_lo, 'dtype') else event_lo
+            elif node.takes_feed:
+                extra = (feeds or {})[i]
             elif isinstance(node, MVKeyedNode):
                 extra = auxes[node.inputs[0]]
             else:
@@ -1641,8 +1748,8 @@ class FusedProgram:
         import jax.numpy as jnp
         sum_mask = jnp.asarray(self._sum_mask)
 
-        def step(states, event_lo, stats_acc):
-            new_states, vec = self.epoch(states, event_lo)
+        def step(states, event_lo, stats_acc, feeds=None):
+            new_states, vec = self.epoch(states, event_lo, feeds=feeds)
             # jitted fold (see the _stack_stats rationale): one program
             # instead of three eager ops per epoch
             acc = _fold_stats(vec, stats_acc, sum_mask)
@@ -1736,7 +1843,8 @@ class FusedJob:
                  compile_buckets: int = 4,
                  plan_hash: Optional[str] = None,
                  rebalance: bool = True, rebalance_threshold: float = 2.0,
-                 hot_key_rep: bool = True, hot_key_frac: float = 0.125):
+                 hot_key_rep: bool = True, hot_key_frac: float = 0.125,
+                 ingest=None):
         import jax.numpy as jnp
         from ..utils.profile import JobProfiler
         self.name = name
@@ -1770,6 +1878,10 @@ class FusedJob:
             self.compile_service = get_service()
             program.compile_service = self.compile_service
             program.job_name = name
+        # host-ingest stager (device/ingest.py): when set, every epoch's
+        # source input is a pre-staged device buffer taken from it
+        # instead of device-regenerated events; None = the datagen path
+        self.ingest = ingest
         # node indices predate the chain transform — remap through it
         pull.node_idx = program.remap.get(pull.node_idx, pull.node_idx)
         self.pull = pull
@@ -1899,6 +2011,11 @@ class FusedJob:
         # already re-dispatched it from the epoch event log.
         dispatched = False
         todo = stretch
+        if self.ingest is not None and not self.drained:
+            # barrier-time admission refill (the SourceExecutor
+            # contract): one token authorizes one window per source; a
+            # stretched barrier needs `stretch` or the tail defers
+            self.ingest.epoch_refill(stretch)
         while True:
             try:
                 if not self.drained and not dispatched:
@@ -1906,7 +2023,11 @@ class FusedJob:
                     # recovery replays what WAS logged, the retry then
                     # dispatches only the epochs still owed this barrier
                     while todo > 0 and not self.drained:
-                        self._dispatch_epoch(prof)
+                        if not self._dispatch_epoch(prof):
+                            # host-ingest window deferred (admission) or
+                            # empty: the data stays at the connector —
+                            # give the barrier's remaining budget back
+                            break
                         todo -= 1
                     dispatched = True
                 if barrier.is_checkpoint:
@@ -1925,25 +2046,49 @@ class FusedJob:
             # against a wedged process must see the newest checkpoint
             self.profiler.flush()
 
-    def _dispatch_epoch(self, prof) -> None:
+    def _dispatch_epoch(self, prof) -> bool:
         """Dispatch ONE epoch (async) and log it into the epoch event
-        log — the coordinator-side record an in-place recovery replays."""
+        log — the coordinator-side record an in-place recovery replays.
+        Returns False when a host-ingest window was deferred (admission)
+        — nothing was dispatched and the counter did not move."""
         import jax.numpy as jnp
         import time as _time
-        if self._window_ingest is None:
-            # first dispatch since the last checkpoint: freshness of
-            # the NEXT commit is measured against this moment
-            self._window_ingest = _time.time()
         if failpoint("fused.dispatch"):
             raise FailpointError("fused.dispatch")
         t0 = _time.perf_counter() if prof is not None else 0.0
+        feeds = None
+        events = self.program.epoch_events
+        h2d_s = 0.0
+        ingest_ts = None
+        if self.ingest is not None:
+            # the staged window at the event counter: pre-packed,
+            # pre-transferred by the staging thread when the double
+            # buffer is warm — pack/h2d below then collapse to the lock
+            # wait, which is the whole point (the profiler's evidence
+            # surface for the overlap)
+            w, pack_s, h2d_s = self.ingest.take(self.counter)
+            if w.events <= 0:
+                if prof is not None:
+                    prof.phase("pack", _time.perf_counter() - t0)
+                return False
+            feeds, events, ingest_ts = w.feeds, w.events, w.ingest_ts
+        if self._window_ingest is None:
+            # first dispatch since the last checkpoint: freshness of the
+            # NEXT commit is measured against this moment — for ingest
+            # jobs the moment the window's rows came off the connector
+            self._window_ingest = ingest_ts if ingest_ts is not None \
+                else _time.time()
+        elif ingest_ts is not None:
+            self._window_ingest = min(self._window_ingest, ingest_ts)
         lo = jnp.int64(self.counter)
         if prof is not None:
             t1 = _time.perf_counter()
-            prof.phase("host_pack", t1 - t0)
+            prof.phase("pack", t1 - t0 - h2d_s)
+            if h2d_s > 0.0:
+                prof.phase("h2d", h2d_s)
             t0 = t1
         self.states, self.stats_acc = self._step(
-            self.states, lo, self.stats_acc)
+            self.states, lo, self.stats_acc, feeds=feeds)
         if prof is not None:
             dt = _time.perf_counter() - t0
             # the ICI shuffle's enqueue wall is its own phase so the
@@ -1953,8 +2098,9 @@ class FusedJob:
             if ex > 0.0:
                 prof.phase("exchange", ex)
             prof.phase("dispatch", dt - ex)
-        self._epoch_log.append(self.counter, self.program.epoch_events)
-        self.counter += self.program.epoch_events
+        self._epoch_log.append(self.counter, events)
+        self.counter += events
+        return True
 
     def _recover_in_place(self, err: BaseException) -> None:
         """In-place recovery from a device-path failure: NO DDL-replay
@@ -2020,8 +2166,19 @@ class FusedJob:
         event_lo advances as a device-side scalar add instead of a fresh
         host->device transfer per epoch (one RTT each on a remote tunnel),
         and no per-epoch host work (stats pulls, MV mirroring, tracer
-        spans) happens until the terminal sync/checkpoint."""
+        spans) happens until the terminal sync/checkpoint.
+
+        Host-ingest jobs replay through the stager instead: retained
+        windows re-pack verbatim, committed history re-derives from the
+        sources' deterministic range contract (`HostIngest.replay_range`)
+        — the staged-window replay the epoch event log promises."""
         import jax.numpy as jnp
+        if self.ingest is not None:
+            for wlo, _ev, feeds in self.ingest.replay_range(lo, hi):
+                self.states, self.stats_acc = self._step(
+                    self.states, jnp.int64(wlo), self.stats_acc,
+                    feeds=feeds)
+            return
         e = self.program.epoch_events
         lo_dev = jnp.int64(lo)
         c = lo
@@ -2222,6 +2379,10 @@ class FusedJob:
         # reset the in-place recovery attempt budget (attempts bound
         # failures per window, not per job lifetime)
         self._epoch_log.clear()
+        if self.ingest is not None:
+            # committed windows are durable — drop their retained host
+            # arrays (the crash-window retention contract)
+            self.ingest.trim(self.committed)
         self._recovery_attempts = 0
         # skew defenses that change exchange routing adopt HERE — the
         # only point where committed == counter and the whole history is

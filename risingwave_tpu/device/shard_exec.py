@@ -466,7 +466,11 @@ def sharded_apply(mesh, node, epoch_events: int, state, ins, extra,
         if node.takes_event_lo and not abst:
             ex = extra + jax.lax.axis_index(SHARD_AXIS).astype(
                 jnp.int64) * ev_local
-        elif isinstance(node, MVKeyedNode):
+        elif node.takes_feed or isinstance(node, MVKeyedNode):
+            # a host-staged ingest feed arrives pre-bucketed per shard
+            # (device/ingest.py packs each shard's contiguous event
+            # block host-side and device_puts with the vnode-block
+            # NamedSharding) — the local step just drops the shard axis
             ex = _drop(extra)
         st, out, stats, aux = node.apply(lst, lins, ex, ev_local)
         if pad and node.takes_event_lo and out is not None \
@@ -501,7 +505,7 @@ def sharded_apply(mesh, node, epoch_events: int, state, ins, extra,
     if node.takes_event_lo:
         from jax.sharding import PartitionSpec as P
         espec = P()
-    elif isinstance(node, MVKeyedNode):
+    elif node.takes_feed or isinstance(node, MVKeyedNode):
         espec = _spec_sharded(extra)
     else:
         espec = None

@@ -288,11 +288,162 @@ class _Conn:
         self._data_rows(rows, kinds)
         self._send(b"C", f"SELECT {len(rows)}".encode() + b"\0")
 
+    # ---- COPY <table> FROM STDIN ---------------------------------------
+    _COPY_RE = None
+
+    @classmethod
+    def _match_copy(cls, sql: str):
+        """(table, options text) for a COPY ... FROM STDIN statement, or
+        None. COPY is a wire-protocol feature (CopyInResponse + CopyData
+        framing), so it is recognized here rather than in the SQL
+        parser — the reference routes it the same way
+        (pg_protocol.rs copy-in handling)."""
+        import re
+        if cls._COPY_RE is None:
+            cls._COPY_RE = re.compile(
+                r"^\s*COPY\s+(\"?[A-Za-z_][A-Za-z0-9_]*\"?)\s+FROM\s+"
+                r"STDIN\s*(.*?);?\s*$", re.IGNORECASE | re.DOTALL)
+        m = cls._COPY_RE.match(sql)
+        if m is None:
+            return None
+        opts = (m.group(2) or "").strip().rstrip(";").strip()
+        if ";" in opts:
+            # 'COPY t FROM STDIN; SELECT 1' — the tail is a second
+            # statement, not COPY options; refuse CLEARLY instead of a
+            # baffling option error (copy-in owns the whole message)
+            e = ValueError("COPY FROM STDIN must be the only statement "
+                           "in its message")
+            e.sqlstate = "0A000"
+            raise e
+        return m.group(1).strip('"'), opts
+
+    @staticmethod
+    def _copy_format(opts: str) -> Tuple[str, str]:
+        """(format, delimiter) from the COPY options tail; raises
+        ValueError with .sqlstate = 0A000 on anything unsupported
+        (BINARY, PROGRAM, unknown format names) — a clean refusal
+        BEFORE CopyInResponse, so the client never starts streaming."""
+        import re
+        fmt, delim = "text", None
+        t = opts.strip()
+        if t:
+            m = re.fullmatch(
+                r"(?:WITH\s*)?\(\s*(.*?)\s*\)", t,
+                re.IGNORECASE | re.DOTALL)
+            body = m.group(1) if m else t
+            for part in re.split(r",", body):
+                part = part.strip()
+                if not part:
+                    continue
+                kv = re.fullmatch(
+                    r"(FORMAT|DELIMITER)\s+'?([^']*)'?", part,
+                    re.IGNORECASE)
+                if kv is None and part.upper() in ("CSV", "TEXT",
+                                                   "BINARY"):
+                    kv_k, kv_v = "FORMAT", part
+                elif kv is None:
+                    e = ValueError(f"COPY option {part!r} is not "
+                                   "supported")
+                    e.sqlstate = "0A000"
+                    raise e
+                else:
+                    kv_k, kv_v = kv.group(1), kv.group(2)
+                if kv_k.upper() == "FORMAT":
+                    fmt = kv_v.strip().lower()
+                else:
+                    delim = kv_v
+        if fmt not in ("text", "csv"):
+            e = ValueError(
+                f"COPY format {fmt!r} is not supported (text, csv only)")
+            e.sqlstate = "0A000"
+            raise e
+        return fmt, delim if delim is not None \
+            else ("\t" if fmt == "text" else ",")
+
+    def _copy_in(self, table: str, opts: str) -> None:
+        """Copy-in sub-protocol: CopyInResponse, then CopyData frames
+        parsed in batches through the Database's admission-gated bulk
+        path (`copy_rows`) — the firehose entry point. Batches flow as
+        they arrive (a producer streaming forever still makes progress);
+        the final flush rides CopyDone."""
+        import struct as _struct
+        fmt, delim = self._copy_format(opts)
+        with self.lock:
+            ncols = self.db.copy_describe(table)
+        self._send(b"G", b"\x00" + _struct.pack(">H", ncols)
+                   + _struct.pack(">H", 0) * ncols)
+        buf = b""
+        rows = 0
+        failed: Optional[str] = None
+        while True:
+            tag, body = self._recv(1), None
+            (ln,) = _struct.unpack(">I", self._recv(4))
+            body = self._recv(ln - 4)
+            if tag == b"d":                      # CopyData
+                if failed is not None:
+                    continue
+                buf += body
+                # frame on the last newline: a CopyData boundary may
+                # split a row in half. For csv the newline must also be
+                # OUTSIDE quotes (even quote count before it) — quoted
+                # fields may legally contain newlines
+                cut = buf.rfind(b"\n")
+                if fmt == "csv":
+                    while cut >= 0 and buf.count(b'"', 0, cut) % 2 == 1:
+                        cut = buf.rfind(b"\n", 0, cut)
+                    if cut < 0 and len(buf) > (8 << 20):
+                        # quote parity never evens out: a stray quote in
+                        # an unquoted field (data _csv_rows accepts as
+                        # literal) would otherwise buffer the stream
+                        # unboundedly. Fall back to plain newline
+                        # framing past the bound — which also means a
+                        # WELL-FORMED quoted field larger than 8 MiB
+                        # gets torn (documented limit; PG's own COPY
+                        # has a 1 GiB field ceiling for the same class
+                        # of reason)
+                        cut = buf.rfind(b"\n")
+                if cut >= 0:
+                    chunk, buf = buf[:cut + 1], buf[cut + 1:]
+                    try:
+                        with self.lock:
+                            rows += self.db.copy_rows(
+                                table, chunk.decode("utf-8"), fmt, delim)
+                    except Exception as e:  # noqa: BLE001
+                        failed = f"{type(e).__name__}: {e}"
+            elif tag == b"c":                    # CopyDone
+                if failed is None and buf.strip():
+                    try:
+                        with self.lock:
+                            rows += self.db.copy_rows(
+                                table, buf.decode("utf-8"), fmt, delim)
+                    except Exception as e:  # noqa: BLE001
+                        failed = f"{type(e).__name__}: {e}"
+                if failed is not None:
+                    self._error(failed, "22P04")
+                else:
+                    with self.lock:
+                        self.db.flush()
+                    self._send(b"C", f"COPY {rows}".encode() + b"\0")
+                return
+            elif tag == b"f":                    # CopyFail
+                self._error("COPY aborted by client: "
+                            + body.rstrip(b"\0").decode("utf-8",
+                                                        "replace"),
+                            "57014")
+                return
+            elif tag == b"X":
+                raise ConnectionError("client terminated during COPY")
+            # Flush/Sync mid-copy: ignore, per protocol
+
     def _run_one(self, sql: str, suppress_desc: bool = False) -> bool:
         """Execute every statement in `sql`; returns False for an empty
         query (caller sends EmptyQueryResponse)."""
         from ..sql import ast as A
         from ..sql.parser import parse_sql_with_text
+        cp = self._match_copy(sql)
+        if cp is not None:
+            self._copy_in(*cp)
+            return True
         pairs = parse_sql_with_text(sql)
         if not pairs:
             return False

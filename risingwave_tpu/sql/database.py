@@ -508,10 +508,18 @@ class Database:
                     f"{kd!r} conflicts with "
                     f"{self._nexmark_gen.cfg.key_dist!r}")
             cols = [c.name for c in stmt.columns]
-            return NexmarkReader(table, self._nexmark_gen,
-                                 events_per_poll=per,
-                                 max_events=int(maxe) if maxe else None,
-                                 columns=cols)
+            reader = NexmarkReader(table, self._nexmark_gen,
+                                   events_per_poll=per,
+                                   max_events=int(maxe) if maxe else None,
+                                   columns=cols)
+            # per-source host-ingest opt-in (fused jobs feed this source
+            # through the staging pipeline instead of device datagen)
+            ing = stmt.with_options.get("nexmark.ingest", "").lower()
+            if ing and ing not in ("host", "device"):
+                raise ValueError(
+                    f"nexmark.ingest={ing!r} (supported: host, device)")
+            reader.ingest_mode = "" if ing == "device" else ing
+            return reader
         if connector == "datagen":
             from ..connectors.datagen import FieldGen
             per = int(float(stmt.with_options.get("rows.per.poll", "1024")))
@@ -699,6 +707,17 @@ class Database:
                                "upstream_subs": [], "fused_job": job}
                 self.catalog.create(obj)
                 self._fused[stmt.name] = job
+                if getattr(job, "ingest", None) is not None:
+                    # host-ingest jobs keep PR 14's per-source admission
+                    # semantics: each multiplexed source gets the same
+                    # overload-manager bucket a host SourceExecutor
+                    # would (rw_source_admission rows, ladder-rated
+                    # factor, deferral lag) — unadmitted windows stay at
+                    # the connector, never in RAM
+                    for sname in job.ingest.source_names():
+                        b = self._overload.bucket(sname)
+                        b.shed_sink = self._shed_record
+                        job.ingest.buckets[sname] = b
                 job.profiler.attach(self._data_dir)
                 # skew snapshots (risectl skew, offline-capable) mirror
                 # beside epoch_profile.jsonl at every checkpoint
@@ -1024,6 +1043,8 @@ class Database:
         self._overload.forget(stmt.name)
         dropped_job = self._fused.pop(stmt.name, None)
         if dropped_job is not None:
+            if getattr(dropped_job, "ingest", None) is not None:
+                dropped_job.ingest.close()    # join the staging thread
             # remember where its capacities topped out, keyed by plan
             # shape — a re-created MV with the same plan (any name)
             # starts there (zero growth replays); structurally identical
@@ -1087,6 +1108,124 @@ class Database:
             schema.dtypes, [(Op.INSERT, r) for r in rows]))
         self.flush()
         return f"INSERT_{len(rows)}"
+
+    # ------------------------------------------------------------------
+    # COPY FROM STDIN (pgwire firehose entry point)
+    # ------------------------------------------------------------------
+    def copy_describe(self, table: str) -> int:
+        """Validate a COPY target and return its data-column count (the
+        CopyInResponse column count — hidden _row_id excluded)."""
+        obj = self.catalog.get(table)
+        rt = obj.runtime if isinstance(obj.runtime, dict) else None
+        if rt is None or rt.get("reader") is None:
+            raise ValueError(f"{table} is not COPY-writable (DML tables "
+                             "only — sources pull from their connector)")
+        return sum(1 for f in obj.schema.fields if f.name != ROWID)
+
+    def _copy_bucket(self, table: str):
+        """The COPY firehose rides the same per-source admission buckets
+        as connector sources (PR 14): re-rated by the overload ladder,
+        refilled once per epoch. COPY refills its own bucket on epoch
+        change — a DML table has no SourceExecutor to do it."""
+        b = self._overload.bucket(table)
+        if b.shed_sink is None:
+            b.shed_sink = self._shed_record   # audited drops -> rw_shed_log
+        cur = self.injector.epoch.curr
+        if getattr(b, "_copy_epoch", None) != cur:
+            b._copy_epoch = cur
+            b.epoch_refill(max(1, b.stretch))
+        return b
+
+    def copy_chunk(self, table: str, text: str, fmt: str = "text",
+                   delim: str = "\t",
+                   force: bool = False) -> Tuple[str, int]:
+        """One admission-gated COPY batch: parse `text` (newline-framed
+        rows in the given format) and push through the table's DML
+        reader. Returns (verdict, rows): `defer` pushed nothing — the
+        caller holds the wire (TCP backpressure to the producer) and
+        retries; `shed` dropped the batch with a durable rw_shed_log
+        audit row (shedding rung + RW_LOAD_SHED only); `admit` pushed.
+        `force` bypasses a defer after the caller's bounded wait so a
+        COPY can never deadlock on a quiescent barrier clock."""
+        obj = self.catalog.get(table)
+        reader = (obj.runtime or {}).get("reader")
+        assert reader is not None, f"{table} is not COPY-writable"
+        b = self._copy_bucket(table)
+        verdict = b.admit()
+        if verdict == "defer" and not force:
+            return "defer", 0
+        rows = self._parse_copy(obj.schema, text, fmt, delim)
+        if not rows:
+            return "admit", 0
+        if verdict == "shed":
+            b.note_shed(self.injector.epoch.curr, len(rows))
+            return "shed", len(rows)
+        b.note_admitted(len(rows))
+        reader.push(StreamChunk.from_rows(
+            obj.schema.dtypes, [(Op.INSERT, r) for r in rows]))
+        return "admit", len(rows)
+
+    def copy_rows(self, table: str, text: str, fmt: str = "text",
+                  delim: str = "\t") -> int:
+        """Admission-gated COPY with a bounded defer wait (the embedded
+        API / pgwire convenience wrapper around copy_chunk)."""
+        import time as _time
+        deadline = _time.monotonic() + 1.0
+        while True:
+            verdict, n = self.copy_chunk(
+                table, text, fmt, delim,
+                force=_time.monotonic() >= deadline)
+            if verdict != "defer":
+                return n if verdict == "admit" else 0
+            _time.sleep(0.01)
+
+    @staticmethod
+    def _parse_copy(schema: Schema, text: str, fmt: str,
+                    delim: str) -> List[Tuple]:
+        """COPY text/csv lines -> full-schema host rows (the minimal PG
+        subset: text format with \\N NULLs and backslash escapes, csv
+        with RFC-4180 quoting — embedded delimiters/newlines/doubled
+        quotes inside quoted fields — where an empty UNQUOTED field is
+        NULL and a quoted empty field is the empty string)."""
+        from ..connectors.base import _coerce
+        fields = [f for f in schema.fields if f.name != ROWID]
+        has_rowid = len(fields) != len(schema.fields)
+        rows: List[Tuple] = []
+
+        def build(vals: List[Optional[str]]) -> None:
+            if len(vals) != len(fields):
+                raise ValueError(
+                    f"COPY row has {len(vals)} columns, table expects "
+                    f"{len(fields)}")
+            r = [None if v is None else _coerce(v, f.dtype)
+                 for v, f in zip(vals, fields)]
+            rows.append(tuple(r) + ((None,) if has_rowid else ()))
+
+        if fmt == "csv":
+            for parts in _csv_rows(text, delim):
+                if parts == ["\\."]:     # end-of-data marker (PG
+                    continue             # recognizes it in csv too)
+                build(parts)
+        else:
+            import re
+            # single-pass unescape: sequential str.replace would let an
+            # escaped backslash's second byte re-match as '\\t' etc.
+            unesc = {"t": "\t", "n": "\n", "r": "\r", "\\": "\\"}
+            pat = re.compile(r"\\(.)")
+            for ln in text.split("\n"):
+                ln = ln.rstrip("\r")
+                if not ln or ln == "\\.":
+                    continue
+                vals: List[Optional[str]] = []
+                for p in ln.split(delim):
+                    if p == "\\N":
+                        vals.append(None)
+                    else:
+                        vals.append(pat.sub(
+                            lambda m: unesc.get(m.group(1), m.group(1)),
+                            p))
+                build(vals)
+        return rows
 
     def _delete(self, stmt: A.Delete) -> str:
         obj = self.catalog.get(stmt.table)
@@ -1536,6 +1675,59 @@ class Database:
         if q.limit is not None:
             out = out[: q.limit]
         return [r[:n_vis] for r in out]
+
+
+def _csv_rows(text: str, delim: str) -> List[List[Optional[str]]]:
+    """RFC-4180 row splitter for COPY csv: quoted fields may hold the
+    delimiter, newlines, and doubled quotes; an UNQUOTED empty field is
+    NULL (None) while a quoted empty field is ''. A hand state machine
+    because csv.reader both discards quoted-ness (collapsing '\"\"' and
+    '' to the same value) and needs pre-split lines (tearing embedded
+    newlines)."""
+    rows: List[List[Optional[str]]] = []
+    field: List[str] = []
+    row: List[Optional[str]] = []
+    quoted = False      # current field was opened with a quote
+    in_q = False        # currently inside the quotes
+    i, n = 0, len(text)
+
+    def end_field():
+        nonlocal quoted
+        v = "".join(field)
+        row.append(v if quoted or v != "" else None)
+        field.clear()
+        quoted = False
+
+    while i < n:
+        c = text[i]
+        if in_q:
+            if c == '"':
+                if i + 1 < n and text[i + 1] == '"':
+                    field.append('"')
+                    i += 1
+                else:
+                    in_q = False
+            else:
+                field.append(c)
+        elif c == '"' and not field:
+            quoted = True
+            in_q = True
+        elif c == delim:
+            end_field()
+        elif c == "\n" or c == "\r":
+            if c == "\r" and i + 1 < n and text[i + 1] == "\n":
+                i += 1
+            if field or quoted or row:
+                end_field()
+                rows.append(list(row))
+                row.clear()
+        else:
+            field.append(c)
+        i += 1
+    if field or quoted or row:
+        end_field()
+        rows.append(list(row))
+    return rows
 
 
 def _source_names(q: A.Select) -> List[str]:

@@ -82,13 +82,15 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("progress", T.VARCHAR)),
         lambda db: _ddl_progress(db)),
     # epoch-timeline profiler (utils/profile.py): one row per fused-job
-    # epoch with its phase split — host pack, async dispatch, blocking
-    # device sync, state-table commit (ring-buffered; the full history
-    # is in epoch_profile.jsonl / `risectl profile`)
+    # epoch with its phase split — host pack, H2D transfer enqueue
+    # (staged ingest buffers), async dispatch, blocking device sync,
+    # state-table commit (ring-buffered; the full history is in
+    # epoch_profile.jsonl / `risectl profile`). pack/h2d split the old
+    # host_pack column disjointly.
     "rw_epoch_profile": (
         Schema.of(("job", T.VARCHAR), ("seq", T.INT64),
                   ("events", T.INT64), ("shards", T.INT64),
-                  ("host_pack_ms", T.FLOAT64),
+                  ("pack_ms", T.FLOAT64), ("h2d_ms", T.FLOAT64),
                   ("dispatch_ms", T.FLOAT64), ("exchange_ms", T.FLOAT64),
                   ("device_sync_ms", T.FLOAT64),
                   ("commit_ms", T.FLOAT64), ("wall_ms", T.FLOAT64)),

@@ -5,7 +5,20 @@ streaming engine is only tunable with continuous phase/occupancy
 accounting; this module is that accounting for the fused execution path.
 Each epoch of a `FusedJob` is one phase-split span:
 
-  host_pack    — building the epoch's host-side inputs (event cursor)
+  pack         — building the epoch's host-side inputs: the event cursor
+                 for device-datagen jobs; for host-ingest jobs
+                 (device/ingest.py) the wall the dispatch thread spends
+                 packing poll windows into staging buffers OR blocked on
+                 the staging thread doing it (a well-overlapped double
+                 buffer drives this toward zero)
+  h2d          — host->device transfer enqueue (`jax.device_put` of the
+                 staged ingest buffers) as seen by the dispatch thread;
+                 split disjointly out of the old `host_pack` so the
+                 ingest pipeline's two costs are separately attributable.
+                 The stager's HIDDEN walls (work done on the staging
+                 thread while the device computes) are reported through
+                 `HostIngest.stats()`, not epoch spans — in-span phases
+                 stay on-thread so they keep summing to <= epoch wall
   dispatch     — the async per-node jit dispatch loop (no device sync)
   exchange     — dispatching the in-program ICI shuffle of mesh-sharded
                  programs (device/shard_exec.py); 0 on single-chip jobs.
@@ -20,7 +33,7 @@ Every span and row carries the job's `shards` dimension (device mesh
 size; 1 = single chip) so phase timings from sharded and unsharded runs
 never aggregate silently.
 
-Non-checkpoint epochs only carry host_pack+dispatch (their device work is
+Non-checkpoint epochs only carry pack+h2d+dispatch (their device work is
 paid for by the next sync — that asymmetry is the async-dispatch design,
 and exactly what the profiler exists to make visible). Compile/retrace
 events are timed separately and labeled by node signature so warmup time
@@ -44,7 +57,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 PROFILE_FILE = "epoch_profile.jsonl"
 _MAX_FILE_BYTES = 4 << 20
-PHASES = ("host_pack", "dispatch", "exchange", "device_sync", "commit")
+PHASES = ("pack", "h2d", "dispatch", "exchange", "device_sync", "commit")
 # a per-node step call slower than this is recorded as a compile/retrace
 # even when the profiler did not expect one (catches shape changes that
 # arrived through a path growth accounting doesn't flag)
@@ -175,14 +188,18 @@ class JobProfiler:
 
     # ---- surfaces --------------------------------------------------------
     def rows(self) -> List[Tuple]:
-        """rw_epoch_profile rows: (job, seq, events, shards, host_pack_ms,
-        dispatch_ms, exchange_ms, device_sync_ms, commit_ms, wall_ms)."""
+        """rw_epoch_profile rows: (job, seq, events, shards, pack_ms,
+        h2d_ms, dispatch_ms, exchange_ms, device_sync_ms, commit_ms,
+        wall_ms). Records written by a pre-split release carry
+        `host_pack`; it reads back as `pack` (h2d was 0 by construction
+        there — no staged transfers existed)."""
         out = []
         for r in self.ring:
             ph = r["ph_ms"]
             out.append((self.job, r["seq"], r["events"],
                         r.get("shards", 1),
-                        ph.get("host_pack", 0.0), ph.get("dispatch", 0.0),
+                        ph.get("pack", ph.get("host_pack", 0.0)),
+                        ph.get("h2d", 0.0), ph.get("dispatch", 0.0),
                         ph.get("exchange", 0.0),
                         ph.get("device_sync", 0.0), ph.get("commit", 0.0),
                         r["wall_ms"]))

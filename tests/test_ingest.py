@@ -1,0 +1,420 @@
+"""Host-ingest staging for fused jobs (device/ingest.py, ISSUE 15).
+
+The contract under test: a fused MV whose sources are HOST-FED through
+the staging pipeline (poll -> pack into reused buffers -> double-buffered
+H2D -> IngestNode feed) is BIT-IDENTICAL — including row order — to the
+same MV on the device-datagen fused path, at 1 and 8 shards, with
+admission control and the fault-tolerance machinery engaged. (The host
+EXECUTOR path is compared order-insensitively, as every fused-vs-host
+test in this repo always has: the host MV's iteration order was never
+part of the engine's bit-identity contract — the fused family's row
+order is.)
+"""
+import os
+
+import numpy as np
+import pytest
+
+from risingwave_tpu.config import DeviceConfig
+from risingwave_tpu.sql import Database
+
+N = 4096
+CHUNK = 32          # fused epoch = 64 * CHUNK = 2048 events
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='{c}'{x})")
+AUCTION_SRC = ("CREATE SOURCE auction (id BIGINT, item_name VARCHAR,"
+               " description VARCHAR, initial_bid BIGINT, reserve BIGINT,"
+               " date_time TIMESTAMP, expires TIMESTAMP, seller BIGINT,"
+               " category BIGINT, extra VARCHAR) WITH (connector='nexmark',"
+               " nexmark.table='auction', nexmark.max.events='{n}',"
+               " nexmark.chunk.size='{c}'{x})")
+Q1_MV = ("CREATE MATERIALIZED VIEW q1a AS SELECT bidder,"
+         " count(*) AS n, sum(price) AS dol, max(price) AS top"
+         " FROM bid GROUP BY bidder")
+Q3_MV = ("CREATE MATERIALIZED VIEW q3a AS SELECT b.auction, b.price,"
+         " a.seller, a.category FROM bid b JOIN auction a"
+         " ON b.auction = a.id WHERE b.price > 500")
+Q5_MV = """CREATE MATERIALIZED VIEW q5 AS
+SELECT AuctionBids.auction, AuctionBids.num FROM (
+    SELECT bid.auction, count(*) AS num, window_start AS starttime
+    FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+    GROUP BY window_start, bid.auction
+) AS AuctionBids
+JOIN (
+    SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+    FROM (
+        SELECT count(*) AS num, window_start AS starttime_c
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY bid.auction, window_start
+    ) AS CountBids
+    GROUP BY CountBids.starttime_c
+) AS MaxBids
+ON AuctionBids.starttime = MaxBids.starttime_c
+   AND AuctionBids.num >= MaxBids.maxn"""
+
+
+def _drive(db, n=N):
+    for _ in range(n // (64 * CHUNK) + 3):
+        db.tick()
+
+
+def _run(mv_sql, name, srcs, *, ingest, shards=1, capacity=512, n=N,
+         data_dir=None, aot=False, keep=False, src_opt=""):
+    db = Database(device=DeviceConfig(capacity=capacity,
+                                      host_ingest=ingest,
+                                      mesh_shards=shards,
+                                      aot_compile=aot),
+                  data_dir=data_dir)
+    for s in srcs:
+        db.run(s.format(n=n, c=CHUNK, x=src_opt))
+    db.run(mv_sql)
+    job = db._fused[name]
+    assert (job.ingest is not None) == (ingest or bool(src_opt))
+    _drive(db, n)
+    rows = db.query(f"SELECT * FROM {name}")
+    return (rows, job, db) if keep else (rows, job, None)
+
+
+@pytest.fixture(scope="module")
+def q1_ref():
+    """Device-datagen fused q1 — the established bit-identical family's
+    reference rows (host-executor parity of this path is covered by
+    test_fused_sql/test_mesh_fused)."""
+    rows, _, _ = _run(Q1_MV, "q1a", [BID_SRC], ingest=False)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# the surrogate feed itself
+# ---------------------------------------------------------------------------
+
+
+def test_surrogate_twin_bit_identical():
+    """connectors/nexmark.gen_surrogates must equal the device generator
+    value-for-value — the property the whole host-feed bit-identity
+    stands on."""
+    import jax.numpy as jnp
+    from risingwave_tpu.connectors.nexmark import (NexmarkConfig,
+                                                   gen_surrogates)
+    from risingwave_tpu.device.nexmark_gen import GenCfg, gen_table
+    ids = np.arange(0, 3000, dtype=np.int64)
+    for kd in ("", "zipf:1.5"):
+        cfg = NexmarkConfig(key_dist=kd)
+        g = GenCfg.from_config(cfg)
+        for table in ("person", "auction", "bid"):
+            host = gen_surrogates(cfg, table, ids)
+            dev = gen_table(g, table, jnp.asarray(ids))
+            for col, h in host.items():
+                assert h.dtype == np.int64
+                assert np.array_equal(h, np.asarray(dev[col])), \
+                    (kd, table, col)
+
+
+def test_to_jax_masked_nullable_columns():
+    """Arrow-seam satellite: nullable fixed-width columns cross with a
+    validity mask + sentinel fill; the bare path's error names the
+    remediation."""
+    from risingwave_tpu.core import dtypes as T
+    from risingwave_tpu.core.arrow import to_jax, to_jax_masked
+    from risingwave_tpu.core.chunk import Column
+    col = Column.from_list(T.INT64, [1, None, 3])
+    with pytest.raises(ValueError, match="to_jax_masked"):
+        to_jax(col)
+    vals, valid = to_jax_masked(col, sentinel=-1)
+    assert np.asarray(vals).tolist() == [1, -1, 3]
+    assert np.asarray(valid).tolist() == [True, False, True]
+    # all-valid fast path stays exact (and keeps the value buffer)
+    full = Column.from_list(T.INT64, [7, 8])
+    v2, m2 = to_jax_masked(full)
+    assert np.asarray(v2).tolist() == [7, 8] and np.asarray(m2).all()
+    with pytest.raises(ValueError, match="no device representation"):
+        to_jax_masked(Column.from_list(T.VARCHAR, ["x"]))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: host-fed vs device-datagen (and vs host executor)
+# ---------------------------------------------------------------------------
+
+
+def test_q1_host_fed_bit_identity(q1_ref):
+    got, job, db = _run(Q1_MV, "q1a", [BID_SRC], ingest=True, keep=True)
+    assert got == q1_ref, "host-fed q1 diverged from device datagen " \
+        "(bit-identity incl. row order)"
+    st = job.ingest.stats()
+    assert st["events"] == N and st["deferred"] == 0
+    # host-executor parity (order-insensitive, the repo-wide contract)
+    dbh = Database(device="off")
+    dbh.run(BID_SRC.format(n=N, c=CHUNK, x=""))
+    dbh.run(Q1_MV)
+    _drive(dbh)
+    assert sorted(got) == sorted(dbh.query("SELECT * FROM q1a"))
+    # the observability surfaces know the new node and the new phases
+    ea = db.run("EXPLAIN ANALYZE q1a")[0]
+    assert "IngestNode" in str(ea)
+    adm = db.query("SELECT * FROM rw_source_admission")
+    assert any(r[0] == "bid" for r in adm)
+    prof_rows = db.query("SELECT * FROM rw_epoch_profile")
+    assert prof_rows
+    for (_j, _s, _e, _sh, pack, h2d, disp, exch, sync, commit,
+         wall) in prof_rows:
+        assert pack + h2d + disp + exch + sync + commit \
+            <= wall * 1.001 + 0.05
+
+
+def test_q1_per_source_opt_in(q1_ref):
+    """WITH (nexmark.ingest='host') arms host feed for one source
+    without the global DeviceConfig knob."""
+    got, job, _ = _run(Q1_MV, "q1a", [BID_SRC], ingest=False,
+                       src_opt=", nexmark.ingest='host'")
+    assert job.ingest is not None
+    assert got == q1_ref
+
+
+def test_q3_multi_source_multiplex(q1_ref):
+    """Two independent sources concatenate into one fused dispatch per
+    epoch; per-source provenance balances exactly."""
+    ref, _, _ = _run(Q3_MV, "q3a", [BID_SRC, AUCTION_SRC], ingest=False)
+    got, job, _ = _run(Q3_MV, "q3a", [BID_SRC, AUCTION_SRC], ingest=True)
+    assert got == ref
+    st = job.ingest.stats()
+    assert set(st["sources"]) == {"bid", "auction"}
+    assert all(v > 0 for v in st["sources"].values())
+    # rows in == rows dispatched: per-source offered rows equal the
+    # ingest nodes' dispatched rows_out, summed over the run
+    dispatched = 0
+    for i, node in enumerate(job.program.nodes):
+        if getattr(node, "takes_feed", False):
+            dispatched += job.program.node_stats(
+                i, job._stat_totals).get("rows_out", 0)
+    assert dispatched == sum(st["sources"].values())
+
+
+def test_q5_host_fed_bit_identity():
+    ref, _, _ = _run(Q5_MV, "q5", [BID_SRC], ingest=False)
+    got, _, _ = _run(Q5_MV, "q5", [BID_SRC], ingest=True)
+    assert got == ref
+
+
+@pytest.mark.mesh
+def test_mesh_host_fed_bit_identity(q1_ref):
+    """8-shard host-fed == 1-shard device-datagen, incl. row order —
+    per-shard H2D placement composing with the in-program exchange."""
+    got, job, _ = _run(Q1_MV, "q1a", [BID_SRC], ingest=True, shards=8)
+    assert job.mesh_shards == 8
+    assert got == q1_ref
+    ref3, _, _ = _run(Q3_MV, "q3a", [BID_SRC, AUCTION_SRC], ingest=False)
+    got3, _, _ = _run(Q3_MV, "q3a", [BID_SRC, AUCTION_SRC], ingest=True,
+                      shards=8)
+    assert got3 == ref3
+
+
+@pytest.mark.mesh
+def test_per_shard_feed_placement(mesh8):
+    """The staged device buffers are [n_shards, cap] arrays carrying the
+    SAME vnode-block NamedSharding as every state array, each shard
+    holding its contiguous event block — ingest lands directly on its
+    chip, no post-transfer scatter."""
+    from risingwave_tpu.device.ingest import feed_capacity
+    from risingwave_tpu.parallel.mesh import state_sharding
+    db = Database(device=DeviceConfig(capacity=512, host_ingest=True,
+                                      mesh_shards=8))
+    db.run(BID_SRC.format(n=N, c=CHUNK, x=""))
+    db.run(Q1_MV)
+    job = db._fused["q1a"]
+    w, _p, _h = job.ingest.take(0)
+    ee = job.program.epoch_events
+    cap = feed_capacity(ee, 8)
+    sh = state_sharding(job.program.mesh)
+    (idx, src), = job.ingest.sources
+    cnt, pk = w.feeds[idx][0], w.feeds[idx][1]
+    assert pk.shape == (8, cap)
+    for leaf in w.feeds[idx]:
+        assert leaf.sharding == sh
+    counts = np.asarray(cnt)
+    ids, _cols = src.rows_for(0, ee)
+    for s in range(8):
+        block = ids[(ids >= s * cap) & (ids < (s + 1) * cap)]
+        assert counts[s] == len(block)
+        # the shard's addressable data IS its event block (one device)
+        shard = next(x for x in pk.addressable_shards
+                     if x.index[0] == slice(s, s + 1, None)
+                     or x.index[0] == s)
+        local = np.asarray(shard.data).reshape(-1)[:counts[s]]
+        assert np.array_equal(local, block)
+    # the manually taken window replays idempotently: the job's own
+    # dispatch re-serves it from retention, results unharmed
+    _drive(db)
+    assert len(db.query("SELECT * FROM q1a")) > 0
+
+
+# ---------------------------------------------------------------------------
+# double buffering / profiler evidence
+# ---------------------------------------------------------------------------
+
+
+def test_double_buffer_overlap_and_phases():
+    """With the staging thread warm, H2D hides under dispatch: the
+    stager's total transfer wall stays below the job's dispatch wall,
+    and most windows were prefetched off the dispatch thread. A
+    stretched cadence (several epochs per barrier) gives the prefetcher
+    a dense take sequence to overlap against."""
+    db = Database(device=DeviceConfig(capacity=512, host_ingest=True))
+    db.run(BID_SRC.format(n=4 * N, c=CHUNK, x=""))
+    db.run(Q1_MV)
+    job = db._fused["q1a"]
+    job.cadence_stretch = 4
+    _drive(db, 4 * N)
+    st = job.ingest.stats()
+    assert st["prefetched"] > 0, "the staging thread never got ahead"
+    disp = job.profiler.totals.get("dispatch", 0.0)
+    assert st["h2d_s"] < disp, (st, job.profiler.totals)
+    # phases stayed disjoint + within wall (pack/h2d included)
+    for r in job.profiler.rows():
+        pack, h2d, dispatch, exch, sync, commit, wall = r[4:]
+        assert pack + h2d + dispatch + exch + sync + commit \
+            <= wall * 1.001 + 0.05
+
+
+# ---------------------------------------------------------------------------
+# admission: throttle / defer exactness
+# ---------------------------------------------------------------------------
+
+
+def test_admission_throttle_defer_exact(q1_ref):
+    """Throttled (smaller windows) and deferred (zero-token) epochs
+    re-time ingestion without changing the answer; a 10x-offered burst
+    phase (stretch tokens) drains exactly once admission recovers."""
+    from risingwave_tpu.utils.overload import AdmissionBucket
+    db = Database(device=DeviceConfig(capacity=512, host_ingest=True))
+    db.run(BID_SRC.format(n=N, c=CHUNK, x=""))
+    db.run(Q1_MV)
+    job = db._fused["q1a"]
+    # detached bucket: the overload manager must not re-rate it back
+    bucket = AdmissionBucket("bid")
+    job.ingest.buckets["bid"] = bucket
+    # phase 1: throttled to quarter windows
+    bucket.factor = 0.25
+    for _ in range(3):
+        db.tick()
+    assert any(ev < job.program.epoch_events
+               for _, ev in job.ingest.recent_windows)
+    # phase 2: starved — counter must not move
+    class Starved(AdmissionBucket):
+        def epoch_refill(self, mult=1):
+            self.tokens = 0
+    job.ingest.buckets["bid"] = Starved("bid")
+    # drain the window the warm pipeline already admitted, then freeze
+    db.tick()
+    c0 = job.counter
+    db.tick()
+    assert job.counter == c0
+    assert job.ingest.stats()["deferred"] >= 1
+    # phase 3: burst recovery — 10x the per-barrier budget until drained
+    bucket.factor = 1.0
+    job.ingest.buckets["bid"] = bucket
+    job.cadence_stretch = 10
+    _drive(db)
+    job.cadence_stretch = 1
+    _drive(db)
+    assert db.query("SELECT * FROM q1a") == q1_ref
+    assert bucket.lag >= 0
+
+
+def test_zero_fresh_compiles_across_batch_sizes():
+    """Varying admitted window sizes (throttle sweep) all hit the ONE
+    pre-lowered aval signature: compile-service counters stay flat."""
+    from risingwave_tpu.device.compile_service import get_service
+    from risingwave_tpu.utils.overload import AdmissionBucket
+    db = Database(device=DeviceConfig(capacity=1 << 14, host_ingest=True,
+                                      aot_compile=True))
+    db.run(BID_SRC.format(n=8 * N, c=CHUNK, x=""))
+    db.run(Q1_MV)
+    job = db._fused["q1a"]
+    svc = get_service()
+    for _ in range(3):
+        db.tick()
+    assert svc.wait_idle(180)
+    before = svc.summary()["compiles"]
+    bucket = AdmissionBucket("bid")
+    job.ingest.buckets["bid"] = bucket
+    for f in (0.5, 0.25, 0.8, 1.0):
+        bucket.factor = f
+        db.tick()
+        db.tick()
+    job.sync()
+    assert svc.wait_idle(60)
+    assert len({ev for _, ev in job.ingest.recent_windows}) >= 3, \
+        "throttle sweep failed to vary the admitted window size"
+    assert svc.summary()["compiles"] == before, \
+        "a varying poll batch size must never trigger a fresh compile"
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance: staged-window replay
+# ---------------------------------------------------------------------------
+
+
+def test_staged_window_inplace_recovery(q1_ref):
+    """A device fault mid-window heals in place: the crash window's
+    staged-but-uncommitted epochs replay from the epoch event log via
+    the stager's retained host arrays — bit-identical MV."""
+    from risingwave_tpu.utils import failpoint as fp
+    fp.arm("fused.dispatch", prob=1.0, seed=11, max_fires=2)
+    try:
+        got, job, _ = _run(Q1_MV, "q1a", [BID_SRC], ingest=True)
+    finally:
+        fp.reset()
+    assert job.recoveries >= 1
+    assert got == q1_ref
+
+
+def test_growth_replay_from_retained_windows(q1_ref):
+    """Capacity overflow replays the checkpoint window through the
+    stager's retained feeds — same rows, same boundaries, same MV."""
+    got, job, _ = _run(Q1_MV, "q1a", [BID_SRC], ingest=True, capacity=64)
+    assert job.growth_replays >= 1
+    assert got == q1_ref
+
+
+def test_restart_recovery(tmp_path, q1_ref):
+    d = str(tmp_path / "data")
+    _, job, db = _run(Q1_MV, "q1a", [BID_SRC], ingest=True, keep=True,
+                      data_dir=d)
+    committed = job.committed
+    assert committed >= N
+    del db, job
+    db2 = Database(data_dir=d,
+                   device=DeviceConfig(capacity=512, host_ingest=True))
+    job2 = db2._fused["q1a"]
+    assert job2.committed == committed
+    assert db2.query("SELECT * FROM q1a") == q1_ref
+
+
+def test_mixed_opt_in_promotes_whole_job(q1_ref):
+    """One host-opted source promotes the job's other sources to ingest
+    too (shared event clock: a mixed job would double-ingest datagen
+    rows the moment admission shrank a staged window) — and a throttled
+    run of the promoted job stays exact."""
+    from risingwave_tpu.utils.overload import AdmissionBucket
+    db = Database(device=DeviceConfig(capacity=512))
+    db.run(BID_SRC.format(n=N, c=CHUNK, x=", nexmark.ingest='host'"))
+    db.run(AUCTION_SRC.format(n=N, c=CHUNK, x=""))   # no opt-in
+    db.run(Q3_MV)
+    job = db._fused["q3a"]
+    assert job.ingest is not None
+    from risingwave_tpu.device.fused import IngestNode, SourceNode
+    flat = [n for nd in job.program.nodes
+            for n in (getattr(nd, "chain", None) or [nd])]
+    assert not any(isinstance(n, SourceNode) for n in flat)
+    assert sum(isinstance(n, IngestNode) for n in flat) == 2
+    # throttle mid-run: windows shrink, both sources stay in lockstep
+    b = AdmissionBucket("bid")
+    b.factor = 0.5
+    job.ingest.buckets["bid"] = b
+    _drive(db)
+    ref, _, _ = _run(Q3_MV, "q3a", [BID_SRC, AUCTION_SRC], ingest=False)
+    assert db.query("SELECT * FROM q3a") == ref

@@ -61,10 +61,12 @@ def test_epoch_profile_rows_and_phase_sums(tmp_path):
     db = _fused_db(str(tmp_path / "d"))
     rows = db.query("SELECT * FROM rw_epoch_profile")
     assert rows, "a fused run must produce epoch profile rows"
-    for job, seq, events, shards, hp, disp, exch, sync, commit, wall in rows:
+    for job, seq, events, shards, hp, h2d, disp, exch, sync, commit, \
+            wall in rows:
         assert job == "q4"
         assert shards == 1 and exch == 0.0   # single-chip job
-        phases = hp + disp + exch + sync + commit
+        assert h2d == 0.0                    # no staged ingest transfers
+        phases = hp + h2d + disp + exch + sync + commit
         # phase splits must account for the measured wall (the acceptance
         # bound is 10%; sub-ms epochs get an epsilon for timer noise)
         assert phases <= wall * 1.001 + 0.05
@@ -112,7 +114,7 @@ def test_profile_file_and_risectl(tmp_path, capsys):
     assert ctl.main(["profile", "q4", "--data-dir", d, "--top", "3"]) == 0
     out = json.loads(capsys.readouterr().out)
     assert out["q4"]["epochs"] >= 1
-    assert set(out["q4"]["phase_ms"]) >= {"host_pack", "dispatch",
+    assert set(out["q4"]["phase_ms"]) >= {"pack", "dispatch",
                                           "device_sync", "commit"}
     assert out["q4"]["slowest_epochs"]
     assert len(out["q4"]["slowest_epochs"]) <= 3

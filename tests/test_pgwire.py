@@ -423,3 +423,123 @@ def test_portal_row_limit_and_suspend(server):
     assert vals == ["3", "4", "5"], vals
     assert any(t == b"s" for t, _ in msgs)       # second fetch suspended
     assert any(t == b"C" for t, _ in msgs)       # final completed
+
+
+# ---------------------------------------------------------------------------
+# COPY <table> FROM STDIN (ISSUE 15: the firehose entry point)
+# ---------------------------------------------------------------------------
+
+
+def _copy(c, sql, chunks, done=True):
+    c.send(b"Q", sql.encode() + b"\0")
+    t, b = c.read_msg()
+    if t != b"G":
+        # refusal path: drain to ready, hand back the error
+        msgs = [(t, b)] + c.read_until(b"Z")
+        return None, msgs
+    for ch in chunks:
+        c.send(b"d", ch)
+    c.send(b"c" if done else b"f", b"" if done else b"stop\0")
+    return (t, b), c.read_until(b"Z")
+
+
+def test_copy_from_stdin_text_and_csv(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE cp (a BIGINT, b VARCHAR, f DOUBLE PRECISION)")
+    g, msgs = _copy(c, "COPY cp FROM STDIN",
+                    [b"1\tone\t1.5\n2\t\\N\t2.5\n", b"3\tthr", b"ee\t3.5\n"])
+    assert g is not None and g[1][0:1] == b"\x00"   # text-format response
+    assert any(t == b"C" and b.startswith(b"COPY 3") for t, b in msgs)
+    g, msgs = _copy(c, "COPY cp FROM STDIN WITH (FORMAT csv)",
+                    [b'4,"fo,ur",4.5\n5,,5.5\n'])
+    assert g is not None
+    assert any(t == b"C" and b.startswith(b"COPY 2") for t, b in msgs)
+    c.query("FLUSH")
+    rows = sorted(c.rows(c.query("SELECT a, b, f FROM cp")))
+    assert rows == [("1", "one", "1.5"), ("2", None, "2.5"),
+                    ("3", "three", "3.5"), ("4", "fo,ur", "4.5"),
+                    ("5", None, "5.5")]
+
+
+def test_copy_unsupported_format_sqlstate_0a000(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE cp2 (a BIGINT)")
+    for sql in ("COPY cp2 FROM STDIN (FORMAT binary)",
+                "COPY cp2 FROM STDIN WITH (FORMAT parquet)"):
+        g, msgs = _copy(c, sql, [])
+        assert g is None, "unsupported format must refuse BEFORE CopyIn"
+        err = next(b for t, b in msgs if t == b"E")
+        assert b"0A000" in err
+    # connection stays usable after the refusal
+    assert any(t == b"C" for t, _ in c.query("SELECT 1"))
+
+
+def test_copy_fail_and_bad_rows(server):
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE cp3 (a BIGINT, b VARCHAR)")
+    # client aborts: CopyFail -> ErrorResponse, connection usable
+    g, msgs = _copy(c, "COPY cp3 FROM STDIN", [b"1\tx\n"], done=False)
+    assert g is not None and any(t == b"E" for t, _ in msgs)
+    # malformed rows: error after the stream, not a hang
+    g, msgs = _copy(c, "COPY cp3 FROM STDIN", [b"1\tonly\n1\ttoo\tmany\n"])
+    assert g is not None and any(t == b"E" for t, _ in msgs)
+    assert any(t == b"C" for t, _ in c.query("SELECT 1"))
+
+
+def test_copy_rides_the_admission_gate(server):
+    """The firehose enters through the same per-source AdmissionBucket
+    as connector sources: admitted rows are accounted, and on the
+    shedding rung unadmitted batches drop with a durable audit row."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE fh (a BIGINT)")
+    g, msgs = _copy(c, "COPY fh FROM STDIN", [b"1\n2\n3\n"])
+    assert any(t == b"C" and b.startswith(b"COPY 3") for t, b in msgs)
+    db = server.db
+    bucket = db._overload.bucket("fh")
+    assert bucket.admitted_rows == 3 and bucket.lag == 0
+    # force the shedding rung: the next batch drops, audited
+    bucket.state = "shedding"
+    bucket.shed_enabled = True
+    bucket.tokens = 0
+    bucket._copy_epoch = db.injector.epoch.curr     # pin: no refill
+    verdict, n = db.copy_chunk("fh", "4\n5\n")
+    assert verdict == "shed" and n == 2
+    assert bucket.shed_rows == 2
+    assert any(r[1] == "fh" for r in db.query("SELECT * FROM rw_shed_log"))
+
+
+def test_copy_escapes_and_quoting_edge_cases(server):
+    """Review-hardening cases: escaped backslash before t/n/r in text
+    format, quoted-empty vs unquoted-empty in csv, and embedded
+    delimiters/newlines/doubled quotes inside quoted csv fields —
+    including a CopyData boundary landing INSIDE a quoted field."""
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE ce (a BIGINT, s VARCHAR)")
+    # text: '\\temp' is escaped-backslash + 'temp', NOT backslash+TAB
+    g, msgs = _copy(c, "COPY ce FROM STDIN", [b"1\t\\\\temp\n"])
+    assert any(t == b"C" and b.startswith(b"COPY 1") for t, b in msgs)
+    # csv: quoted empty = '', unquoted empty = NULL; embedded comma,
+    # newline and doubled quote inside quotes; the second CopyData
+    # frame starts mid-quoted-field
+    g, msgs = _copy(c, "COPY ce FROM STDIN WITH (FORMAT csv)",
+                    [b'2,""\n3,\n4,"x,y"\n5,"l1\nl2"\n6,"he said ',
+                     b'""hi"""\n'])
+    assert any(t == b"C" and b.startswith(b"COPY 5") for t, b in msgs)
+    # the '\\.' end-of-data marker is recognized in csv too
+    g, msgs = _copy(c, "COPY ce FROM STDIN WITH (FORMAT csv)",
+                    [b"7,last\n\\.\n"])
+    assert any(t == b"C" and b.startswith(b"COPY 1") for t, b in msgs)
+    # multi-statement COPY refuses clearly (0A000), connection usable
+    g, msgs = _copy(c, "COPY ce FROM STDIN; SELECT 1", [])
+    assert g is None
+    err = next(b for t, b in msgs if t == b"E")
+    assert b"0A000" in err and b"only statement" in err
+    c.query("FLUSH")
+    rows = dict(c.rows(c.query("SELECT a, s FROM ce")))
+    assert rows == {"1": "\\temp", "2": "", "3": None, "4": "x,y",
+                    "5": "l1\nl2", "6": 'he said "hi"', "7": "last"}, rows
