@@ -1131,6 +1131,95 @@ def _overload_arms(n_rows, out):
         }
 
 
+def stage_tiering(n_events):
+    """Workload: tiered state beyond HBM (ISSUE 16) — a q8-style
+    unbounded-key GROUP BY (nexmark auction ids keep arriving for the
+    life of the stream) run at a device capacity clamped BELOW the
+    final distinct-key count, tiering off vs on at the SAME clamp.
+
+    The untiered arm has to grow (capacity-doubling replays); the
+    tiered arm demotes cold groups to host memory off the commit phase
+    and touch-promotes them back when their keys reappear (Xor8
+    negative caches keep absent-key windows off the promotion path).
+    Records eps for both arms, the demotion/promotion counters, the
+    negative-cache hit rate, the HBM budget-utilization high-water and
+    freshness p50/p99 — and asserts the MVs bit-identical."""
+    import time as _t
+    from risingwave_tpu.config import DeviceConfig
+    from risingwave_tpu.sql import Database
+    from risingwave_tpu.utils.metrics import REGISTRY
+    # clamp ~half the run's distinct auctions (974 per 16384 bids)
+    cap = 1 << max(10, int(0.03 * n_events).bit_length() - 1)
+    chunk = max(512, n_events // (64 * 24))
+    os.environ.setdefault("RW_TIER_HIGH_WATER", "0.35")
+    os.environ.setdefault("RW_TIER_LOW_WATER", "0.15")
+    # both demotion-inert-by-design shapes must stay out of this stage
+    # (documented residuals): min/max fold through a minput multiset,
+    # and a pre-combined agg's input lineage is the combiner, not an
+    # ingest source — so q4 minus max(price), pre-combine off BOTH arms
+    os.environ["RW_AGG_PRECOMBINE"] = "0"
+    mv = ("CREATE MATERIALIZED VIEW qt AS SELECT auction,"
+          " count(*) AS c, sum(price) AS s FROM bid GROUP BY auction")
+    out = {"events": n_events, "capacity": cap}
+    rows_by_arm = {}
+    for arm, tier in (("untiered", "0"), ("tiered", "1")):
+        os.environ["RW_STATE_TIERING"] = tier
+        os.environ["RW_HOST_INGEST"] = tier
+        db = Database(device=DeviceConfig(capacity=cap,
+                                          hbm_budget_mb=256,
+                                          mv_persist_every=
+                                          MV_PERSIST_EVERY))
+        db.run(BID_SRC.format(n=n_events, c=chunk))
+        db.run(mv)
+        dt = drive(db, n_events, chunk=chunk)
+        db.tick()                       # harvest the last demote pull
+        job = db._fused["qt"]
+        rows_by_arm[arm] = db.query("SELECT * FROM qt")
+        rec = {
+            "eps": round(n_events / dt),
+            "groups": len(rows_by_arm[arm]),
+            "growth_replays": job.growth_replays,
+            "capacity_final": job.cap_report(),
+            "freshness": _freshness_stats(db),
+        }
+        if tier == "1":
+            tm = job.tiering
+            probes = tm.counters["filter_probes"]
+            rec["tier"] = {
+                "demotions": tm.counters["demotions"],
+                "promotions": tm.counters["promotions"],
+                "demote_events": tm.counters["demote_events"],
+                "cold_rows": sum(len(s) for s in tm.stores.values()),
+                "filter_probes": probes,
+                "filter_hit_rate": round(
+                    tm.counters["filter_hits"] / probes, 4)
+                if probes else None,
+                "filter_fallbacks": tm.counters["filter_fallbacks"],
+            }
+            util = [float(line.rsplit(" ", 1)[1])
+                    for line in REGISTRY.expose().splitlines()
+                    if line.startswith("rw_hbm_budget_utilization")]
+            rec["hbm_budget_utilization_high_water"] = (
+                round(max(util), 6) if util else None)
+            rec["profile_tier_phase_s"] = {
+                "demote_d2h": round(
+                    job.profiler.totals.get("demote_d2h", 0.0), 3),
+                "promote_h2d": round(
+                    job.profiler.totals.get("promote_h2d", 0.0), 3),
+            }
+        out[arm] = rec
+    assert rows_by_arm["tiered"] == rows_by_arm["untiered"], \
+        "tiered MV must be bit-identical to untiered"
+    out["mv_bit_identical"] = True
+    out["note"] = ("same capacity clamp both arms; the untiered arm "
+                   "pays growth replays, the tiered arm demotes cold "
+                   "groups to host ColdStores (commit-phase async D2H) "
+                   "and touch-promotes on reappearance — Xor8 negative "
+                   "caches filter promotion probes; MVs asserted "
+                   "bit-identical incl. row order")
+    return {"tiering": out}
+
+
 # ---------------------------------------------------------------------------
 # the un-killable harness
 # ---------------------------------------------------------------------------
@@ -1148,6 +1237,7 @@ _STAGES = {
     "chaos_mttr": stage_chaos_mttr,
     "overload": stage_overload,
     "ingest": stage_ingest,
+    "tiering": stage_tiering,
 }
 
 
@@ -1295,7 +1385,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r15.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r16.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -1325,6 +1415,7 @@ def main():
         # >= 4 staged windows at INGEST_CHUNK so the double buffer has
         # something to overlap even at smoke scale
         h.run_stage("ingest", (1_048_576, 20_000, 4), 180)
+        h.run_stage("tiering", (262_144,), 150)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
         # compile of a fused epoch program set is ~200-400s on the remote-
@@ -1381,6 +1472,12 @@ def main():
                                       500_000, 16), 900):
             h.run_stage("ingest", (Q4_SQL_EVENTS[0] // 2,
                                    500_000, 16), 600, " — retry (warmer)")
+        # tiered state beyond HBM (ISSUE 16): unbounded-key agg at a
+        # clamped capacity, untiered (growth replays) vs tiered
+        # (demote/promote), MVs asserted bit-identical
+        if not h.run_stage("tiering", (Q4_SQL_EVENTS[0] // 4,), 600):
+            h.run_stage("tiering", (Q4_SQL_EVENTS[0] // 4,), 400,
+                        " — retry (warmer)")
     h.emit()
 
 

@@ -132,6 +132,16 @@ class DeviceConfig:
     # bit-identical. RW_VNODE_REBALANCE=0/1 overrides.
     vnode_rebalance: bool = True
     rebalance_threshold: float = 2.0
+    # tiered state beyond HBM (device/tiering.py): keyed fused state
+    # (agg groups, join rows, the terminal MV's rows) demotes its
+    # coldest keys to per-shard host stores when occupancy crosses a
+    # high-water fraction of capacity, and promotes them back — probed
+    # through an Xor8 negative cache — the moment a window touches them
+    # again, so results stay bit-identical to the untiered run. Arms a
+    # last-touched-epoch column in the traced step (part of the plan-
+    # shape hash, like skew_stats). RW_STATE_TIERING=0/1 overrides;
+    # RW_TIER_HIGH_WATER / RW_TIER_LOW_WATER tune the marks.
+    state_tiering: bool = True
 
 
 @dataclass

@@ -49,6 +49,14 @@ Commands:
                               counters and current per-node capacities
                               (JSON) — diagnose capacity-bound runs
                               without reading bench logs
+    tiering [JOB]             hot/cold state-tier report per fused job:
+                              per-node resident vs cold row counts,
+                              Xor8 negative-cache liveness, and the
+                              demotion / promotion / filter-probe
+                              counters (the `rw_state_tiering` system
+                              table, offline) — answers "is state
+                              spilling, and is the filter earning its
+                              keep"
     compile-status [JOB]      per-signature AOT compile state of every
                               fused job (pending / ready / cached /
                               failed, with capacity bucket and compile
@@ -377,6 +385,39 @@ def cmd_fused_stats(args) -> int:
     return 0
 
 
+def cmd_tiering(args) -> int:
+    """Hot/cold state-tier report of every fused job (or one JOB): the
+    `rw_state_tiering` system-table rows, printed as a table. Opens a
+    full Database — recovery rebuilds both tiers (device residents +
+    host cold stores) from the journal, so the numbers reflect what a
+    restarted job would actually hold."""
+    from ..sql import Database
+    db = Database(data_dir=args.data_dir, device="auto")
+    jobs = {name: job for name, job in db._fused.items()
+            if args.job is None or name == args.job}
+    if not jobs:
+        print("no fused device jobs in this data directory"
+              if args.job is None else f"no fused job {args.job!r}")
+        return 0 if args.job is None else 1
+    cols = ("node", "type", "resident", "cold", "filter", "promotable",
+            "demotions", "promotions", "demote_ev", "probes", "hits",
+            "fallbacks")
+    for name, job in sorted(jobs.items()):
+        rows = job.tiering_report()
+        if not rows:
+            print(f"{name}: state tiering off (or no tierable nodes)")
+            continue
+        print(name)
+        print("  " + "  ".join(f"{c:>9s}" for c in cols))
+        for r in rows:
+            cells = [str(r[0]), str(r[1]),
+                     str(r[2]), str(r[3]),
+                     "live" if r[4] else "off",
+                     "yes" if r[5] else "no"] + [str(v) for v in r[6:]]
+            print("  " + "  ".join(f"{c:>9s}" for c in cells))
+    return 0
+
+
 def cmd_skew(args) -> int:
     """Key-skew summary of every fused job (`rw_key_skew`, offline):
     per-node skew_ratio + per-shard load under the current routing
@@ -621,6 +662,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("--json", action="store_true",
                     help="raw snapshot JSON instead of the summary")
     sp.set_defaults(fn=cmd_skew)
+    sp = sub.add_parser("tiering")
+    sp.add_argument("job", nargs="?", default=None)
+    sp.add_argument("--data-dir", required=True)
+    sp.set_defaults(fn=cmd_tiering)
     sp = sub.add_parser("compile-status")
     sp.add_argument("job", nargs="?", default=None)
     sp.add_argument("--data-dir", required=True)

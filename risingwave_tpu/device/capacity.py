@@ -38,6 +38,21 @@ UNBOUNDED_STEP = 4
 EPOCH_HEADROOM = 2.0
 
 
+def tier_waters() -> tuple:
+    """(high, low) occupancy-fraction water marks for the state tier
+    (device/tiering.py). Demotion ARMS when a node's live count crosses
+    high * capacity and drains cold keys down to low * capacity — the
+    gap is what keeps the capacity predictor from ever needing to grow
+    past the HBM budget, because `needed` stays strictly below the
+    current bucket between demotion ticks. Env-overridable per run."""
+    import os
+    high = float(os.environ.get("RW_TIER_HIGH_WATER", "0.85"))
+    low = float(os.environ.get("RW_TIER_LOW_WATER", "0.60"))
+    high = min(max(high, 0.05), 0.99)
+    low = min(max(low, 0.01), high)
+    return high, low
+
+
 def bucket(n: int, lo: int = 256) -> int:
     """Smallest pow2 >= n, floored at lo (pow2 buckets bound the number of
     distinct traced shapes per node)."""

@@ -567,6 +567,15 @@ def try_fuse(execu, ns, device_cfg, name: str,
             # (skew extends the traced step — see AggNode._sig)
             for node in f.nodes:
                 node.enable_skew()
+        tier_on = _env_bool("RW_STATE_TIERING",
+                            getattr(device_cfg, "state_tiering", True))
+        if tier_on:
+            # arm the tiered-state recency column on every keyed
+            # stateful node — after skew (stat order), before the
+            # exchange (the spliced "exch" stat stays last) and before
+            # the plan hash (the touch column extends the traced step)
+            for node in f.nodes:
+                node.enable_tiering()
         mesh = _fused_mesh(device_cfg, ee)
         if mesh is not None:
             # arm the declarative exchange stages: every node whose
@@ -629,6 +638,47 @@ def try_fuse(execu, ns, device_cfg, name: str,
                                  live=f.nodes[idx].live)))
             ingest = HostIngest(srcs, ee, mesh=mesh,
                                 max_events=f.max_events)
+        tier_plans = []
+        if tier_on:
+            # demotion plans: one per keyed stateful node, with
+            # promotion-candidate recipes derived by walking the key
+            # columns' lineage back to an ingest source's shipped host
+            # columns. A node whose lineage can't be traced (device
+            # datagen, computed keys, pre-combined input, multiset
+            # aggs) keeps recency stats but never demotes — safe.
+            from .tiering import TierPlan, derive_recipe
+            source_ords = {idx: k for k, (idx, _s)
+                           in enumerate(ingest.sources)} \
+                if ingest is not None else {}
+            mv_of = {}
+            for j, node in enumerate(program.nodes):
+                if isinstance(node, MVKeyedNode):
+                    mv_of[node.inputs[0]] = j
+            for j, node in enumerate(program.nodes):
+                if isinstance(node, AggNode):
+                    recipes = ()
+                    if not node.spec.minputs and not node.combined:
+                        r = derive_recipe(
+                            program.nodes, node.inputs[0],
+                            node.group_idx, node.pack.fields,
+                            source_ords)
+                        if r is not None:
+                            recipes = (r,)
+                    tier_plans.append(TierPlan(j, "agg", recipes,
+                                               mv_of.get(j)))
+                elif isinstance(node, JoinNode):
+                    rl = derive_recipe(program.nodes, node.inputs[0],
+                                       node.l_keys, node.pack.fields,
+                                       source_ords)
+                    rr = derive_recipe(program.nodes, node.inputs[1],
+                                       node.r_keys, node.pack.fields,
+                                       source_ords)
+                    # promotion must see EVERY window key that can
+                    # touch either side — a one-sided lineage can't
+                    # prove that, so such a join demotes nothing
+                    recipes = (rl, rr) \
+                        if rl is not None and rr is not None else ()
+                    tier_plans.append(TierPlan(j, "join", recipes))
         ph = plan_shape_hash(program.nodes, program.epoch_events,
                              mesh.devices.size if mesh is not None else 1)
         hints = (cap_registry or {}).get(ph) or {}
@@ -668,7 +718,9 @@ def try_fuse(execu, ns, device_cfg, name: str,
                         hot_key_rep=hot_on and skew_on,
                         hot_key_frac=getattr(device_cfg,
                                              "hot_key_frac", 0.125),
-                        ingest=ingest)
+                        ingest=ingest,
+                        state_tiering=tier_on,
+                        tier_plans=tuple(tier_plans))
     except FuseReject:
         return None
 

@@ -400,6 +400,11 @@ class Node:
     # node's exchanges carry pks (joins): makes the node a candidate for
     # the checkpoint-time hot-key policy (no-op until hot_keys lands)
     hotrep: bool = False
+    # state tiering (device/tiering.py): keyed nodes carry a
+    # last-touched-epoch column beside their key table and report
+    # residency/coldness scalars on the stats vector when armed
+    # (enable_tiering). False everywhere else.
+    tier: bool = False
 
     def init_state(self):
         return None
@@ -409,6 +414,13 @@ class Node:
         BEFORE the program is built: the skew scalars extend both the
         stat layout and the traced step, so arming is part of the
         node's structural signature). No-op for un-keyed nodes."""
+
+    def enable_tiering(self) -> None:
+        """Arm recency tracking for this node (planner-called, once,
+        BEFORE the program is built — the touch column wraps the state
+        pytree and two scalars extend the stat layout, so arming is
+        part of the structural signature, exactly like enable_skew).
+        No-op for un-keyed nodes."""
 
     # ---- mesh sharding (declarative; device/shard_exec.py executes) ----
     def shard_spec(self) -> ShardSpec:
@@ -955,6 +967,16 @@ class AggNode(Node):
             self.skew = True
             self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
 
+    def enable_tiering(self):
+        # tres = live groups, tcold = live groups untouched >= TIER_TTL
+        # epochs. MAX-accumulated (not in stat_sums) so the job sees the
+        # window high-water; pmax across shards would double-count
+        # nothing (per-shard tables are disjoint) but the coordinator
+        # reads residency from the D2H pull, so max is the right fold.
+        if not self.tier:
+            self.tier = True
+            self.stat_names = tuple(self.stat_names) + ("tres", "tcold")
+
     def enable_precombine(self) -> None:
         """Arm the pre-combined input mode (planner-called, once, BEFORE
         the program is built — the combined layout changes the traced
@@ -990,8 +1012,15 @@ class AggNode(Node):
     def init_state(self):
         from .agg_step import DeviceAggState
         from .minput import ms_make
-        return DeviceAggState(self.spec.make_state(self.capacity),
-                              tuple(ms_make(c) for c in self.ms_caps))
+        state = DeviceAggState(self.spec.make_state(self.capacity),
+                               tuple(ms_make(c) for c in self.ms_caps))
+        if self.tier:
+            import jax.numpy as jnp
+            from .tiering import TieredState
+            return TieredState(state,
+                               jnp.zeros((self.capacity,), jnp.int64),
+                               jnp.zeros((), jnp.int64))
+        return state
 
     def cap_current(self):
         caps = {"main": self.capacity}
@@ -1046,9 +1075,15 @@ class AggNode(Node):
             self.exch = max(self.exch, caps.get("exch", 0))
 
     def cap_resize(self, state, caps):
+        import jax.numpy as jnp
         from .agg_step import DeviceAggState
         from .minput import ms_grow
         from .sorted_state import grow_state
+        tstate = None
+        if self.tier:
+            from .tiering import TieredState
+            tstate = state
+            state = tstate.inner
         if self.exch is not None and caps.get("exch", 0) > self.exch:
             self.exch = caps["exch"]   # jit-static: _mut_sig salts the trace
         main = state.main
@@ -1061,7 +1096,19 @@ class AggNode(Node):
             if c > ms[i].capacity:
                 self.ms_caps[i] = c
                 ms[i] = ms_grow(ms[i], c)
-        return DeviceAggState(main, tuple(ms))
+        out = DeviceAggState(main, tuple(ms))
+        if tstate is None:
+            return out
+        # touch rows ride positionally with the key table: grow_state
+        # tail-pads keys with EMPTY_KEY, so zero-padding the touch tail
+        # keeps the alignment (EMPTY rows carry touch 0 by invariant)
+        from .tiering import TieredState
+        touch = tstate.touch
+        pad = main.capacity - touch.shape[0]
+        if pad > 0:
+            touch = jnp.concatenate(
+                [touch, jnp.zeros((pad,), jnp.int64)])
+        return TieredState(out, touch, tstate.tick)
 
     def _call_outputs(self, ch, which: str):
         """Per-call (array, null) at the touched keys, old or new."""
@@ -1094,7 +1141,13 @@ class AggNode(Node):
         # twin. Appended conditionally so un-armed signatures — and the
         # plan hashes / manifests built from them — stay byte-identical
         # to previous releases.
-        return sig + ("skew",) if self.skew else sig
+        if self.skew:
+            sig = sig + ("skew",)
+        # same contract for tiering: the touch column wraps the state
+        # pytree and two stats extend the layout
+        if self.tier:
+            sig = sig + ("tier",)
+        return sig
 
     def _mut_sig(self):
         # grow mutates both; capacity shapes `bound`, exch the exchange.
@@ -1104,9 +1157,39 @@ class AggNode(Node):
             return (self.capacity,)
         return (self.capacity, self.exch)
 
+    def _tier_tail(self, tstate, old_main, new_state, ch):
+        """Touch-column maintenance inside the traced step: carry each
+        surviving group's stamp across the merge's row permutation (by
+        key, not position), stamp this epoch's touched groups with the
+        current tick, and report (tres, tcold). Costs two searchsorteds
+        over arrays the step already sorts — no extra program, no sync."""
+        import jax.numpy as jnp
+        from .sorted_state import EMPTY_KEY
+        from .tiering import TIER_TTL, TieredState
+        touch, tick = tstate.touch, tstate.tick
+        keys = new_state.main.keys
+        ocap = old_main.keys.shape[0]
+        idx = jnp.clip(jnp.searchsorted(old_main.keys, keys), 0, ocap - 1)
+        carried = jnp.where(old_main.keys[idx] == keys, touch[idx], 0)
+        tch = ch["keys"]
+        tidx = jnp.clip(jnp.searchsorted(tch, keys), 0,
+                        tch.shape[0] - 1)
+        touched = tch[tidx] == keys
+        live = keys != EMPTY_KEY
+        ntouch = jnp.where(live, jnp.where(touched, tick, carried), 0)
+        tres = jnp.sum(live).astype(jnp.int64)
+        tcold = jnp.sum(live & (tick - ntouch >= TIER_TTL)) \
+            .astype(jnp.int64)
+        return (TieredState(new_state, ntouch, tick + 1),
+                [tres, tcold])
+
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
         from .agg_step import DeviceAggState, local_epoch_step
+        tstate = None
+        if self.tier:
+            tstate = state
+            state = tstate.inner
         d = ins[0]
         if self.combined:
             # pre-combined input ([key, raw-row count, *partial deltas],
@@ -1177,6 +1260,10 @@ class AggNode(Node):
             stats = [needed.astype(jnp.int64),
                      ch["count"].astype(jnp.int64)] + stats_tail \
                 + [packbad, rows_in, rows_out] + sk
+            if tstate is not None:
+                new_state, tstats = self._tier_tail(
+                    tstate, state.main, new_state, ch)
+                stats = stats + tstats
             return new_state, None, stats, aux
         # ---- change stream: old rows (-1) then new rows (+1) ------------
         old_found, new_found = ch["old_found"], ch["new_found"]
@@ -1217,6 +1304,10 @@ class AggNode(Node):
         stats = [needed.astype(jnp.int64),
                  ch["count"].astype(jnp.int64)] + stats_tail \
             + [packbad, rows_in, _nrows(mask)] + sk
+        if tstate is not None:
+            new_state, tstats = self._tier_tail(
+                tstate, state.main, new_state, ch)
+            stats = stats + tstats
         return new_state, out, stats, ch
 
 
@@ -1248,6 +1339,12 @@ class JoinNode(Node):
             self.skew = True
             self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
 
+    def enable_tiering(self):
+        # see AggNode.enable_tiering; tres/tcold span BOTH build sides
+        if not self.tier:
+            self.tier = True
+            self.stat_names = tuple(self.stat_names) + ("tres", "tcold")
+
     def shard_spec(self):
         # both build sides partition by the vnode of the packed join key;
         # both input deltas shuffle first, keeping row identity (pair
@@ -1258,8 +1355,16 @@ class JoinNode(Node):
 
     def init_state(self):
         from .join_step import make_side
-        return (make_side(self.cap_a, self.l_val_dtypes),
-                make_side(self.cap_b, self.r_val_dtypes))
+        state = (make_side(self.cap_a, self.l_val_dtypes),
+                 make_side(self.cap_b, self.r_val_dtypes))
+        if self.tier:
+            import jax.numpy as jnp
+            from .tiering import TieredState
+            return TieredState(state,
+                               (jnp.zeros((self.cap_a,), jnp.int64),
+                                jnp.zeros((self.cap_b,), jnp.int64)),
+                               jnp.zeros((), jnp.int64))
+        return state
 
     def cap_current(self):
         caps = {"a": self.cap_a, "b": self.cap_b, "pairs": self.m}
@@ -1306,7 +1411,13 @@ class JoinNode(Node):
             self.exch = max(self.exch, caps.get("exch", 0))
 
     def cap_resize(self, state, caps):
+        import jax.numpy as jnp
         from .join_step import grow_side
+        tstate = None
+        if self.tier:
+            from .tiering import TieredState
+            tstate = state
+            state = tstate.inner
         if self.exch is not None and caps.get("exch", 0) > self.exch:
             self.exch = caps["exch"]   # jit-static: _mut_sig salts the trace
         a, b = state
@@ -1319,7 +1430,19 @@ class JoinNode(Node):
         self.capacity = max(self.cap_a, self.cap_b)
         if caps.get("pairs", 0) > self.m:
             self.m = caps["pairs"]    # jit-static: _mut_sig salts the trace
-        return (a, b)
+        if tstate is None:
+            return (a, b)
+        from .tiering import TieredState
+        ta, tb = tstate.touch
+        if a.jk.shape[0] > ta.shape[0]:
+            ta = jnp.concatenate(
+                [ta, jnp.zeros((a.jk.shape[0] - ta.shape[0],),
+                               jnp.int64)])
+        if b.jk.shape[0] > tb.shape[0]:
+            tb = jnp.concatenate(
+                [tb, jnp.zeros((b.jk.shape[0] - tb.shape[0],),
+                               jnp.int64)])
+        return TieredState((a, b), (ta, tb), tstate.tick)
 
     def _sig(self):
         sig = (tuple(self.l_keys), tuple(self.r_keys), self.pack,
@@ -1327,7 +1450,11 @@ class JoinNode(Node):
                tuple(str(d) for d in self.l_val_dtypes),
                tuple(str(d) for d in self.r_val_dtypes))
         # see AggNode._sig: armed skew telemetry changes the trace
-        return sig + ("skew",) if self.skew else sig
+        if self.skew:
+            sig = sig + ("skew",)
+        if self.tier:
+            sig = sig + ("tier",)
+        return sig
 
     def _mut_sig(self):
         # grow mutates the pair capacity and the exchange bucket capacity
@@ -1339,6 +1466,10 @@ class JoinNode(Node):
     def apply(self, state, ins, extra, epoch_events):
         import jax.numpy as jnp
         from .join_step import local_join_step
+        tstate = None
+        if self.tier:
+            tstate = state
+            state = tstate.inner
         A, B = ins
         packbad = jnp.zeros((), jnp.int64)
         sides = []
@@ -1382,7 +1513,44 @@ class JoinNode(Node):
                                         bmk & (bsg != 0)])
             stats += [a + b for a, b in zip(occ_a, occ_b)] \
                 + epoch_topk(cat_keys, cat_live, EMPTY_KEY)
-        return (new_a, new_b), out, stats, None
+        if tstate is None:
+            return (new_a, new_b), out, stats, None
+        # touch at JOIN-KEY granularity (every row of one jk shares the
+        # stamp — demotion/promotion move whole jk groups so probe
+        # results never see a partial build side). An arriving delta on
+        # EITHER input touches the jk on BOTH sides.
+        from .sorted_state import EMPTY_KEY
+        from .tiering import TIER_TTL, TieredState
+        tick = tstate.tick
+        tkeys = jnp.sort(jnp.concatenate(
+            [jnp.where(amk & (asg != 0), ajk, EMPTY_KEY),
+             jnp.where(bmk & (bsg != 0), bjk, EMPTY_KEY)]))
+
+        def side_touch(old_side, old_touch, new_side):
+            nk = new_side.jk
+            oc = old_side.jk.shape[0]
+            idx = jnp.clip(jnp.searchsorted(old_side.jk, nk,
+                                            side="left"), 0, oc - 1)
+            carried = jnp.where(old_side.jk[idx] == nk,
+                                old_touch[idx], 0)
+            ti = jnp.clip(jnp.searchsorted(tkeys, nk), 0,
+                          tkeys.shape[0] - 1)
+            hit = tkeys[ti] == nk
+            live = nk != EMPTY_KEY
+            return jnp.where(live, jnp.where(hit, tick, carried), 0)
+
+        ta, tb = tstate.touch
+        nta = side_touch(a, ta, new_a)
+        ntb = side_touch(b, tb, new_b)
+        live_a = new_a.jk != EMPTY_KEY
+        live_b = new_b.jk != EMPTY_KEY
+        tres = (jnp.sum(live_a) + jnp.sum(live_b)).astype(jnp.int64)
+        tcold = (jnp.sum(live_a & (tick - nta >= TIER_TTL))
+                 + jnp.sum(live_b & (tick - ntb >= TIER_TTL))) \
+            .astype(jnp.int64)
+        stats = stats + [tres, tcold]
+        return (TieredState((new_a, new_b), (nta, ntb), tick + 1),
+                out, stats, None)
 
 
 class MVKeyedNode(Node):
@@ -1508,6 +1676,203 @@ class MVPairNode(Node):
 # datagen program produced XLA graphs the remote-compile helper could not
 # finish (observed wedge, round 5); as its own program it compiles fine.
 _CHAINABLE = (SourceNode, MapNode, FilterNode)
+
+
+# ---------------------------------------------------------------------------
+# Tiered-state device surgery (policy in device/tiering.py; FusedJob
+# drives). Evict compacts demoted keys out of a table IN PLACE at the
+# SAME capacity — the node step's executable is untouched (same avals,
+# same _mut_sig), which is the zero-compile contract for demotion.
+# Promote is sorted_state.merge / join_step.merge_side with the exact
+# stored payload: an absent key inserts its delta verbatim, so a
+# demote->promote round trip is bit-exact. These helpers jit OUTSIDE
+# the compile service on purpose: its counters are the "zero fresh
+# compiles at adoption" assertion surface and tier surgery is not a
+# node-step compile.
+
+_TIER_JITS: Dict[Any, Any] = {}
+
+
+def _tier_jit(name: str, fn, static=("node",)):
+    import jax
+    if name not in _TIER_JITS:
+        _TIER_JITS[name] = jax.jit(fn, static_argnames=static)
+    return _TIER_JITS[name]
+
+
+def _agg_evict_core(tstate, dkeys, *, node):
+    """Demote `dkeys` (sorted, EMPTY-padded) from a tiered agg state:
+    returns (state without those rows — same capacity, count reduced —,
+    found[L], payload vals at dkeys, touch at dkeys)."""
+    import jax.numpy as jnp
+    from .agg_step import DeviceAggState
+    from .sorted_state import (EMPTY_KEY, SortedState, _neutral,
+                               compact_rows, lookup)
+    from .tiering import TieredState
+    inner, touch, tick = tstate.inner, tstate.touch, tstate.tick
+    main = inner.main
+    cap = main.keys.shape[0]
+    found, dvals = lookup(main, dkeys)
+    idx = jnp.clip(jnp.searchsorted(main.keys, dkeys), 0, cap - 1)
+    dtouch = jnp.where(found, touch[idx], 0)
+    ridx = jnp.clip(jnp.searchsorted(dkeys, main.keys), 0,
+                    dkeys.shape[0] - 1)
+    hit = (dkeys[ridx] == main.keys) & (main.keys != EMPTY_KEY)
+    alive = (main.keys != EMPTY_KEY) & ~hit
+    fills = [EMPTY_KEY] + [_neutral(k, v.dtype)
+                           for v, k in zip(main.vals, node.spec.kinds)] \
+        + [0]
+    rows = compact_rows(alive, [main.keys],
+                        list(main.vals) + [touch], cap, fills)
+    ncount = jnp.minimum(jnp.sum(alive).astype(jnp.int32), cap)
+    nmain = SortedState(rows[0], ncount, tuple(rows[1:-1]))
+    return (TieredState(DeviceAggState(nmain, inner.minputs),
+                        rows[-1], tick), found, dvals, dtouch)
+
+
+def _mv_evict_core(state, dkeys, *, node):
+    """Lockstep MV demotion (MVKeyedNode SortedState, no touch col)."""
+    import jax.numpy as jnp
+    from .materialize import mv_kinds
+    from .sorted_state import (EMPTY_KEY, SortedState, _neutral,
+                               compact_rows, lookup)
+    cap = state.keys.shape[0]
+    found, dvals = lookup(state, dkeys)
+    ridx = jnp.clip(jnp.searchsorted(dkeys, state.keys), 0,
+                    dkeys.shape[0] - 1)
+    hit = (dkeys[ridx] == state.keys) & (state.keys != EMPTY_KEY)
+    alive = (state.keys != EMPTY_KEY) & ~hit
+    kinds = mv_kinds(len(node.agg.spec.calls))
+    fills = [EMPTY_KEY] + [_neutral(k, v.dtype)
+                           for v, k in zip(state.vals, kinds)]
+    rows = compact_rows(alive, [state.keys], list(state.vals), cap,
+                        fills)
+    ncount = jnp.minimum(jnp.sum(alive).astype(jnp.int32), cap)
+    return (SortedState(rows[0], ncount, tuple(rows[1:])), found, dvals)
+
+
+def _join_evict_core(tstate, dkeys, *, node, side):
+    """Demote every row of the given jks from ONE build side: returns
+    (new tiered state, demoted jk/pk/vals/touch compacted to a prefix,
+    n_demoted)."""
+    import jax.numpy as jnp
+    from .join_step import JoinSide
+    from .sorted_state import EMPTY_KEY, compact_rows
+    from .tiering import TieredState
+    a, b = tstate.inner
+    ta, tb = tstate.touch
+    s, st = (a, ta) if side == 0 else (b, tb)
+    cap = s.jk.shape[0]
+    ridx = jnp.clip(jnp.searchsorted(dkeys, s.jk), 0,
+                    dkeys.shape[0] - 1)
+    hit = (dkeys[ridx] == s.jk) & (s.jk != EMPTY_KEY)
+    alive = (s.jk != EMPTY_KEY) & ~hit
+    fills = [EMPTY_KEY, EMPTY_KEY] + [0] * len(s.vals) + [0]
+    cols = list(s.vals) + [st]
+    arows = compact_rows(alive, [s.jk, s.pk], cols, cap, fills)
+    drows = compact_rows(hit, [s.jk, s.pk], cols, cap, fills)
+    ncount = jnp.minimum(jnp.sum(alive).astype(jnp.int32), cap)
+    ns = JoinSide(arows[0], arows[1], ncount, tuple(arows[2:-1]))
+    nst = arows[-1]
+    ndem = jnp.sum(hit).astype(jnp.int32)
+    new = ((ns, b), (nst, tb)) if side == 0 else ((a, ns), (ta, nst))
+    return (TieredState(new[0], new[1], tstate.tick),
+            drows[0], drows[1], tuple(drows[2:-1]), drows[-1], ndem)
+
+
+def _agg_promote_core(tstate, pkeys, pvals, ptouch, acc, *, node):
+    """Insert promoted rows (exact stored payload + touch) back into a
+    tiered agg state; EMPTY-padded buffer rows are no-ops. Returns the
+    new state and the max-folded `needed` accumulator (promotion can
+    overflow capacity like any merge — the job folds this into the
+    normal grow+replay remedy at the next sync)."""
+    import jax.numpy as jnp
+    from .agg_step import DeviceAggState
+    from .sorted_state import EMPTY_KEY, merge
+    from .tiering import TieredState
+    inner, touch, tick = tstate.inner, tstate.touch, tstate.tick
+    main = inner.main
+    new_main, needed = merge(main, pkeys, pvals, node.spec.kinds)
+    keys = new_main.keys
+    cap = keys.shape[0]
+    oidx = jnp.clip(jnp.searchsorted(main.keys, keys), 0, cap - 1)
+    ofound = main.keys[oidx] == keys
+    pidx = jnp.clip(jnp.searchsorted(pkeys, keys), 0,
+                    pkeys.shape[0] - 1)
+    pfound = pkeys[pidx] == keys
+    ntouch = jnp.where(keys != EMPTY_KEY,
+                       jnp.where(ofound, touch[oidx],
+                                 jnp.where(pfound, ptouch[pidx], 0)),
+                       0)
+    nacc = jnp.maximum(acc, needed.astype(jnp.int64))
+    return (TieredState(DeviceAggState(new_main, inner.minputs),
+                        ntouch, tick), nacc)
+
+
+def _mv_promote_core(state, pkeys, pvals, acc, *, node):
+    import jax.numpy as jnp
+    from .materialize import mv_kinds
+    from .sorted_state import merge
+    new_state, needed = merge(state, pkeys, pvals,
+                              mv_kinds(len(node.agg.spec.calls)))
+    return new_state, jnp.maximum(acc, needed.astype(jnp.int64))
+
+
+def _join_promote_core(tstate, pa, pb, acc, *, node):
+    """Promote cold rows into BOTH build sides ((jk, pk, vals, jk-touch)
+    per side, (jk,pk)-sorted, EMPTY-padded). `acc` is an (a, b) pair of
+    per-side needed accumulators (per-side capacities grow separately)."""
+    import jax.numpy as jnp
+    from .join_step import merge_side
+    from .sorted_state import EMPTY_KEY
+    from .tiering import TieredState
+    a, b = tstate.inner
+    ta, tb = tstate.touch
+    tick = tstate.tick
+
+    def one(side, st, buf):
+        jk, pk, vals, pt = buf
+        sign = jnp.where(jk != EMPTY_KEY, 1, 0).astype(jnp.int32)
+        ns, needed = merge_side(side, jk, pk, sign, vals)
+        nk = ns.jk
+        oc = side.jk.shape[0]
+        oidx = jnp.clip(jnp.searchsorted(side.jk, nk, side="left"),
+                        0, oc - 1)
+        ofound = side.jk[oidx] == nk
+        pix = jnp.clip(jnp.searchsorted(jk, nk, side="left"), 0,
+                       jk.shape[0] - 1)
+        pfound = jk[pix] == nk
+        nst = jnp.where(nk != EMPTY_KEY,
+                        jnp.where(ofound, st[oidx],
+                                  jnp.where(pfound, pt[pix], 0)), 0)
+        return ns, nst, needed
+
+    na, nta, need_a = one(a, ta, pa)
+    nb, ntb, need_b = one(b, tb, pb)
+    return (TieredState((na, nb), (nta, ntb), tick),
+            (jnp.maximum(acc[0], need_a.astype(jnp.int64)),
+             jnp.maximum(acc[1], need_b.astype(jnp.int64))))
+
+
+def _tier_call(name: str, core, shards: int, args, statics: Dict):
+    """Run a surgery core single-chip or vmapped over the shard axis.
+    `args[0]` is the (per-shard, under mesh) state; the rest follow the
+    core's positional signature. Evict cores get ONE shared key buffer
+    across shards (each shard evicts the subset it holds — no host
+    routing needed); promote cores get per-shard [S, L] buffers."""
+    import jax
+    snames = tuple(statics.keys())
+    if shards <= 1:
+        return _tier_jit((name, 0), core, snames)(*args, **statics)
+    shared_keys = "evict" in name
+
+    def vm(*a, **kw):
+        if shared_keys:
+            state, rest = a[0], a[1:]
+            return jax.vmap(lambda ts: core(ts, *rest, **kw))(state)
+        return jax.vmap(lambda *xs: core(*xs, **kw))(*a)
+
+    return _tier_jit((name, 1), vm, snames)(*args, **statics)
 
 
 # ---------------------------------------------------------------------------
@@ -1844,7 +2209,8 @@ class FusedJob:
                  plan_hash: Optional[str] = None,
                  rebalance: bool = True, rebalance_threshold: float = 2.0,
                  hot_key_rep: bool = True, hot_key_frac: float = 0.125,
-                 ingest=None):
+                 ingest=None,
+                 state_tiering: bool = True, tier_plans=None):
         import jax.numpy as jnp
         from ..utils.profile import JobProfiler
         self.name = name
@@ -1882,6 +2248,25 @@ class FusedJob:
         # source input is a pre-staged device buffer taken from it
         # instead of device-regenerated events; None = the datagen path
         self.ingest = ingest
+        # tiered state (device/tiering.py): per-node host cold stores +
+        # demotion journal + Xor8 negative caches. Armed by the planner
+        # (enable_tiering on the nodes, TierPlans derived from the
+        # ingest wiring); off — or no eligible node — keeps this job
+        # byte-identical to the untiered build. The cold snapshot pairs
+        # with `self.snapshot`: a growth replay must rewind BOTH tiers
+        # to the same commit point, because window promotions move rows
+        # out of the stores mid-window.
+        self.state_tiering = bool(state_tiering) and bool(tier_plans)
+        self.tiering = None
+        self._cold_snapshot = None
+        # promotion merges report truncation like any other step: the
+        # per-slot `needed` high-water folds here host-side (promotions
+        # are rare and already host-heavy) and joins the next sync's
+        # overflow check instead of riding a device accumulator
+        self._promo_need: Dict[int, Dict[str, int]] = {}
+        if self.state_tiering:
+            from .tiering import TieringManager
+            self.tiering = TieringManager(tier_plans, self.mesh_shards)
         # node indices predate the chain transform — remap through it
         pull.node_idx = program.remap.get(pull.node_idx, pull.node_idx)
         self.pull = pull
@@ -2087,6 +2472,13 @@ class FusedJob:
             if h2d_s > 0.0:
                 prof.phase("h2d", h2d_s)
             t0 = t1
+        if self.tiering is not None:
+            # touch-promotion BEFORE the step: probe the window's keys
+            # against the negative caches and restore any cold hits, so
+            # the device step always sees a complete working set
+            self._tier_promote(self.counter, events, prof)
+            if prof is not None:
+                t0 = _time.perf_counter()
         self.states, self.stats_acc = self._step(
             self.states, lo, self.stats_acc, feeds=feeds)
         if prof is not None:
@@ -2140,11 +2532,18 @@ class FusedJob:
         self.states = self.program.init_states()
         self.stats_acc = self._zero_stats
         self.counter = 0
+        if self.tiering is not None:
+            self.tiering.reset_stores()
+            self._promo_need = {}
         if target:
-            self._dispatch_range(0, target)
+            self._replay_history(target)
             self.counter = target
             self.sync()
         self.snapshot = (self.states, target)
+        if self.tiering is not None:
+            # cold snapshot BEFORE the crash window: its promotions must
+            # rewind with the device snapshot on a later growth replay
+            self._cold_snapshot = self.tiering.snapshot()
         self.stats_acc = self._zero_stats
         if expect > target:
             self._dispatch_range(target, expect)
@@ -2175,6 +2574,11 @@ class FusedJob:
         import jax.numpy as jnp
         if self.ingest is not None:
             for wlo, _ev, feeds in self.ingest.replay_range(lo, hi):
+                if self.tiering is not None:
+                    # replayed windows promote exactly like live ones
+                    # (window-boundary independent — a re-cut cadence
+                    # still meets every key before its step)
+                    self._tier_promote(wlo, _ev, None)
                 self.states, self.stats_acc = self._step(
                     self.states, jnp.int64(wlo), self.stats_acc,
                     feeds=feeds)
@@ -2273,6 +2677,14 @@ class FusedJob:
                 needs[i] = node.cap_needs(st)
                 needs_cum[i] = node.cap_needs_cum(st)
                 needs_epoch[i] = node.cap_needs_epoch(st)
+            # promotion merges can truncate too — their host-folded
+            # `needed` high-waters join the same overflow/growth check
+            for i, nd in self._promo_need.items():
+                for s, v in nd.items():
+                    if v > needs.get(i, {}).get(s, 0):
+                        needs.setdefault(i, {})[s] = v
+                    if v > needs_cum.get(i, {}).get(s, 0):
+                        needs_cum.setdefault(i, {})[s] = v
             overflow = any(
                 needs[i].get(s, 0) > c
                 for i, node in enumerate(self.program.nodes)
@@ -2310,6 +2722,14 @@ class FusedJob:
             self.snapshot = (self.states, snap_counter)
             self.counter = snap_counter
             self.stats_acc = self._zero_stats
+            if self.tiering is not None and self._cold_snapshot is not None:
+                # rewind the cold tier to the same commit point: window
+                # promotions popped rows out of the stores, and the
+                # replay below will promote them again. No journal
+                # re-enactment is due — demotions only happen at
+                # checkpoint commits, i.e. at snap_counter itself.
+                self.tiering.restore(self._cold_snapshot)
+            self._promo_need = {}
             self._dispatch_range(snap_counter, target)
             self.counter = target
 
@@ -2326,6 +2746,462 @@ class FusedJob:
             for si, s in enumerate(sorted(cur)):
                 rows.append((_JS_CAP_BASE + i * stride + si, cur[s]))
         return rows
+
+    # ---- tiered state (cold demotion + touch-promotion) ----------------
+    def _tier_journal(self):
+        """The TieringManager with its journal path bound (lazy — the
+        Database attaches data_dir after construction)."""
+        import os
+        tm = self.tiering
+        if tm is not None and tm.journal_path is None \
+                and self.data_dir is not None:
+            tm.set_journal_path(os.path.join(
+                self.data_dir, f"tiering_journal_{self.name}.jsonl"))
+        return tm
+
+    def _lead(self, x) -> np.ndarray:
+        """Host view of a device leaf, normalized to a leading shard
+        axis ([1, ...] single-chip)."""
+        a = np.asarray(x)
+        return a if self.mesh_shards > 1 else a[None]
+
+    def _set_state(self, i: int, st) -> None:
+        """Install a surgery output as node i's state. Vmapped surgery
+        outputs land unsharded — re-place them under the mesh sharding
+        so the next step call sees the layout it was traced for."""
+        if self.program.mesh is not None:
+            import jax
+            from ..parallel.mesh import state_sharding
+            sh = state_sharding(self.program.mesh)
+            st = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh), st)
+        states = list(self.states)
+        states[i] = st
+        self.states = tuple(states)
+
+    def _fold_promo(self, i: int, slot: str, need) -> None:
+        """Promotion-merge truncation high-water (host-side — see
+        __init__); joins the next sync's overflow check."""
+        need = int(need)
+        if need <= 0:
+            return
+        d = self._promo_need.setdefault(i, {})
+        if need > d.get(slot, 0):
+            d[slot] = need
+
+    def _probe_counters(self, store, shard: int, cand: np.ndarray):
+        """One negative-cache probe with the counter bookkeeping."""
+        tm = self.tiering
+        hits, probes, positives = store.probe(shard, cand)
+        tm.counters["filter_probes"] += probes
+        tm.counters["filter_hits"] += positives
+        if probes and not store.filter_live[shard]:
+            # Xor8.build failed (or no filter yet): every candidate
+            # paid the dict lookup — correct, just not cheap
+            tm.counters["filter_fallbacks"] += probes
+        return hits
+
+    def _tier_promote(self, lo: int, events: int, prof) -> None:
+        """Touch-promotion for the window at `lo`: derive each tiered
+        node's candidate keys from the window's host rows (the recipes'
+        lineage walk), probe the per-shard Xor8 negative caches, and
+        merge cold hits back into the device tables BEFORE the step —
+        the step then sees a complete working set and the MV stays
+        bit-identical to the untiered run. Promotion is window-boundary
+        independent (any window containing the key restores it first),
+        so replays with a re-cut cadence stay exact."""
+        import time as _time
+        tm = self.tiering
+        if tm is None or self.ingest is None or not tm.any_cold():
+            return
+        t0 = _time.perf_counter() if prof is not None else 0.0
+        per_source = None
+        for plan in tm.plans:
+            if not plan.recipes:
+                continue
+            if plan.kind == "agg":
+                if not len(tm.store(plan.node_idx, -1)):
+                    continue
+            elif not len(tm.store(plan.node_idx, 0)) \
+                    and not len(tm.store(plan.node_idx, 1)):
+                continue
+            if per_source is None:
+                per_source = self.ingest.host_window(lo, events)
+            cand = np.unique(np.concatenate(
+                [r.keys_for(per_source) for r in plan.recipes]))
+            if not len(cand):
+                continue
+            if plan.kind == "agg":
+                self._promote_agg(plan, cand)
+            else:
+                self._promote_join(plan, cand)
+        if prof is not None:
+            prof.phase("promote_h2d", _time.perf_counter() - t0)
+
+    def _promote_agg(self, plan, cand: np.ndarray) -> None:
+        import jax
+        from .sorted_state import EMPTY_KEY
+        from .tiering import _pad_pow2
+        tm = self.tiering
+        i = plan.node_idx
+        store = tm.store(i, -1)
+        shards = self.mesh_shards
+        hits = [sorted(self._probe_counters(store, s, cand))
+                for s in range(shards)]
+        nhit = sum(len(h) for h in hits)
+        if not nhit:
+            return
+        node = self.program.nodes[i]
+        tstate = self.states[i]
+        main = tstate.inner.main
+        vdt = [np.dtype(v.dtype) for v in main.vals]
+        L = _pad_pow2(max(len(h) for h in hits))
+        pkeys = np.full((shards, L), EMPTY_KEY, np.int64)
+        pvals = [np.zeros((shards, L), d) for d in vdt]
+        ptouch = np.zeros((shards, L), np.int64)
+        mvstore = tm.stores.get((i, "mv")) if plan.mv_idx is not None \
+            else None
+        if mvstore is not None:
+            mvst = self.states[plan.mv_idx]
+            mdt = [np.dtype(v.dtype) for v in mvst.vals]
+            mkeys = np.full((shards, L), EMPTY_KEY, np.int64)
+            mvals = [np.zeros((shards, L), d) for d in mdt]
+        for s, h in enumerate(hits):
+            for j, k in enumerate(h):
+                vals, tch = store.rows[s].pop(k)
+                pkeys[s, j] = k
+                ptouch[s, j] = tch
+                for c, v in enumerate(vals):
+                    pvals[c][s, j] = v
+                if mvstore is not None:
+                    mrow = mvstore.rows[s].pop(k, None)
+                    if mrow is not None:
+                        mkeys[s, j] = k
+                        for c, v in enumerate(mrow):
+                            mvals[c][s, j] = v
+        tm.counters["promotions"] += nhit
+
+        def shp(a):
+            return a if shards > 1 else a[0]
+        acc = np.zeros((shards,), np.int64) if shards > 1 \
+            else np.int64(0)
+        ntstate, nacc = _tier_call(
+            "agg_promote", _agg_promote_core, shards,
+            (tstate, shp(pkeys), tuple(shp(c) for c in pvals),
+             shp(ptouch), acc), {"node": node})
+        self._set_state(i, ntstate)
+        self._fold_promo(i, "main",
+                         np.max(np.asarray(jax.device_get(nacc))))
+        if mvstore is not None:
+            nst, mnacc = _tier_call(
+                "mv_promote", _mv_promote_core, shards,
+                (mvst, shp(mkeys), tuple(shp(c) for c in mvals), acc),
+                {"node": self.program.nodes[plan.mv_idx]})
+            self._set_state(plan.mv_idx, nst)
+            self._fold_promo(plan.mv_idx, "main",
+                             np.max(np.asarray(jax.device_get(mnacc))))
+
+    def _promote_join(self, plan, cand: np.ndarray) -> None:
+        import jax
+        from .sorted_state import EMPTY_KEY
+        from .tiering import _pad_pow2
+        tm = self.tiering
+        i = plan.node_idx
+        shards = self.mesh_shards
+        node = self.program.nodes[i]
+        tstate = self.states[i]
+        bufs = []
+        total = 0
+        for side in (0, 1):
+            store = tm.store(i, side)
+            sd = tstate.inner[side]
+            vdt = [np.dtype(v.dtype) for v in sd.vals]
+            rows_by_shard = []
+            for s in range(shards):
+                rows = []
+                for k in sorted(self._probe_counters(store, s, cand)):
+                    rows.extend((k,) + r for r in store.rows[s].pop(k))
+                rows_by_shard.append(rows)
+            L = _pad_pow2(max(len(r) for r in rows_by_shard))
+            jk = np.full((shards, L), EMPTY_KEY, np.int64)
+            pk = np.full((shards, L), EMPTY_KEY, np.int64)
+            vals = [np.zeros((shards, L), d) for d in vdt]
+            tch = np.zeros((shards, L), np.int64)
+            for s, rows in enumerate(rows_by_shard):
+                rows.sort(key=lambda r: (r[0], r[1]))
+                for j, (rjk, rpk, rvals, rt) in enumerate(rows):
+                    jk[s, j] = rjk
+                    pk[s, j] = rpk
+                    tch[s, j] = rt
+                    for c, v in enumerate(rvals):
+                        vals[c][s, j] = v
+                total += len(rows)
+            bufs.append((jk, pk, tuple(vals), tch))
+        if not total:
+            return
+        tm.counters["promotions"] += total
+
+        def shp(t):
+            if shards > 1:
+                return t
+            jk, pk, vals, tch = t
+            return (jk[0], pk[0], tuple(v[0] for v in vals), tch[0])
+        z = np.zeros((shards,), np.int64) if shards > 1 else np.int64(0)
+        ntstate, (na, nb) = _tier_call(
+            "join_promote", _join_promote_core, shards,
+            (tstate, shp(bufs[0]), shp(bufs[1]), (z, z)),
+            {"node": node})
+        self._set_state(i, ntstate)
+        self._fold_promo(i, "a", np.max(np.asarray(jax.device_get(na))))
+        self._fold_promo(i, "b", np.max(np.asarray(jax.device_get(nb))))
+
+    def _tier_demote_tick(self, prof) -> None:
+        """The commit-phase half of demotion, two-phase so the D2H
+        never blocks an epoch: HARVEST the recency pull issued at the
+        LAST checkpoint (its transfer overlapped this whole window's
+        dispatch), select + evict the cold keys it names, then ISSUE
+        the next async pull for any node whose window residency
+        high-water crossed the high-water fraction of capacity."""
+        import time as _time
+        from .capacity import tier_waters
+        from .skew_stats import SK_KEY_MASK, hot_key_set
+        from .tiering import select_cold
+        tm = self._tier_journal()
+        if tm is None:
+            return
+        t0 = _time.perf_counter() if prof is not None else 0.0
+        did = False
+        high, _low = tier_waters()
+        vec = np.maximum(self._stat_totals, self._last_stats) \
+            if len(self._stat_totals) == len(self._last_stats) \
+            else self._last_stats
+        for plan in tm.plans:
+            if not plan.recipes:
+                continue                   # demotion-inert (stats only)
+            i = plan.node_idx
+            node = self.program.nodes[i]
+            pend = tm.pending.pop(i, None)
+            if pend is not None:
+                did = True
+                hot = hot_key_set(self.program.node_stats(i, vec)) \
+                    if node.skew else ()
+                sel = []
+                if plan.kind == "agg":
+                    keys, touch, count = (self._lead(x) for x in pend)
+                    cap = keys.shape[1]
+                    for s in range(self.mesh_shards):
+                        d = select_cold(keys[s], touch[s],
+                                        int(count[s]), cap, hot,
+                                        SK_KEY_MASK)
+                        if d is not None:
+                            sel.append(d)
+                else:
+                    ka, ta, ca, kb, tb, cb = (self._lead(x)
+                                              for x in pend)
+                    for k, t, c in ((ka, ta, ca), (kb, tb, cb)):
+                        cap = k.shape[1]
+                        for s in range(self.mesh_shards):
+                            d = select_cold(k[s], t[s], int(c[s]), cap,
+                                            hot, SK_KEY_MASK)
+                            if d is not None:
+                                sel.append(d)
+                if sel:
+                    self._tier_demote_enact(
+                        plan, np.unique(np.concatenate(sel)),
+                        record=True)
+            # issue the NEXT pull when the window's residency
+            # high-water says pressure (stats already on host — the
+            # sync pulled them; no extra device round trip here, the
+            # copy below is async by construction)
+            st = self.program.node_stats(i, self._last_stats)
+            tres = int(st.get("tres", 0))
+            tstate = self.states[i]
+            if plan.kind == "agg":
+                pressure = tres > high * node.capacity
+                leaves = (tstate.inner.main.keys, tstate.touch,
+                          tstate.inner.main.count)
+            else:
+                pressure = tres > high * min(node.cap_a, node.cap_b)
+                a, b = tstate.inner
+                ta, tb = tstate.touch
+                leaves = (a.jk, ta, a.count, b.jk, tb, b.count)
+            if pressure:
+                did = True
+                for x in leaves:
+                    x.copy_to_host_async()
+                tm.pending[i] = leaves
+        if did and prof is not None:
+            prof.phase("demote_d2h", _time.perf_counter() - t0)
+
+    def _tier_demote_enact(self, plan, keys: np.ndarray,
+                           record: bool) -> None:
+        """Evict `keys` from the device table(s) into the cold store
+        (exact payload + touch stamp), rebuild the negative caches, and
+        journal the event. The selection may be stale (it came from the
+        previous checkpoint's pull) — the evict cores report `found`
+        per key, and only found rows move, so a key promoted or died
+        since selection is simply skipped. With record=False this
+        re-enacts a journaled event during a history replay."""
+        import jax
+        from .sorted_state import EMPTY_KEY
+        from .tiering import _pad_pow2
+        tm = self.tiering
+        i = plan.node_idx
+        node = self.program.nodes[i]
+        shards = self.mesh_shards
+        keys = np.unique(np.asarray(keys, dtype=np.int64))
+        if not len(keys):
+            return
+        dbuf = np.full((_pad_pow2(len(keys)),), EMPTY_KEY, np.int64)
+        dbuf[:len(keys)] = keys
+        stored = 0
+        if plan.kind == "agg":
+            ntstate, found, dvals, dtouch = _tier_call(
+                "agg_evict", _agg_evict_core, shards,
+                (self.states[i], dbuf), {"node": node})
+            self._set_state(i, ntstate)
+            fnd = self._lead(jax.device_get(found))
+            dvs = [self._lead(v) for v in jax.device_get(list(dvals))]
+            dts = self._lead(jax.device_get(dtouch))
+            store = tm.store(i, -1)
+            for s in range(shards):
+                for j in np.nonzero(fnd[s])[0]:
+                    store.rows[s][int(dbuf[j])] = (
+                        tuple(v[s, j] for v in dvs), int(dts[s, j]))
+                    stored += 1
+                store.rebuild_filter(s)
+            if plan.mv_idx is not None:
+                # lockstep MV demotion: the SAME groups leave the
+                # terminal MV table, merged back at SELECT time
+                # (_tier_merge_mv_rows) or on promotion
+                nst, mfnd, mdvals = _tier_call(
+                    "mv_evict", _mv_evict_core, shards,
+                    (self.states[plan.mv_idx], dbuf),
+                    {"node": self.program.nodes[plan.mv_idx]})
+                self._set_state(plan.mv_idx, nst)
+                mf = self._lead(jax.device_get(mfnd))
+                mdv = [self._lead(v)
+                       for v in jax.device_get(list(mdvals))]
+                mstore = tm.store(i, "mv")
+                for s in range(shards):
+                    for j in np.nonzero(mf[s])[0]:
+                        mstore.rows[s][int(dbuf[j])] = tuple(
+                            v[s, j] for v in mdv)
+                    # no filter rebuild: the MV store is only ever
+                    # probed in lockstep by its agg's hit keys
+        else:
+            tstate = self.states[i]
+            for side in (0, 1):
+                out = _tier_call(
+                    "join_evict", _join_evict_core, shards,
+                    (tstate, dbuf), {"node": node, "side": side})
+                tstate, djk, dpk, dvals, dtouch, ndem = out
+                jks = self._lead(jax.device_get(djk))
+                pks = self._lead(jax.device_get(dpk))
+                dvs = [self._lead(v)
+                       for v in jax.device_get(list(dvals))]
+                dts = self._lead(jax.device_get(dtouch))
+                nd = self._lead(jax.device_get(ndem))
+                store = tm.store(i, side)
+                for s in range(shards):
+                    n = int(nd[s])
+                    for j in range(n):
+                        store.rows[s].setdefault(
+                            int(jks[s, j]), []).append(
+                            (int(pks[s, j]),
+                             tuple(v[s, j] for v in dvs),
+                             int(dts[s, j])))
+                    stored += n
+                    store.rebuild_filter(s)
+            self._set_state(i, tstate)
+        if record:
+            tm.record(self.counter, i, -1, keys)
+            tm.counters["demote_events"] += 1
+            tm.counters["demotions"] += stored
+
+    def _replay_history(self, target: int) -> None:
+        """From-zero history regeneration with tier re-enactment: split
+        the committed range at the journal's demotion counters and
+        re-enact each event in place — payloads regenerate from the
+        replayed (deterministic) state, so BOTH tiers rebuild
+        bit-identically. Falls back to a plain dispatch when untiered
+        or nothing was ever demoted."""
+        if target <= 0:
+            return
+        tm = self.tiering
+        events = tm.events_between(0, target) if tm is not None else []
+        if not events:
+            self._dispatch_range(0, target)
+            return
+        plans = {p.node_idx: p for p in tm.plans}
+        lo = 0
+        for c, evs in events:
+            if c > lo:
+                self._dispatch_range(lo, c)
+                lo = c
+            for n, _side, keys in evs:
+                p = plans.get(n)
+                if p is not None:
+                    self._tier_demote_enact(
+                        p, np.asarray(keys, np.int64), record=False)
+        if target > lo:
+            self._dispatch_range(lo, target)
+
+    def _tier_merge_mv_rows(self, keys, cols, nulls):
+        """SELECT-time merge of the terminal MV's cold rows with the
+        device pull, in ascending-key order — packed keys are globally
+        unique across tiers AND shards, so the merged order is exactly
+        the untiered pull's order."""
+        tm = self.tiering
+        store = None
+        for p in tm.plans:
+            if p.mv_idx == self.pull.node_idx:
+                store = tm.stores.get((p.node_idx, "mv"))
+        if store is None or not len(store):
+            return keys, cols, nulls
+        ck, crows = [], []
+        for d in store.rows:
+            for k, vals in d.items():
+                ck.append(k)
+                crows.append(vals)
+        keys = np.asarray(keys)
+        cols = [np.asarray(c) for c in cols]
+        nulls = [np.asarray(nl) for nl in nulls]
+        ckeys = np.asarray(ck, dtype=np.int64)
+        keys_all = np.concatenate([keys, ckeys])
+        order = np.argsort(keys_all, kind="stable")
+        ncalls = len(cols)
+        out_cols, out_nulls = [], []
+        for j in range(ncalls):
+            cc = np.array([r[1 + 2 * j] for r in crows],
+                          dtype=cols[j].dtype)
+            cn = np.array([r[2 + 2 * j] for r in crows],
+                          dtype=nulls[j].dtype)
+            out_cols.append(np.concatenate([cols[j], cc])[order])
+            out_nulls.append(np.concatenate([nulls[j], cn])[order])
+        return keys_all[order], out_cols, out_nulls
+
+    def tiering_report(self) -> List[Tuple]:
+        """Rows for `rw_state_tiering` / `risectl tiering`: per tiered
+        node (node, kind, resident high-water, cold rows, filter live,
+        promotable) + the job-wide demotion/promotion/filter counters
+        repeated on every row (the rw_key_skew flat-row pattern)."""
+        tm = self.tiering
+        if tm is None:
+            return []
+        vec = np.maximum(self._stat_totals, self._last_stats) \
+            if len(self._stat_totals) == len(self._last_stats) \
+            else self._last_stats
+        resident = {
+            p.node_idx:
+                self.program.node_stats(p.node_idx, vec).get("tres", 0)
+            for p in tm.plans}
+        c = tm.counters
+        tail = (c["demotions"], c["promotions"], c["demote_events"],
+                c["filter_probes"], c["filter_hits"],
+                c["filter_fallbacks"])
+        return [row + tail
+                for row in tm.report_rows(self.program.nodes, resident)]
 
     def _checkpoint(self, epoch: int) -> None:
         import time as _time
@@ -2372,7 +3248,15 @@ class FusedJob:
             self.freshness.commit(self.name, epoch, self._window_ingest,
                                   _time.time())
         self._window_ingest = None
+        # cold demotion rides the commit phase: harvest the D2H pull
+        # issued at the LAST checkpoint (it overlapped the whole
+        # window's dispatch), evict the selected cold keys, then issue
+        # the next pull if this window's residency crossed high-water
+        self._tier_demote_tick(prof)
         self.snapshot = (self.states, self.counter)
+        if self.tiering is not None:
+            self._cold_snapshot = self.tiering.snapshot()
+        self._promo_need = {}
         self.stats_acc = self._zero_stats
         self.committed = self.counter
         # the checkpoint closed the window: trim the epoch event log and
@@ -2424,6 +3308,12 @@ class FusedJob:
                     live_bound=self._pull_need() * self.mesh_shards)
             else:
                 keys, cols, nulls = mv_rows(st, dts)
+            if self.tiering is not None:
+                # demoted groups live in the host cold store — merge
+                # them back in key order so the result is bit-identical
+                # (row order included) to the untiered pull
+                keys, cols, nulls = self._tier_merge_mv_rows(
+                    keys, cols, nulls)
             gcols_np = _np_unpack(self.pull.agg.pack, keys)
             out_cols = []
             for pos, (kind, j) in enumerate(self.pull.out_map):
@@ -2527,9 +3417,23 @@ class FusedJob:
             # nothing dispatched yet — rebuild empty state at full size
             self.states = self.program.init_states()
             self.snapshot = (self.states, 0)
+        tm = self._tier_journal()
         if target == 0:
+            if tm is not None:
+                # a crashed predecessor's journal is stale history — the
+                # state tables say nothing committed, so neither tier did
+                tm.clear_journal()
             return
-        self._dispatch_range(0, target)
+        if tm is not None:
+            # the demotion journal is the cold tier's redo log: load it,
+            # drop any torn tail past the committed counter, and let the
+            # replay re-enact each event at its recorded position —
+            # payloads regenerate from the (deterministic) replayed
+            # state, so both tiers rebuild bit-identically
+            tm.load_journal()
+            tm.truncate_journal(target)
+            tm.reset_stores()
+        self._replay_history(target)
         self.counter = target
         self.sync()
         # the replay's pulled stats seed the job-lifetime totals — the
@@ -2537,7 +3441,10 @@ class FusedJob:
         # after recovery, not one checkpoint later
         self._accum_totals(self._last_stats)
         self.snapshot = (self.states, target)
+        if tm is not None:
+            self._cold_snapshot = tm.snapshot()
         self.stats_acc = self._zero_stats
+        self._promo_need = {}
         self.committed = target
         if self.mv_state_table is not None:
             self._persisted = {tuple(r): None
@@ -2701,11 +3608,17 @@ class FusedJob:
         self.stats_acc = self._zero_stats
         self.counter = 0
         self.snapshot = (self.states, 0)
+        if self.tiering is not None:
+            self.tiering.reset_stores()
+            self._promo_need = {}
+            self._cold_snapshot = self.tiering.snapshot()
         if target:
-            self._dispatch_range(0, target)
+            self._replay_history(target)
             self.counter = target
             self.sync()
         self.snapshot = (self.states, target)
+        if self.tiering is not None:
+            self._cold_snapshot = self.tiering.snapshot()
         self.stats_acc = self._zero_stats
         # the superseded policy's pre-warmed exchange executables are
         # dead weight now — drop them (keyed by node shape, so only

@@ -523,6 +523,18 @@ class HostIngest:
             self.stat["h2d_s"] += h
             yield wlo, ev, feeds
 
+    def host_window(self, lo: int, events: int):
+        """The window's HOST rows, one (ids, cols) per source — the
+        tier-promotion candidate probe (device/tiering.py) reads these
+        to recompute each node's packed keys host-side. Retained
+        windows answer from the staged arrays for free; otherwise the
+        deterministic range contract re-derives them."""
+        retained = self._retained.get(lo)
+        if retained is not None and retained[0] == events:
+            return retained[1]
+        return [src.rows_for(lo, lo + events)
+                for _, src in self.sources]
+
     def trim(self, committed: int) -> None:
         """Checkpoint trim: windows at or past `committed` stay (the
         next crash window replays them); everything older is durable."""

@@ -124,6 +124,20 @@ def unpack_hot(packed: int) -> Tuple[int, int]:
     return packed & SK_KEY_MASK, packed >> SK_SHIFT
 
 
+def hot_key_set(stats) -> Tuple[int, ...]:
+    """The heavy-hitter keys (40-bit masked) present in one node's
+    folded stats dict — the free hot-set oracle state tiering's cold
+    selection must exclude. Empty when skew stats are off."""
+    out = set()
+    for i in range(SK_TOPK):
+        packed = stats.get(f"skh{i}", 0)
+        if packed:
+            key, cnt = unpack_hot(packed)
+            if cnt > 0:
+                out.add(int(key))
+    return tuple(sorted(out))
+
+
 # ---------------------------------------------------------------------------
 # host-side policy math: occupancy histogram -> shard loads -> new bounds
 # ---------------------------------------------------------------------------

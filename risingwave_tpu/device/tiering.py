@@ -1,0 +1,436 @@
+"""Tiered state beyond HBM: host-side policy for cold-group demotion.
+
+A fused job whose live key set outgrows `DeviceConfig.hbm_budget_mb`
+historically had one lever — grow-and-replay — and the budget clamp
+floors at observed need, so truly unbounded-key workloads (q8-style
+user tables over days of traffic) could not run at all. This module is
+the host half of the fix (StreamBox-HBM's frequency-tiered placement,
+applied to the sorted-array state the device operators already use):
+
+  hot tier   — the device SortedState/JoinSide tables, exactly as
+               before, now carrying a last-touched-epoch column
+               (device/fused.py stamps it inside the existing traced
+               step; no extra program, no extra sync).
+  cold tier  — per-node, per-shard host dicts (`ColdStore`) keyed by
+               the packed group/join key, holding the exact payload
+               row + its touch stamp, populated by the coordinator off
+               the commit phase with one batched D2H (the reverse of
+               ingest's double-buffered H2D).
+
+Demotion picks the OLDEST-touched keys (never `rw_key_skew` heavy
+hitters — the free hot-set oracle) once occupancy crosses a high-water
+fraction of capacity, and drains down to a low-water mark so the
+capacity predictor never needs to grow past the budget. Promotion is
+exactness-critical: every epoch's incoming key batch is probed against
+an Xor8 negative cache over the demoted key set (a filter miss proves
+residency-or-absence and costs zero dict lookups); hits are pulled
+from the cold store and merged back into the device table BEFORE the
+epoch step dispatches, so the step always sees a complete working set
+and results stay bit-identical to the untiered run.
+
+Durability: every enacted demotion appends one JSON line
+(`tiering_journal_<job>.jsonl`, beside the job state table) recording
+(commit counter, node, side, keys). Rebuilds-from-zero (restart
+recovery, failpoint recovery, policy adoption) replay the input
+history and RE-ENACT the journal at the recorded counters — payloads
+are regenerated from the replayed state, which is deterministic, so
+both tiers come back bit-identical. The invariant everything leans on:
+a key lives in EXACTLY one tier at any commit point, with its exact
+payload.
+
+This module is deliberately jax-free (numpy + json only): policy,
+recipes, stores, journal. The device surgery (evict/promote jits)
+lives with the node classes in device/fused.py.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .capacity import tier_waters
+
+# epochs a key may go untouched before it counts as cold in the
+# `tcold` stat (observability only — selection is oldest-first by
+# actual touch stamp, not a TTL cliff)
+TIER_TTL = max(1, int(os.environ.get("RW_TIER_TTL", "4")))
+
+# demotion batch buffers (and the evict jit's key argument) are padded
+# to pow2 buckets so repeated demotions reuse one executable per bucket
+_PAD_LO = 64
+
+
+def _pad_pow2(n: int, lo: int = _PAD_LO) -> int:
+    c = lo
+    while c < n:
+        c <<= 1
+    return c
+
+
+def np_pack(fields, cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Host numpy twin of PackPlan.pack — bit-identical to the device
+    packing for in-range values (int64 shifts, floor division)."""
+    key = np.zeros_like(np.asarray(cols[0], dtype=np.int64))
+    shift = 0
+    for c, f in zip(cols, fields):
+        c = np.asarray(c, dtype=np.int64)
+        v = (c - f.offset) // f.stride if f.stride > 1 else c - f.offset
+        key = key + (v.astype(np.int64) << shift)
+        shift += f.bits
+    return key
+
+
+def key_bytes(k: int) -> bytes:
+    return struct.pack("<q", int(k))
+
+
+class TieredState(NamedTuple):
+    """A tier-armed node's device state: the node's ordinary state plus
+    the recency columns the tier policy reads. NamedTuple = automatic
+    jax pytree, so it nests transparently through jit / shard_map /
+    device_put — the module stays jax-free.
+
+    `touch` rides POSITIONALLY with the inner key table(s): agg/MV keep
+    one int64[capacity] column; joins keep a (side_a, side_b) pair at
+    row granularity. `tick` is the node-local epoch counter the step
+    stamps into touched rows (a scalar, replicated per shard under the
+    mesh)."""
+    inner: Any                       # the untiered node state (pytree)
+    touch: Any                       # int64[cap] | (int64[ca], int64[cb])
+    tick: Any                        # int64 scalar epoch stamp
+
+
+class TierRecipe(NamedTuple):
+    """How to recompute one node input's packed key host-side from the
+    ingest window's SHIPPED host columns (device/ingest.py retains them
+    per window): per key column, its position in the shipped list, plus
+    the node's own PackPlan fields. Derived once at plan time by
+    walking InputRef-only Map / Filter chains back to the IngestNode."""
+    source_ord: int                  # position in HostIngest.sources
+    col_pos: Tuple[int, ...]         # per key col: shipped-list index
+    fields: Tuple[Any, ...]          # PackPlan.fields (host twin input)
+
+    def keys_for(self, per_source) -> np.ndarray:
+        ids, cols = per_source[self.source_ord]
+        kcols = [ids if p == -1 else cols[p] for p in self.col_pos]
+        return np_pack(self.fields, kcols)
+
+
+class TierPlan(NamedTuple):
+    """One demotion-eligible node: an AggNode (side -1, with its
+    lockstep terminal MVKeyedNode if any) or a JoinNode (sides 0/1)."""
+    node_idx: int
+    kind: str                        # "agg" | "join"
+    recipes: Tuple[TierRecipe, ...]  # promotion-candidate derivations
+    mv_idx: Optional[int] = None     # lockstep MVKeyedNode index
+
+
+def derive_recipe(nodes, node_idx: int, col_idx: Sequence[int],
+                  fields, source_ords: Dict[int, int]
+                  ) -> Optional[TierRecipe]:
+    """Walk `col_idx` (positions in nodes[node_idx]'s OUTPUT delta)
+    back through Filter (positional passthrough) and InputRef-only Map
+    stages — standalone or absorbed into a ChainNode — to an
+    IngestNode's shipped host columns. None when any column's lineage
+    leaves the traceable set (computed expressions, window columns,
+    device datagen, another stateful node): the node stays armed for
+    recency stats but is demotion-inert, which is always safe."""
+    from .fused import ChainNode, FilterNode, IngestNode, MapNode
+    from ..expr.expression import InputRef
+
+    def through(member, cols):
+        if isinstance(member, FilterNode):
+            return cols
+        if isinstance(member, MapNode):
+            out = []
+            for ci in cols:
+                if ci >= len(member.exprs):
+                    return None
+                e = member.exprs[ci]
+                if not isinstance(e, InputRef):
+                    return None
+                out.append(e.index)
+            return out
+        return None
+
+    cols = list(col_idx)
+    idx = node_idx
+    for _ in range(64):                       # cycle guard
+        n = nodes[idx]
+        if isinstance(n, IngestNode):
+            live = n.live if n.live is not None \
+                else tuple(range(len(n.col_names)))
+            pos = []
+            for ci in cols:
+                if ci == n.rowid_pos:
+                    pos.append(-1)            # the ids array itself
+                elif ci in live:
+                    pos.append(live.index(ci))
+                else:
+                    return None
+            ordn = source_ords.get(idx)
+            if ordn is None:
+                return None
+            return TierRecipe(ordn, tuple(pos), tuple(fields))
+        if isinstance(n, ChainNode):
+            for m in reversed(n.chain):
+                if isinstance(m, IngestNode):
+                    break
+                cols = through(m, cols)
+                if cols is None:
+                    return None
+            head = n.chain[0]
+            if isinstance(head, IngestNode):
+                idx_n = idx
+                nodes = list(nodes)
+                nodes[idx_n] = head           # re-enter as the ingest
+                continue
+            if not n.inputs:
+                return None
+            idx = n.inputs[0]
+            continue
+        if isinstance(n, (MapNode, FilterNode)):
+            cols = through(n, cols)
+            if cols is None:
+                return None
+            idx = n.inputs[0]
+            continue
+        return None
+    return None
+
+
+class ColdStore:
+    """Per-node(-side) host tier: one dict per shard (packed key ->
+    payload row) plus an Xor8 negative cache over the shard's demoted
+    key set. The filter is REBUILT on demotion (the key set just
+    changed) and left stale-superset on promotion (a stale positive
+    costs one dict miss; a false negative is impossible). `Xor8.build`
+    may return None (construction failure) — the store then degrades
+    to always-probe: every candidate pays the dict lookup, correctness
+    unchanged."""
+
+    def __init__(self, n_shards: int):
+        self.rows: List[Dict[int, Tuple]] = [dict()
+                                             for _ in range(n_shards)]
+        self.filters: List[Optional[Any]] = [None] * n_shards
+        self.filter_live: List[bool] = [False] * n_shards
+
+    def __len__(self) -> int:
+        return sum(len(d) for d in self.rows)
+
+    def rebuild_filter(self, shard: int) -> None:
+        from ..state.hummock import Xor8
+        ks = list(self.rows[shard].keys())
+        if not ks:
+            self.filters[shard] = None
+            self.filter_live[shard] = False
+            return
+        # dedupe is structural (dict keys) — build() also guards
+        f = Xor8.build([key_bytes(k) for k in ks])
+        self.filters[shard] = f                  # None => always-probe
+        self.filter_live[shard] = f is not None
+
+    def probe(self, shard: int, cand: np.ndarray
+              ) -> Tuple[List[int], int, int]:
+        """Candidate packed keys -> (hits present in this shard's cold
+        dict, filter probes, filter positives). A missing / failed
+        filter falls back to probing the dict for every candidate."""
+        d = self.rows[shard]
+        if not d:
+            return [], 0, 0
+        f = self.filters[shard]
+        hits, pos = [], 0
+        if f is None:
+            for k in cand.tolist():
+                if k in d:
+                    hits.append(k)
+            return hits, len(cand), len(hits)
+        for k in cand.tolist():
+            if f.may_contain(key_bytes(k)):
+                pos += 1
+                if k in d:
+                    hits.append(k)
+        return hits, len(cand), pos
+
+    def snapshot(self):
+        return ([dict(d) for d in self.rows], list(self.filters),
+                list(self.filter_live))
+
+    def restore(self, snap) -> None:
+        rows, filters, live = snap
+        self.rows = [dict(d) for d in rows]
+        self.filters = list(filters)
+        self.filter_live = list(live)
+
+
+def select_cold(keys: np.ndarray, touch: np.ndarray, count: int,
+                capacity: int, hot_keys, key_mask: int
+                ) -> Optional[np.ndarray]:
+    """Oldest-touched live keys to demote from ONE shard, excluding
+    `rw_key_skew` heavy hitters, sized to drain occupancy from above
+    high water down to low water. None = no pressure."""
+    high, low = tier_waters()
+    count = int(count)
+    if capacity <= 0 or count <= int(high * capacity):
+        return None
+    target = count - int(low * capacity)
+    if target <= 0:
+        return None
+    k = np.asarray(keys[:count], dtype=np.int64)
+    t = np.asarray(touch[:count], dtype=np.int64)
+    if hot_keys:
+        hot = np.array(sorted(hot_keys), dtype=np.int64)
+        masked = (k.astype(np.uint64) & np.uint64(key_mask)).astype(np.int64)
+        cold_ok = ~np.isin(masked, hot)
+    else:
+        cold_ok = np.ones(count, dtype=bool)
+    order = np.argsort(t, kind="stable")
+    order = order[cold_ok[order]]
+    return k[order[:target]] if len(order) else None
+
+
+class TieringManager:
+    """Coordinator-side bookkeeping for one FusedJob: plans, cold
+    stores, the demotion journal, pending async D2H recency pulls, and
+    the counters the `rw_state_tiering` system table reports."""
+
+    def __init__(self, plans: Sequence[TierPlan], n_shards: int):
+        self.plans = list(plans)
+        self.n_shards = max(1, int(n_shards))
+        # (node_idx, side) -> ColdStore; side -1 = agg main / its MV
+        # rides (node_idx, "mv"); joins use 0/1 per build side
+        self.stores: Dict[Tuple[int, Any], ColdStore] = {}
+        for p in self.plans:
+            if p.kind == "agg":
+                self.stores[(p.node_idx, -1)] = ColdStore(self.n_shards)
+                if p.mv_idx is not None:
+                    self.stores[(p.node_idx, "mv")] = \
+                        ColdStore(self.n_shards)
+            else:
+                self.stores[(p.node_idx, 0)] = ColdStore(self.n_shards)
+                self.stores[(p.node_idx, 1)] = ColdStore(self.n_shards)
+        # journal: ordered (counter, node_idx, side, [keys]) of ENACTED
+        # demotions; the file is the restart-durable mirror
+        self.journal: List[Tuple[int, int, Any, List[int]]] = []
+        self.journal_path: Optional[str] = None
+        self._jlock = threading.Lock()
+        # pending two-phase recency pulls: node_idx -> opaque handle
+        self.pending: Dict[int, Any] = {}
+        self.counters: Dict[str, int] = {
+            "demotions": 0, "promotions": 0, "demote_events": 0,
+            "filter_probes": 0, "filter_hits": 0, "filter_fallbacks": 0}
+
+    # ---- stores ----------------------------------------------------------
+    def store(self, node_idx: int, side) -> ColdStore:
+        return self.stores[(node_idx, side)]
+
+    def any_cold(self) -> bool:
+        return any(len(s) for s in self.stores.values())
+
+    def reset_stores(self) -> None:
+        for key, s in self.stores.items():
+            self.stores[key] = ColdStore(self.n_shards)
+        self.pending.clear()
+
+    def snapshot(self):
+        return ({k: s.snapshot() for k, s in self.stores.items()},
+                dict(self.counters))
+
+    def restore(self, snap) -> None:
+        stores, counters = snap
+        for k, s in stores.items():
+            self.stores[k].restore(s)
+        self.counters.update(counters)
+        self.pending.clear()
+
+    # ---- journal ---------------------------------------------------------
+    def set_journal_path(self, path: Optional[str]) -> None:
+        self.journal_path = path
+
+    def record(self, counter: int, node_idx: int, side,
+               keys: Sequence[int]) -> None:
+        ev = (int(counter), int(node_idx), side,
+              [int(k) for k in keys])
+        with self._jlock:
+            self.journal.append(ev)
+            if self.journal_path is not None:
+                with open(self.journal_path, "a") as f:
+                    f.write(json.dumps({"c": ev[0], "n": ev[1],
+                                        "s": ev[2], "k": ev[3]}) + "\n")
+                    f.flush()
+
+    def load_journal(self) -> None:
+        self.journal = []
+        if self.journal_path is None \
+                or not os.path.exists(self.journal_path):
+            return
+        with open(self.journal_path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue                     # torn tail from a crash
+                self.journal.append((int(r["c"]), int(r["n"]), r["s"],
+                                     [int(k) for k in r["k"]]))
+
+    def truncate_journal(self, target: int) -> None:
+        """Drop events past the committed counter (a crash between a
+        demotion's journal append and its checkpoint commit leaves a
+        tail that never happened as far as the state tables know) and
+        rewrite the file to match."""
+        keep = [ev for ev in self.journal if ev[0] <= target]
+        if len(keep) == len(self.journal):
+            return
+        self.journal = keep
+        if self.journal_path is not None:
+            with self._jlock, open(self.journal_path, "w") as f:
+                for c, n, s, k in keep:
+                    f.write(json.dumps({"c": c, "n": n, "s": s,
+                                        "k": k}) + "\n")
+
+    def clear_journal(self) -> None:
+        """Forget everything — a fresh job (nothing committed) must not
+        inherit a crashed predecessor's demotion history."""
+        self.journal = []
+        if self.journal_path is not None \
+                and os.path.exists(self.journal_path):
+            try:
+                os.remove(self.journal_path)
+            except OSError:
+                pass
+
+    def events_between(self, lo: int, hi: int
+                       ) -> List[Tuple[int, List[Tuple[int, Any,
+                                                       List[int]]]]]:
+        """Journal events with lo < counter <= hi, grouped by counter in
+        order — the re-enactment schedule for a history replay."""
+        by: Dict[int, List[Tuple[int, Any, List[int]]]] = {}
+        for c, n, s, k in self.journal:
+            if lo < c <= hi:
+                by.setdefault(c, []).append((n, s, k))
+        return [(c, by[c]) for c in sorted(by)]
+
+    # ---- report ----------------------------------------------------------
+    def report_rows(self, nodes, resident: Dict[int, int]
+                    ) -> List[Tuple]:
+        """(node, kind, resident, cold, filter_live) per tiered node,
+        with the job-wide counters repeated — the `rw_state_tiering` /
+        `risectl tiering` surface."""
+        rows = []
+        for p in self.plans:
+            if p.kind == "agg":
+                cold = len(self.stores[(p.node_idx, -1)])
+                flt = any(self.stores[(p.node_idx, -1)].filter_live)
+            else:
+                cold = len(self.stores[(p.node_idx, 0)]) \
+                    + len(self.stores[(p.node_idx, 1)])
+                flt = any(self.stores[(p.node_idx, 0)].filter_live) \
+                    or any(self.stores[(p.node_idx, 1)].filter_live)
+            rows.append((p.node_idx, type(nodes[p.node_idx]).__name__,
+                         int(resident.get(p.node_idx, 0)), int(cold),
+                         bool(flt), bool(p.recipes)))
+        return rows

@@ -23,6 +23,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, List, Optional, Tuple
 
 from ..core.dtypes import TypeKind
@@ -360,6 +361,26 @@ class _Conn:
         return fmt, delim if delim is not None \
             else ("\t" if fmt == "text" else ",")
 
+    def _copy_push(self, table: str, chunk: str, fmt: str,
+                   delim: str) -> int:
+        """Admission-gated push of one framed COPY chunk. A `defer`
+        verdict waits OUTSIDE the session lock — other sessions'
+        queries (and the epoch ticks that refill the admission bucket)
+        keep flowing while this producer is held at the wire — then
+        re-acquires to retry. Past the bounded deadline the push is
+        forced so COPY can never deadlock on a quiescent barrier
+        clock (same contract as Database.copy_rows, minus the
+        lock-held sleep)."""
+        deadline = time.monotonic() + 1.0
+        while True:
+            with self.lock:
+                verdict, n = self.db.copy_chunk(
+                    table, chunk, fmt, delim,
+                    force=time.monotonic() >= deadline)
+            if verdict != "defer":
+                return n if verdict == "admit" else 0
+            time.sleep(0.01)
+
     def _copy_in(self, table: str, opts: str) -> None:
         """Copy-in sub-protocol: CopyInResponse, then CopyData frames
         parsed in batches through the Database's admission-gated bulk
@@ -405,17 +426,15 @@ class _Conn:
                 if cut >= 0:
                     chunk, buf = buf[:cut + 1], buf[cut + 1:]
                     try:
-                        with self.lock:
-                            rows += self.db.copy_rows(
-                                table, chunk.decode("utf-8"), fmt, delim)
+                        rows += self._copy_push(
+                            table, chunk.decode("utf-8"), fmt, delim)
                     except Exception as e:  # noqa: BLE001
                         failed = f"{type(e).__name__}: {e}"
             elif tag == b"c":                    # CopyDone
                 if failed is None and buf.strip():
                     try:
-                        with self.lock:
-                            rows += self.db.copy_rows(
-                                table, buf.decode("utf-8"), fmt, delim)
+                        rows += self._copy_push(
+                            table, buf.decode("utf-8"), fmt, delim)
                     except Exception as e:  # noqa: BLE001
                         failed = f"{type(e).__name__}: {e}"
                 if failed is not None:

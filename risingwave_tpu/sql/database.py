@@ -1045,6 +1045,11 @@ class Database:
         if dropped_job is not None:
             if getattr(dropped_job, "ingest", None) is not None:
                 dropped_job.ingest.close()    # join the staging thread
+            if getattr(dropped_job, "tiering", None) is not None:
+                # a re-created MV under the same name starts with no
+                # demotion history — a stale journal would replay
+                # evictions against state that never saw them
+                dropped_job.tiering.clear_journal()
             # remember where its capacities topped out, keyed by plan
             # shape — a re-created MV with the same plan (any name)
             # starts there (zero growth replays); structurally identical

@@ -86,13 +86,16 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
     # (staged ingest buffers), async dispatch, blocking device sync,
     # state-table commit (ring-buffered; the full history is in
     # epoch_profile.jsonl / `risectl profile`). pack/h2d split the old
-    # host_pack column disjointly.
+    # host_pack column disjointly; promote_h2d/demote_d2h are the state
+    # tier's surgery phases (zero with tiering off).
     "rw_epoch_profile": (
         Schema.of(("job", T.VARCHAR), ("seq", T.INT64),
                   ("events", T.INT64), ("shards", T.INT64),
                   ("pack_ms", T.FLOAT64), ("h2d_ms", T.FLOAT64),
+                  ("promote_h2d_ms", T.FLOAT64),
                   ("dispatch_ms", T.FLOAT64), ("exchange_ms", T.FLOAT64),
                   ("device_sync_ms", T.FLOAT64),
+                  ("demote_d2h_ms", T.FLOAT64),
                   ("commit_ms", T.FLOAT64), ("wall_ms", T.FLOAT64)),
         lambda db: _epoch_profile(db)),
     # per-node attribution from the on-device stats vector: row flow,
@@ -137,6 +140,20 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("ordinal", T.INT64), ("key", T.INT64),
                   ("value", T.INT64), ("share", T.FLOAT64)),
         lambda db: _key_skew(db)),
+    # tiered-state residency (device/tiering.py): per demotion-eligible
+    # fused node, the hot-tier residency high-water vs the cold-tier
+    # row count, whether the Xor8 negative cache is live, whether the
+    # node can demote at all (promotable=false nodes are recency-stats
+    # only), and the job-wide demotion/promotion/filter counters
+    "rw_state_tiering": (
+        Schema.of(("job", T.VARCHAR), ("node", T.INT64),
+                  ("type", T.VARCHAR), ("resident", T.INT64),
+                  ("cold", T.INT64), ("filter_live", T.BOOLEAN),
+                  ("promotable", T.BOOLEAN), ("demotions", T.INT64),
+                  ("promotions", T.INT64), ("demote_events", T.INT64),
+                  ("filter_probes", T.INT64), ("filter_hits", T.INT64),
+                  ("filter_fallbacks", T.INT64)),
+        lambda db: _state_tiering(db)),
     # poison-pill dead-letter queue (fault-tolerance v3): one row per
     # input record the supervisor sidelined after bounded respawns kept
     # dying on the same retained window. The full audit trail of the
@@ -199,6 +216,11 @@ def _epoch_profile(db) -> List[Tuple]:
 def _key_skew(db) -> List[Tuple]:
     return [(name,) + row for name, job in db._fused.items()
             for row in job.skew_report()]
+
+
+def _state_tiering(db) -> List[Tuple]:
+    return [(name,) + row for name, job in db._fused.items()
+            for row in job.tiering_report()]
 
 
 def _fused_node_stats(db) -> List[Tuple]:

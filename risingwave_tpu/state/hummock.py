@@ -148,6 +148,15 @@ class Xor8:
 
     @classmethod
     def build(cls, keys: List[bytes]) -> Optional["Xor8"]:
+        """May return None (construction failure) — every caller must
+        degrade gracefully (run readers treat the run as unfiltered,
+        tiering's negative caches fall back to always-probe). Duplicate
+        keys would make the 3-regular peeling unconditionally fail (a
+        duplicated key's three slots never reach count 1), burning all
+        seed retries for nothing — dedupe first; set semantics are what
+        a membership filter means anyway."""
+        if len(keys) != len(set(keys)):
+            keys = list(dict.fromkeys(keys))
         n = len(keys)
         if n == 0:
             return cls(0, 1, bytes(3))

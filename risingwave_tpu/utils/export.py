@@ -85,8 +85,8 @@ def _instant(name: str, cat: str, ts: float, pid: str, tid: str,
 # the epoch-profile phase order IS the wall-clock order inside an epoch
 # ("host_pack" kept for records written by pre-split releases — it was
 # the union of today's disjoint pack + h2d)
-_PHASE_ORDER = ("pack", "h2d", "host_pack", "dispatch", "exchange",
-                "device_sync", "commit")
+_PHASE_ORDER = ("pack", "h2d", "host_pack", "promote_h2d", "dispatch",
+                "exchange", "device_sync", "demote_d2h", "commit")
 
 
 def export_chrome(data_dir: str) -> Dict[str, Any]:

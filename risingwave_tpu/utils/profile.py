@@ -57,7 +57,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 PROFILE_FILE = "epoch_profile.jsonl"
 _MAX_FILE_BYTES = 4 << 20
-PHASES = ("pack", "h2d", "dispatch", "exchange", "device_sync", "commit")
+PHASES = ("pack", "h2d", "promote_h2d", "dispatch", "exchange",
+          "device_sync", "demote_d2h", "commit")
 # a per-node step call slower than this is recorded as a compile/retrace
 # even when the profiler did not expect one (catches shape changes that
 # arrived through a path growth accounting doesn't flag)
@@ -189,19 +190,25 @@ class JobProfiler:
     # ---- surfaces --------------------------------------------------------
     def rows(self) -> List[Tuple]:
         """rw_epoch_profile rows: (job, seq, events, shards, pack_ms,
-        h2d_ms, dispatch_ms, exchange_ms, device_sync_ms, commit_ms,
-        wall_ms). Records written by a pre-split release carry
-        `host_pack`; it reads back as `pack` (h2d was 0 by construction
-        there — no staged transfers existed)."""
+        h2d_ms, promote_h2d_ms, dispatch_ms, exchange_ms,
+        device_sync_ms, demote_d2h_ms, commit_ms, wall_ms). Records
+        written by a pre-split release carry `host_pack`; it reads back
+        as `pack` (h2d was 0 by construction there — no staged
+        transfers existed). promote_h2d / demote_d2h are the state
+        tier's surgery phases (device/tiering.py) — zero when tiering
+        is off."""
         out = []
         for r in self.ring:
             ph = r["ph_ms"]
             out.append((self.job, r["seq"], r["events"],
                         r.get("shards", 1),
                         ph.get("pack", ph.get("host_pack", 0.0)),
-                        ph.get("h2d", 0.0), ph.get("dispatch", 0.0),
+                        ph.get("h2d", 0.0),
+                        ph.get("promote_h2d", 0.0),
+                        ph.get("dispatch", 0.0),
                         ph.get("exchange", 0.0),
-                        ph.get("device_sync", 0.0), ph.get("commit", 0.0),
+                        ph.get("device_sync", 0.0),
+                        ph.get("demote_d2h", 0.0), ph.get("commit", 0.0),
                         r["wall_ms"]))
         return out
 

@@ -21,6 +21,11 @@ os.environ.setdefault("RW_SKEW_STATS", "0")
 # the dedicated skew-defense tests (test_skew_ops.py). Production
 # default stays ON (DeviceConfig.agg_precombine).
 os.environ.setdefault("RW_AGG_PRECOMBINE", "0")
+# And for the hot/cold state tier (a touch column in every keyed step
+# plus promote/evict surgery programs): pinned OFF suite-wide, forced
+# on per test by the dedicated tiering tests (test_tiering.py).
+# Production default stays ON (DeviceConfig.state_tiering).
+os.environ.setdefault("RW_STATE_TIERING", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

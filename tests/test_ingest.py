@@ -159,9 +159,9 @@ def test_q1_host_fed_bit_identity(q1_ref):
     assert any(r[0] == "bid" for r in adm)
     prof_rows = db.query("SELECT * FROM rw_epoch_profile")
     assert prof_rows
-    for (_j, _s, _e, _sh, pack, h2d, disp, exch, sync, commit,
-         wall) in prof_rows:
-        assert pack + h2d + disp + exch + sync + commit \
+    for (_j, _s, _e, _sh, pack, h2d, pro, disp, exch, sync, dem,
+         commit, wall) in prof_rows:
+        assert pack + h2d + pro + disp + exch + sync + dem + commit \
             <= wall * 1.001 + 0.05
 
 
@@ -274,9 +274,9 @@ def test_double_buffer_overlap_and_phases():
     assert st["h2d_s"] < disp, (st, job.profiler.totals)
     # phases stayed disjoint + within wall (pack/h2d included)
     for r in job.profiler.rows():
-        pack, h2d, dispatch, exch, sync, commit, wall = r[4:]
-        assert pack + h2d + dispatch + exch + sync + commit \
-            <= wall * 1.001 + 0.05
+        pack, h2d, pro, dispatch, exch, sync, dem, commit, wall = r[4:]
+        assert pack + h2d + pro + dispatch + exch + sync + dem \
+            + commit <= wall * 1.001 + 0.05
 
 
 # ---------------------------------------------------------------------------

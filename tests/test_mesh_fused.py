@@ -241,10 +241,10 @@ def test_profiler_shards_and_exchange_phase():
     rows = db.query("SELECT * FROM rw_epoch_profile")
     assert rows
     dispatched = 0
-    for j, seq, events, shards, hp, h2d, disp, exch, sync, commit, \
-            wall in rows:
+    for j, seq, events, shards, hp, h2d, pro, disp, exch, sync, dem, \
+            commit, wall in rows:
         assert shards == 8
-        phases = hp + h2d + disp + exch + sync + commit
+        phases = hp + h2d + pro + disp + exch + sync + dem + commit
         # the exchange split must stay disjoint from dispatch: phase
         # sums within 10% of wall (epsilon for sub-ms timer noise)
         assert phases <= wall * 1.001 + 0.05

@@ -61,12 +61,13 @@ def test_epoch_profile_rows_and_phase_sums(tmp_path):
     db = _fused_db(str(tmp_path / "d"))
     rows = db.query("SELECT * FROM rw_epoch_profile")
     assert rows, "a fused run must produce epoch profile rows"
-    for job, seq, events, shards, hp, h2d, disp, exch, sync, commit, \
-            wall in rows:
+    for job, seq, events, shards, hp, h2d, pro, disp, exch, sync, dem, \
+            commit, wall in rows:
         assert job == "q4"
         assert shards == 1 and exch == 0.0   # single-chip job
         assert h2d == 0.0                    # no staged ingest transfers
-        phases = hp + h2d + disp + exch + sync + commit
+        assert pro == 0.0 and dem == 0.0     # tiering off in tier-1
+        phases = hp + h2d + pro + disp + exch + sync + dem + commit
         # phase splits must account for the measured wall (the acceptance
         # bound is 10%; sub-ms epochs get an epsilon for timer noise)
         assert phases <= wall * 1.001 + 0.05

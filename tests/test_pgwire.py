@@ -512,6 +512,48 @@ def test_copy_rides_the_admission_gate(server):
     assert any(r[1] == "fh" for r in db.query("SELECT * FROM rw_shed_log"))
 
 
+def test_copy_defer_waits_outside_session_lock(server):
+    """An admission DEFER during COPY must not camp on the shared
+    session lock: the deferring producer waits unlocked (TCP
+    backpressure to its client) and re-acquires to retry, so other
+    sessions' queries keep flowing. Pre-fix, copy_rows slept its whole
+    bounded wait (up to 1 s) INSIDE the lock and every other
+    connection stalled behind the firehose."""
+    import threading
+    import time
+
+    c = MiniClient(server.host, server.port)
+    c.startup()
+    c.query("CREATE TABLE dw (a BIGINT)")
+    g, msgs = _copy(c, "COPY dw FROM STDIN", [b"1\n"])
+    assert any(t == b"C" and b.startswith(b"COPY 1") for t, b in msgs)
+    db = server.db
+    bucket = db._overload.bucket("dw")
+    bucket.tokens = 0
+    bucket._copy_epoch = db.injector.epoch.curr     # pin: no refill
+    done = threading.Event()
+
+    def producer():
+        c2 = MiniClient(server.host, server.port)
+        c2.startup()
+        _g, pm = _copy(c2, "COPY dw FROM STDIN", [b"2\n3\n"])
+        done.copied = any(t == b"C" and b.startswith(b"COPY 2")
+                          for t, b in pm)
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.2)         # let the producer reach its defer loop
+    t0 = time.monotonic()
+    assert any(tg == b"C" for tg, _ in c.query("SELECT 1"))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.5, (
+        f"query stalled {elapsed:.2f}s behind a deferring COPY — the "
+        "defer wait is holding the session lock")
+    # the deferred COPY itself completes (bounded wait forces the push)
+    assert done.wait(10) and done.copied
+
+
 def test_copy_escapes_and_quoting_edge_cases(server):
     """Review-hardening cases: escaped backslash before t/n/r in text
     format, quoted-empty vs unquoted-empty in csv, and embedded
