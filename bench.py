@@ -1220,6 +1220,137 @@ def stage_tiering(n_events):
     return {"tiering": out}
 
 
+def stage_serving(n_events, window_s=1.0):
+    """Workload: the read path at scale (ISSUE 19) — a fused q4 MV
+    served to 1/8/64 concurrent readers, read cache off vs on, staleness
+    bound 0 vs 2 epochs. Records read QPS, read p50/p99, device pulls
+    per 1k SELECTs, and write-eps interference (ingest driven alone vs
+    under a 64-reader cached storm). Asserts the acceptance invariants:
+    a 64-reader cached storm between two checkpoints costs <= 1 device
+    pull, and cached read QPS >= 5x uncached."""
+    import threading as _th
+    import time as _t
+    from risingwave_tpu.config import DeviceConfig, ROBUSTNESS
+    from risingwave_tpu.device import shard_exec
+    from risingwave_tpu.sql import Database
+
+    chunk = max(2048, n_events // (64 * 8))
+    db = Database(device=DeviceConfig(capacity=1 << 16,
+                                      mv_persist_every=MV_PERSIST_EVERY))
+    db.run(BID_SRC.format(n=n_events, c=chunk))
+    db.run(Q4_MV)
+    job = db._fused["q4"]
+    total_ticks = n_events // (64 * chunk) + 3
+    quarter = max(1, total_ticks // 4)
+
+    def ticks_eps(k):
+        c0 = job.counter
+        t0 = _t.perf_counter()
+        for _ in range(k):
+            db.tick()
+        job.sync()
+        dt = _t.perf_counter() - t0
+        return round((job.counter - c0) / dt) if dt > 0 else None
+
+    # write path alone: one warm quarter (absorbs the compiles), one
+    # measured quarter
+    ticks_eps(quarter)
+    write_eps_alone = ticks_eps(quarter)
+
+    # write path under a continuous 64-reader cached storm
+    saved = (ROBUSTNESS.serving_cache, ROBUSTNESS.serving_staleness_epochs)
+    ROBUSTNESS.serving_cache = True
+    ROBUSTNESS.serving_staleness_epochs = 0
+    stop_ev = _th.Event()
+
+    def bg_reader():
+        while not stop_ev.is_set():
+            db._serve_mv_rows("q4", job)
+
+    bg = [_th.Thread(target=bg_reader, daemon=True) for _ in range(64)]
+    for t in bg:
+        t.start()
+    try:
+        write_eps_storm = ticks_eps(total_ticks - 2 * quarter)
+    finally:
+        stop_ev.set()
+        for t in bg:
+            t.join(30.0)
+
+    # read arms over the drained (stable) MV: readers x cache x staleness
+    def read_storm(readers, seconds):
+        lats = []
+        lock = _th.Lock()
+        deadline = _t.perf_counter() + seconds
+
+        def worker():
+            my = []
+            while _t.perf_counter() < deadline:
+                r0 = _t.perf_counter()
+                db._serve_mv_rows("q4", job)
+                my.append(_t.perf_counter() - r0)
+            with lock:
+                lats.extend(my)
+
+        ts = [_th.Thread(target=worker) for _ in range(readers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(seconds + 60.0)
+        lats.sort()
+        n = len(lats)
+        return {"n_selects": n,
+                "read_qps": round(n / seconds),
+                "read_p50_ms": round(lats[n // 2] * 1e3, 3) if n else None,
+                "read_p99_ms": round(lats[min(n - 1, int(n * 0.99))] * 1e3,
+                                     3) if n else None}
+
+    arms = {}
+    try:
+        for cache, stale in (("off", 0), ("on", 0), ("on", 2)):
+            ROBUSTNESS.serving_cache = cache == "on"
+            ROBUSTNESS.serving_staleness_epochs = stale
+            for readers in (1, 8, 64):
+                db.read_cache.invalidate()
+                shard_exec.reset_pull_stats()
+                rec = read_storm(readers, window_s)
+                pulls = shard_exec.PULL_STATS["device_pulls"]
+                rec["device_pulls"] = pulls
+                rec["pulls_per_1k_selects"] = (
+                    round(1e3 * pulls / rec["n_selects"], 3)
+                    if rec["n_selects"] else None)
+                arms[f"cache_{cache}_stale{stale}_r{readers}"] = rec
+    finally:
+        ROBUSTNESS.serving_cache, ROBUSTNESS.serving_staleness_epochs = saved
+
+    # acceptance: one pull per (MV, epoch) under the cached 64-reader
+    # storm (the stream is drained — exactly one commit window), and
+    # cached QPS >= 5x uncached at the same reader count
+    hot = arms["cache_on_stale0_r64"]
+    cold = arms["cache_off_stale0_r64"]
+    assert hot["device_pulls"] <= 1, \
+        f"cached 64-reader storm pulled {hot['device_pulls']}x"
+    assert hot["read_qps"] >= 5 * cold["read_qps"], \
+        f"cached QPS {hot['read_qps']} < 5x uncached {cold['read_qps']}"
+    out = {
+        "events": n_events,
+        "window_s": window_s,
+        "write_eps_alone": write_eps_alone,
+        "write_eps_under_64_reader_storm": write_eps_storm,
+        "cache": db.read_cache.stats(),
+        "speedup_cached_vs_uncached_64r":
+            round(hot["read_qps"] / max(1, cold["read_qps"]), 1),
+        "arms": arms,
+        "note": ("read QPS over the drained fused q4 MV; cached arms "
+                 "serve (epoch, rows) snapshots from host memory with "
+                 "single-flight fills — pulls_per_1k_selects is the "
+                 "device-pull amortization; interference compares ingest "
+                 "eps alone vs under a continuous 64-reader cached "
+                 "storm"),
+    }
+    return {"serving": out}
+
+
 # ---------------------------------------------------------------------------
 # the un-killable harness
 # ---------------------------------------------------------------------------
@@ -1238,6 +1369,7 @@ _STAGES = {
     "overload": stage_overload,
     "ingest": stage_ingest,
     "tiering": stage_tiering,
+    "serving": stage_serving,
 }
 
 
@@ -1385,7 +1517,7 @@ class Harness:
         }
         # record the round's numbers (warmup_s + compile/retrace counts in
         # the per-stage `warmup` blocks) so regressions diff as files
-        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r16.json")
+        out_path = os.environ.get("RW_BENCH_OUT", "BENCH_r19.json")
         if out_path and self.record:
             try:
                 with open(out_path + ".tmp", "w") as f:
@@ -1416,6 +1548,7 @@ def main():
         # something to overlap even at smoke scale
         h.run_stage("ingest", (1_048_576, 20_000, 4), 180)
         h.run_stage("tiering", (262_144,), 150)
+        h.run_stage("serving", (131_072, 0.5), 120)
     else:
         # Budgets assume a possibly-cold persistent compile cache: one cold
         # compile of a fused epoch program set is ~200-400s on the remote-
@@ -1477,6 +1610,13 @@ def main():
         # (demote/promote), MVs asserted bit-identical
         if not h.run_stage("tiering", (Q4_SQL_EVENTS[0] // 4,), 600):
             h.run_stage("tiering", (Q4_SQL_EVENTS[0] // 4,), 400,
+                        " — retry (warmer)")
+        # serving read path (ISSUE 19): epoch-versioned MV read cache
+        # off/on x staleness 0/2 x 1/8/64 readers — read QPS + p50/p99,
+        # device pulls per 1k SELECTs, write-eps interference under a
+        # 64-reader storm; coalescing + >=5x QPS asserted in-stage
+        if not h.run_stage("serving", (Q4_SQL_EVENTS[0] // 4,), 400):
+            h.run_stage("serving", (Q4_SQL_EVENTS[0] // 4,), 300,
                         " — retry (warmer)")
     h.emit()
 

@@ -41,6 +41,14 @@ class DeviceConfig:
     # unchanged. Distinct from `mesh`, which shards the PER-OPERATOR
     # host executors (parallel/sharded_*) and disables fusion.
     mesh_shards: int = 1
+    # serving replicas (parallel/mesh.REPLICA_AXIS): the fused mesh
+    # becomes (mesh_shards, replicas) with state sharded over the data
+    # axis and MIRRORED over the replica axis — the same fused program,
+    # byte-for-byte, with every MV arrangement readable from any replica
+    # column (SELECT pulls round-robin over replicas). Needs
+    # mesh_shards * replicas devices; 1 = today's 1-D mesh, unchanged.
+    # RW_MESH_REPLICAS overrides.
+    replicas: int = 1
     # whole-fragment fusion (device/fuse_planner.py): eligible MV plans
     # become one jitted epoch program. Off forces the per-operator path.
     fuse: bool = True
@@ -247,6 +255,22 @@ class RobustnessConfig:
     # queueing unboundedly on the coordinator lock. <= 0 disables the
     # gate (the repo's knob-off convention).
     select_concurrency: int = 64
+    # per-session slice of the SELECT admission budget: one pgwire
+    # session may hold at most this many in-flight SELECTs, so a chatty
+    # session exhausts its own slice (53000) long before it can starve
+    # the global budget for everyone else. <= 0 disables the per-session
+    # cap (the knob-off convention); the global bound still applies.
+    select_per_session: int = 8
+    # serving-tier read cache (serving/read_cache.py): pgwire SELECTs
+    # over fused MVs serve from host-side epoch-versioned snapshots —
+    # one device pull per (MV, epoch) regardless of reader count, with
+    # concurrent cache-miss readers coalesced onto a single pull.
+    serving_cache: bool = True
+    # staleness bound, in committed epochs: a cached snapshot serves iff
+    # cache_epoch >= committed_epoch - serving_staleness_epochs. 0 =
+    # always-fresh (the cache still coalesces readers within an epoch);
+    # higher trades bounded staleness for zero pulls across commits.
+    serving_staleness_epochs: int = 0
     # sink spool bound (rows buffered in one checkpoint window) past
     # which the sink reports pressure to the ladder; a stalled external
     # sink parks its backlog in the DURABLE sink log (disk), never RSS.
@@ -330,6 +354,7 @@ class NodeConfig:
             mode = dev.pop("mode", "off")
             for k in dev:
                 if k not in ("capacity", "minmax", "fuse", "mesh_shards",
+                             "replicas",
                              "mv_persist_every", "predictive_growth",
                              "hbm_budget_mb", "compile_cache_dir",
                              "profile", "aot_compile", "compile_buckets"):

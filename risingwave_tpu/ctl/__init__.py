@@ -57,6 +57,13 @@ Commands:
                               table, offline) — answers "is state
                               spilling, and is the filter earning its
                               keep"
+    serving                   serving-tier read-cache report: per
+                              cached MV the snapshot epoch, row count,
+                              and hit / miss / coalesced / fill
+                              counters, plus the process-wide device-
+                              pull total (the `rw_serving_cache` system
+                              table) — answers "are SELECTs actually
+                              serving from host memory"
     compile-status [JOB]      per-signature AOT compile state of every
                               fused job (pending / ready / cached /
                               failed, with capacity bucket and compile
@@ -418,6 +425,27 @@ def cmd_tiering(args) -> int:
     return 0
 
 
+def cmd_serving(args) -> int:
+    """Serving-tier read-cache report (`rw_serving_cache`, offline):
+    per cached MV the snapshot epoch / row count and the hit / miss /
+    coalesced / fill counters, plus the process-wide device-pull
+    total. A healthy read-heavy deployment shows hits >> fills."""
+    from ..sql import Database
+    from ..device.shard_exec import PULL_STATS
+    db = Database(data_dir=args.data_dir, device="auto")
+    rows = db.read_cache.report()
+    if not rows:
+        print("serving cache empty (no fused MV has been read)")
+    else:
+        cols = ("mv", "epoch", "rows", "hits", "misses", "coalesced",
+                "fills")
+        print("  ".join(f"{c:>10s}" for c in cols))
+        for r in rows:
+            print("  ".join(f"{str(v):>10s}" for v in r))
+    print(f"device pulls (process total): {PULL_STATS['device_pulls']}")
+    return 0
+
+
 def cmd_skew(args) -> int:
     """Key-skew summary of every fused job (`rw_key_skew`, offline):
     per-node skew_ratio + per-shard load under the current routing
@@ -666,6 +694,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp.add_argument("job", nargs="?", default=None)
     sp.add_argument("--data-dir", required=True)
     sp.set_defaults(fn=cmd_tiering)
+    sp = sub.add_parser("serving")
+    sp.add_argument("--data-dir", required=True)
+    sp.set_defaults(fn=cmd_serving)
     sp = sub.add_parser("compile-status")
     sp.add_argument("job", nargs="?", default=None)
     sp.add_argument("--data-dir", required=True)

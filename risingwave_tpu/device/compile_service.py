@@ -56,6 +56,11 @@ _WORKERS = max(1, min(4, (os.cpu_count() or 2) - 1))
 MANIFEST_FILE = "compile_manifest.json"
 
 
+def _data_shards(mesh) -> int:
+    from ..parallel.mesh import data_shards
+    return data_shards(mesh)
+
+
 def _stable_digest(obj: Any) -> str:
     """Deterministic short digest of a repr-stable structure (node sigs
     are tuples of strings/ints/frozen dataclasses — repr is canonical)."""
@@ -159,8 +164,9 @@ def _abstract_sharded_avals(nodes, epoch_events: int, mesh):
     import jax
     import jax.numpy as jnp
     from .fused import MVKeyedNode
+    from ..parallel.mesh import data_shards
     from .shard_exec import exchange_apply, sds_sharded, sharded_apply
-    n = mesh.devices.size
+    n = data_shards(mesh)
 
     def lift_sds(tree):
         return jax.tree_util.tree_map(
@@ -557,7 +563,8 @@ class CompileService:
                     self.cache_hits += 1
                 rec = {"label": ent.label, "s": round(ent.seconds, 3)}
                 if ent.mesh is not None:
-                    rec["shards"] = int(ent.mesh.devices.size)
+                    from ..parallel.mesh import data_shards
+                    rec["shards"] = data_shards(ent.mesh)
                 self._manifest["keys"][ent.digest] = rec
                 self._manifest_dirty = True
             # flush now (cheap, small json): a process that dies mid-run
@@ -627,7 +634,7 @@ class CompileService:
         return [{"label": e.label, "bucket": repr(e.bucket),
                  "state": e.status if job is None else e.state_for(job),
                  "kind": e.kind, "s": round(e.seconds, 3),
-                 "shards": (int(e.mesh.devices.size)
+                 "shards": (_data_shards(e.mesh)
                             if e.mesh is not None else 1),
                  "cache_hit": e.cache_hit, "error": e.error}
                 for e in sorted(ents, key=lambda e: e.label)]

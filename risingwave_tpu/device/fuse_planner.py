@@ -584,7 +584,8 @@ def try_fuse(execu, ns, device_cfg, name: str,
             # send bucket sized from the epoch cadence; overflow rides
             # the "exch" stat into the normal grow+replay path
             from .capacity import exchange_cap
-            n = mesh.devices.size
+            from ..parallel.mesh import data_shards
+            n = data_shards(mesh)
             cap0 = exchange_cap(ee, n)
             for node in f.nodes:
                 if node.shard_spec().exchanges:
@@ -679,8 +680,9 @@ def try_fuse(execu, ns, device_cfg, name: str,
                     recipes = (rl, rr) \
                         if rl is not None and rr is not None else ()
                     tier_plans.append(TierPlan(j, "join", recipes))
+        from ..parallel.mesh import data_shards
         ph = plan_shape_hash(program.nodes, program.epoch_events,
-                             mesh.devices.size if mesh is not None else 1)
+                             data_shards(mesh) if mesh is not None else 1)
         hints = (cap_registry or {}).get(ph) or {}
         if hints:
             # structural shape keys must match exactly: a hint from a
@@ -812,13 +814,24 @@ def _fused_mesh(device_cfg, epoch_events: int):
     the tail block is PADDED (the over-generated ids mask out inside the
     traced step, `shard_exec.sharded_apply`), so all chips engage at any
     cadence."""
+    import os
     n = max(1, int(getattr(device_cfg, "mesh_shards", 1) or 1))
     if n <= 1:
         return None
+    r = os.environ.get("RW_MESH_REPLICAS")
+    r = int(r) if r else max(1, int(getattr(device_cfg, "replicas", 1) or 1))
     from ..parallel.mesh import make_mesh
     try:
-        return make_mesh(n)
+        return make_mesh(n, replicas=r)
     except (ValueError, RuntimeError):
+        if r > 1:
+            # not enough devices for the replica grid: keep the data
+            # parallelism (correctness and capacity shapes key on it)
+            # and drop only the serving replicas
+            try:
+                return make_mesh(n)
+            except (ValueError, RuntimeError):
+                return None
         return None
 
 

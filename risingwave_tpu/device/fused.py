@@ -2215,7 +2215,8 @@ class FusedJob:
         from ..utils.profile import JobProfiler
         self.name = name
         self.program = program
-        self.mesh_shards = (program.mesh.devices.size
+        from ..parallel.mesh import data_shards
+        self.mesh_shards = (data_shards(program.mesh)
                             if program.mesh is not None else 1)
         # epoch-timeline profiler: phase-split spans + compile events
         # (utils/profile.py). Every node's first step is a cold compile.
@@ -2867,18 +2868,23 @@ class FusedJob:
             mkeys = np.full((shards, L), EMPTY_KEY, np.int64)
             mvals = [np.zeros((shards, L), d) for d in mdt]
         for s, h in enumerate(hits):
-            for j, k in enumerate(h):
-                vals, tch = store.rows[s].pop(k)
-                pkeys[s, j] = k
-                ptouch[s, j] = tch
-                for c, v in enumerate(vals):
-                    pvals[c][s, j] = v
-                if mvstore is not None:
-                    mrow = mvstore.rows[s].pop(k, None)
-                    if mrow is not None:
-                        mkeys[s, j] = k
-                        for c, v in enumerate(mrow):
-                            mvals[c][s, j] = v
+            if not h:
+                continue
+            # arena gather: one fancy-index slice per payload column
+            hk = np.asarray(h, np.int64)
+            m = len(hk)
+            vcols, tchs = store.take_agg_rows(s, hk)
+            pkeys[s, :m] = hk
+            ptouch[s, :m] = tchs
+            for c in range(len(vdt)):
+                pvals[c][s, :m] = vcols[c]
+            if mvstore is not None:
+                mf, mcols = mvstore.take_flat_rows(s, hk)
+                if mf.any():
+                    idx = np.nonzero(mf)[0]
+                    mkeys[s, idx] = hk[mf]
+                    for c in range(len(mdt)):
+                        mvals[c][s, idx] = mcols[c]
         tm.counters["promotions"] += nhit
 
         def shp(a):
@@ -2916,26 +2922,29 @@ class FusedJob:
             store = tm.store(i, side)
             sd = tstate.inner[side]
             vdt = [np.dtype(v.dtype) for v in sd.vals]
-            rows_by_shard = []
+            per_shard = []
             for s in range(shards):
-                rows = []
-                for k in sorted(self._probe_counters(store, s, cand)):
-                    rows.extend((k,) + r for r in store.rows[s].pop(k))
-                rows_by_shard.append(rows)
-            L = _pad_pow2(max(len(r) for r in rows_by_shard))
+                ks = sorted(self._probe_counters(store, s, cand))
+                per_shard.append(store.take_join_rows(s, ks))
+            L = _pad_pow2(max(len(t[0]) for t in per_shard))
             jk = np.full((shards, L), EMPTY_KEY, np.int64)
             pk = np.full((shards, L), EMPTY_KEY, np.int64)
             vals = [np.zeros((shards, L), d) for d in vdt]
             tch = np.zeros((shards, L), np.int64)
-            for s, rows in enumerate(rows_by_shard):
-                rows.sort(key=lambda r: (r[0], r[1]))
-                for j, (rjk, rpk, rvals, rt) in enumerate(rows):
-                    jk[s, j] = rjk
-                    pk[s, j] = rpk
-                    tch[s, j] = rt
-                    for c, v in enumerate(rvals):
-                        vals[c][s, j] = v
-                total += len(rows)
+            for s, (sjk, spk, svals, stch) in enumerate(per_shard):
+                m = len(sjk)
+                if not m:
+                    continue
+                # (jk, pk) is a unique pair identity: lexsort == the
+                # old per-row stable sort, arena gather is one
+                # fancy-index slice per column
+                order = np.lexsort((spk, sjk))
+                jk[s, :m] = sjk[order]
+                pk[s, :m] = spk[order]
+                tch[s, :m] = stch[order]
+                for c in range(len(vdt)):
+                    vals[c][s, :m] = svals[c][order]
+                total += m
             bufs.append((jk, pk, tuple(vals), tch))
         if not total:
             return
@@ -3065,10 +3074,13 @@ class FusedJob:
             dts = self._lead(jax.device_get(dtouch))
             store = tm.store(i, -1)
             for s in range(shards):
-                for j in np.nonzero(fnd[s])[0]:
-                    store.rows[s][int(dbuf[j])] = (
-                        tuple(v[s, j] for v in dvs), int(dts[s, j]))
-                    stored += 1
+                idx = np.nonzero(fnd[s])[0]
+                if len(idx):
+                    # arena append: one slice-assign per payload column
+                    store.put_agg_rows(s, dbuf[idx],
+                                       [v[s, idx] for v in dvs],
+                                       dts[s, idx])
+                    stored += len(idx)
                 store.rebuild_filter(s)
             if plan.mv_idx is not None:
                 # lockstep MV demotion: the SAME groups leave the
@@ -3084,9 +3096,10 @@ class FusedJob:
                        for v in jax.device_get(list(mdvals))]
                 mstore = tm.store(i, "mv")
                 for s in range(shards):
-                    for j in np.nonzero(mf[s])[0]:
-                        mstore.rows[s][int(dbuf[j])] = tuple(
-                            v[s, j] for v in mdv)
+                    idx = np.nonzero(mf[s])[0]
+                    if len(idx):
+                        mstore.put_flat_rows(s, dbuf[idx],
+                                             [v[s, idx] for v in mdv])
                     # no filter rebuild: the MV store is only ever
                     # probed in lockstep by its agg's hit keys
         else:
@@ -3105,12 +3118,10 @@ class FusedJob:
                 store = tm.store(i, side)
                 for s in range(shards):
                     n = int(nd[s])
-                    for j in range(n):
-                        store.rows[s].setdefault(
-                            int(jks[s, j]), []).append(
-                            (int(pks[s, j]),
-                             tuple(v[s, j] for v in dvs),
-                             int(dts[s, j])))
+                    if n:
+                        store.extend_join_rows(
+                            s, jks[s, :n], pks[s, :n],
+                            [v[s, :n] for v in dvs], dts[s, :n])
                     stored += n
                     store.rebuild_filter(s)
             self._set_state(i, tstate)
@@ -3159,24 +3170,25 @@ class FusedJob:
                 store = tm.stores.get((p.node_idx, "mv"))
         if store is None or not len(store):
             return keys, cols, nulls
-        ck, crows = [], []
-        for d in store.rows:
-            for k, vals in d.items():
-                ck.append(k)
-                crows.append(vals)
+        # arena gather: each shard's demoted rows come back as column
+        # views (no per-key dict walk), cast to the pull's dtypes
+        parts = [store.flat_columns(s) for s in range(len(store.rows))]
+        parts = [(k, cs) for k, cs in parts if len(k)]
         keys = np.asarray(keys)
         cols = [np.asarray(c) for c in cols]
         nulls = [np.asarray(nl) for nl in nulls]
-        ckeys = np.asarray(ck, dtype=np.int64)
+        ckeys = np.concatenate([k for k, _ in parts]).astype(np.int64)
         keys_all = np.concatenate([keys, ckeys])
         order = np.argsort(keys_all, kind="stable")
         ncalls = len(cols)
         out_cols, out_nulls = [], []
         for j in range(ncalls):
-            cc = np.array([r[1 + 2 * j] for r in crows],
-                          dtype=cols[j].dtype)
-            cn = np.array([r[2 + 2 * j] for r in crows],
-                          dtype=nulls[j].dtype)
+            cc = np.concatenate(
+                [cs[1 + 2 * j] for _, cs in parts]).astype(
+                cols[j].dtype, copy=False)
+            cn = np.concatenate(
+                [cs[2 + 2 * j] for _, cs in parts]).astype(
+                nulls[j].dtype, copy=False)
             out_cols.append(np.concatenate([cols[j], cc])[order])
             out_nulls.append(np.concatenate([nulls[j], cn])[order])
         return keys_all[order], out_cols, out_nulls
@@ -3289,6 +3301,12 @@ class FusedJob:
     def _pull_rows(self) -> List[Tuple]:
         import jax
         mesh = self.program.mesh
+        if mesh is None:
+            # mesh pulls count inside merge_*_pull (replica-aware); the
+            # single-chip device_get below is one pull all the same —
+            # the serving cache's coalescing assertion reads one counter
+            from .shard_exec import _count_pull
+            _count_pull()
         if self.pull.kind == "keyed":
             from .materialize import mv_rows
             st = self.states[self.pull.node_idx]
@@ -3358,6 +3376,22 @@ class FusedJob:
                 # _recover_in_place re-raises and the error surfaces
                 self._recover_in_place(e)
         return self._pull_rows()
+
+    def mv_rows_versioned(self) -> Tuple[int, List[Tuple]]:
+        """`mv_rows_now` stamped with the committed epoch it reflects —
+        the serving cache's fill primitive. A pull that loses the race
+        with a barrier commit (another thread advances `committed`
+        mid-pull) could return a torn pre/post-commit mix of shards, so
+        the loop re-reads the epoch around the pull and retries against
+        the new epoch until one pull lands entirely within a commit
+        window. The stamp is the epoch COUNTER (every dispatched epoch
+        changes the MV; commits only seal them), checked alongside
+        `committed` so a mid-pull commit also retries."""
+        while True:
+            c0, e0 = self.counter, self.committed
+            rows = self.mv_rows_now()
+            if self.counter == c0 and self.committed == e0:
+                return int(c0), rows
 
     def _persist_mv(self, epoch: int) -> None:
         """Diff the pulled MV against the last persisted image and write

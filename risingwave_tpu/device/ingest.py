@@ -167,7 +167,11 @@ class HostIngest:
         self.sources = list(sources)          # [(node idx, source)]
         self.epoch_events = int(epoch_events)
         self.mesh = mesh
-        self.n_shards = mesh.devices.size if mesh is not None else 1
+        if mesh is not None:
+            from ..parallel.mesh import data_shards
+            self.n_shards = data_shards(mesh)
+        else:
+            self.n_shards = 1
         self.cap = feed_capacity(epoch_events, self.n_shards)
         self.max_events = max_events
         # per-source PR 14 admission buckets (Database wires them after

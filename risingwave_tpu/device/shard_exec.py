@@ -53,7 +53,8 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ..core.vnode import VNODE_COUNT
-from ..parallel.mesh import SHARD_AXIS, shard_of_vnode, state_sharding
+from ..parallel.mesh import (SHARD_AXIS, data_shards, mesh_replicas,
+                             shard_of_vnode, state_sharding)
 from ..parallel.mesh import shard_map as _shard_map
 
 
@@ -78,7 +79,7 @@ def lift_tree(tree, mesh):
     leading axis). Initial states are identical empty shards, so a
     broadcast IS the correct per-shard initialization."""
     import jax
-    n = mesh.devices.size
+    n = data_shards(mesh)
     sh = state_sharding(mesh)
 
     def lift(x):
@@ -186,7 +187,7 @@ def _exchange_local(mesh, node, xi: int, d, abstract: bool,
     import jax.numpy as jnp
     from ..core.vnode import compute_vnodes_jnp
     from .fused import Delta
-    n = mesh.devices.size
+    n = data_shards(mesh)
     exch = node.exch
     ex = node.shard_spec().exchanges[xi]
     if ex.packed:
@@ -275,7 +276,7 @@ def exchange_apply(mesh, node, xi: int, delta, abstract: bool = False,
 
     if abstract:
         import jax.numpy as jnp
-        n = mesh.devices.size
+        n = data_shards(mesh)
         out, need = _exchange_local(mesh, node, xi, _drop(delta), True,
                                     bounds, hot_keys, hot_side)
         lift = lambda t: jax.tree_util.tree_map(
@@ -443,7 +444,7 @@ def sharded_apply(mesh, node, epoch_events: int, state, ins, extra,
     import jax
     import jax.numpy as jnp
     from .fused import Delta, MVKeyedNode, _nrows
-    n = mesh.devices.size
+    n = data_shards(mesh)
     # ceil-div when the cadence does not split evenly: every shard
     # generates the same-size contiguous event-id block (shapes must be
     # uniform across shards) and the PADDED TAIL — ids at or past
@@ -551,6 +552,55 @@ def sharded_node_step(mesh, node, epoch_events: int, state, ins, extra):
 # ---------------------------------------------------------------------------
 
 
+# serving-tier pull accounting: every host transfer of MV state counts
+# here (the read-cache coalescing assertion — "<= 1 device pull per
+# (MV, epoch) under a 64-reader storm" — is checked against
+# `device_pulls`), and `replica_pulls` records which replica column
+# served each one (chip-parallel SELECT serving: reads round-robin over
+# replicas, so the write path's replica 0 is not the only chip paying
+# host-transfer bandwidth).
+PULL_STATS = {"device_pulls": 0, "replica_pulls": {}}
+_REPLICA_RR = [0]
+
+
+def reset_pull_stats() -> None:
+    PULL_STATS["device_pulls"] = 0
+    PULL_STATS["replica_pulls"] = {}
+
+
+def _count_pull(rep: int = 0) -> None:
+    PULL_STATS["device_pulls"] += 1
+    PULL_STATS["replica_pulls"][rep] = \
+        PULL_STATS["replica_pulls"].get(rep, 0) + 1
+
+
+def replica_device_get(mesh, tree):
+    """`jax.device_get` that spreads reads over the replica axis: on a
+    replicated 2-D mesh the gathered (fully-replicated) result is
+    addressable on every device, so each pull reads its leaves from the
+    devices of one replica column, chosen round-robin. On the classic
+    1-D mesh this IS `jax.device_get` (plus the pull counter)."""
+    import jax
+    r = mesh_replicas(mesh) if mesh is not None else 1
+    if r <= 1:
+        _count_pull(0)
+        return jax.device_get(tree)
+    rep = _REPLICA_RR[0] % r
+    _REPLICA_RR[0] += 1
+    _count_pull(rep)
+    rep_devices = {d.id for d in mesh.devices[:, rep]}
+
+    def read(leaf):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                if s.device.id in rep_devices:
+                    return np.asarray(s.data)
+        return np.asarray(jax.device_get(leaf))
+
+    return jax.tree_util.tree_map(read, tree)
+
+
 _GATHER_JIT = {}
 
 
@@ -606,19 +656,20 @@ def merge_keyed_pull(states, mesh, col_dtypes, live_bound=None):
     back to the two-round-trip host merge — correctness never depends
     on the estimate."""
     import jax
-    n = mesh.devices.size
+    n = data_shards(mesh)
     nc = len(col_dtypes)
     if live_bound:
         from .capacity import bucket
         cap_total = n * states.keys.shape[1]
         m = min(cap_total, bucket(max(1, int(live_bound)), lo=256))
-        total, keys, cols, nulls = jax.device_get(
-            _gather_jit(mesh, "keyed", nc, m)(states))
+        total, keys, cols, nulls = replica_device_get(
+            mesh, _gather_jit(mesh, "keyed", nc, m)(states))
         total = int(total)
         if total <= m:
             return (np.asarray(keys)[:total],
                     [np.asarray(c)[:total] for c in cols],
                     [np.asarray(u)[:total] for u in nulls])
+    _count_pull()
     counts = [int(c) for c in np.asarray(jax.device_get(states.count))]
     # one batched transfer for all shards' live prefixes — per-shard
     # mv_rows pulls would pay n_shards * (1 + 2 * n_cols) host syncs
@@ -647,19 +698,20 @@ def merge_pair_pull(side, mesh, live_bound=None):
     to the 1-shard pull. With `live_bound`, the merge runs in-program
     (ONE device_get — see merge_keyed_pull); a stale bound falls back."""
     import jax
-    n = mesh.devices.size
+    n = data_shards(mesh)
     if live_bound:
         from .capacity import bucket
         cap_total = n * side.jk.shape[1]
         m = min(cap_total, bucket(max(1, int(live_bound)), lo=256))
-        total, vals = jax.device_get(
-            _gather_jit(mesh, "pair", len(side.vals), m)(side))
+        total, vals = replica_device_get(
+            mesh, _gather_jit(mesh, "pair", len(side.vals), m)(side))
         total = int(total)
         if total <= m:
             return total, [np.asarray(v)[:total] for v in vals]
     # counts first, then per-shard LIVE prefixes only — a grown pair
     # capacity must not make every SELECT transfer n_shards x capacity
     # padded rows for each column
+    _count_pull()
     counts = [int(c) for c in np.asarray(jax.device_get(side.count))]
     # one batched transfer for all shards' prefixes — per-slice gets
     # would pay n_shards * (2 + n_cols) host syncs (RTTs on a tunnel)

@@ -203,21 +203,439 @@ def derive_recipe(nodes, node_idx: int, col_idx: Sequence[int],
     return None
 
 
+class _ArenaMap:
+    """Mapping from packed key to a fixed-arity record whose payload
+    lives in preallocated contiguous numpy column arenas (pow2-growable)
+    instead of per-key Python tuples: bulk demotion is one slice-assign
+    per column and bulk promotion gather is one fancy-index slice per
+    column. The mapping protocol (get/set/del/in/len/iter/items) stays
+    for single-key paths, snapshots, and tests that swap in plain
+    dicts.
+
+    `agg=True` presents values as `(vals_tuple, touch)` (the agg cold
+    row shape; touch rides as the LAST arena column); `agg=False`
+    presents the flat tuple (the lockstep-MV shape). Slot order is
+    arena order, not insertion order — every reader either sorts by key
+    or is order-insensitive (filters, snapshots)."""
+
+    __slots__ = ("_agg", "_slot", "_keys", "_cols", "_n")
+
+    def __init__(self, agg: bool):
+        self._agg = agg
+        self._slot: Dict[int, int] = {}
+        self._keys = np.empty(0, np.int64)
+        self._cols: Optional[List[np.ndarray]] = None
+        self._n = 0
+
+    # -- growth ------------------------------------------------------------
+    def _ensure(self, extra: int, proto: Sequence[Any]) -> None:
+        need = self._n + extra
+        if self._cols is None:
+            cap = _pad_pow2(max(need, 1))
+            self._keys = np.empty(cap, np.int64)
+            self._cols = [np.zeros(cap, np.asarray(p).dtype)
+                          for p in proto]
+            return
+        cap = len(self._keys)
+        if need <= cap:
+            return
+        new = _pad_pow2(need)
+        self._keys = np.resize(self._keys, new)
+        self._cols = [np.resize(c, new) for c in self._cols]
+
+    def _flat(self, value) -> Tuple:
+        return tuple(value[0]) + (value[1],) if self._agg \
+            else tuple(value)
+
+    def _value(self, slot: int):
+        row = tuple(c[slot] for c in self._cols)
+        return (row[:-1], int(row[-1])) if self._agg else row
+
+    # -- mapping protocol --------------------------------------------------
+    def __len__(self) -> int:
+        return self._n
+
+    def __bool__(self) -> bool:
+        return self._n > 0
+
+    def __contains__(self, k) -> bool:
+        return k in self._slot
+
+    def __iter__(self):
+        return iter(self._keys[:self._n].tolist())
+
+    def keys(self):
+        return self._keys[:self._n].tolist()
+
+    def items(self):
+        for i in range(self._n):
+            yield int(self._keys[i]), self._value(i)
+
+    def __getitem__(self, k):
+        return self._value(self._slot[k])
+
+    def get(self, k, default=None):
+        s = self._slot.get(k)
+        return default if s is None else self._value(s)
+
+    def __setitem__(self, k, value) -> None:
+        flat = self._flat(value)
+        s = self._slot.get(k)
+        if s is None:
+            self._ensure(1, flat)
+            s = self._n
+            self._n += 1
+            self._slot[k] = s
+            self._keys[s] = k
+        for c, v in zip(self._cols, flat):
+            c[s] = v
+
+    def __delitem__(self, k) -> None:
+        s = self._slot.pop(k)
+        last = self._n - 1
+        if s != last:                      # swap-with-last stays dense
+            mk = int(self._keys[last])
+            self._keys[s] = mk
+            for c in self._cols:
+                c[s] = c[last]
+            self._slot[mk] = s
+        self._n = last
+
+    def pop(self, k, *default):
+        s = self._slot.get(k)
+        if s is None:
+            if default:
+                return default[0]
+            raise KeyError(k)
+        v = self._value(s)
+        del self[k]
+        return v
+
+    # -- bulk (the vectorized tier paths) ----------------------------------
+    def put_many(self, keys: np.ndarray,
+                 cols: Sequence[np.ndarray]) -> None:
+        """Append `len(keys)` NEW rows: one slice-assign per column.
+        Keys already present (never the case under the one-tier
+        invariant, but journal replays are defensive) overwrite via the
+        single-key path."""
+        m = len(keys)
+        if not m:
+            return
+        if any(int(k) in self._slot for k in keys):
+            for j, k in enumerate(keys.tolist()):
+                self[int(k)] = ((tuple(c[j] for c in cols[:-1]),
+                                 cols[-1][j]) if self._agg
+                                else tuple(c[j] for c in cols))
+            return
+        self._ensure(m, [c[:1] for c in cols])
+        n = self._n
+        self._keys[n:n + m] = keys
+        for dst, src in zip(self._cols, cols):
+            dst[n:n + m] = src
+        for j, k in enumerate(keys.tolist()):
+            self._slot[int(k)] = n + j
+        self._n = n + m
+
+    def take_many(self, keys: np.ndarray
+                  ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """Remove `keys` (absent ones skipped) and return
+        (found_mask, gathered columns — found rows only, in `keys`
+        order): ONE fancy-index slice per column, then one masked
+        compaction of the arena."""
+        found = np.array([int(k) in self._slot for k in keys], bool)
+        slots = np.fromiter((self._slot[int(k)]
+                             for k in keys[found]), np.int64,
+                            count=int(found.sum()))
+        out = [c[slots].copy() for c in self._cols] \
+            if self._cols is not None else []
+        if len(slots):
+            keep = np.ones(self._n, bool)
+            keep[slots] = False
+            kept = self._keys[:self._n][keep]
+            m = len(kept)
+            self._keys[:m] = kept
+            for c in self._cols:
+                c[:m] = c[:self._n][keep]
+            self._n = m
+            self._slot = {int(k): i for i, k in enumerate(kept.tolist())}
+        return found, out
+
+
+class _ArenaMultiMap:
+    """The join-side cold tier: packed join key -> MANY (pk, vals,
+    touch) rows, payload in contiguous column arenas (pk and touch ride
+    as the first and last columns). Mapping views materialize per-key
+    row lists (snapshots, restores, tests); the tier paths use the bulk
+    slice APIs."""
+
+    __slots__ = ("_slot", "_jk", "_cols", "_n")
+
+    def __init__(self):
+        self._slot: Dict[int, List[int]] = {}
+        self._jk = np.empty(0, np.int64)
+        self._cols: Optional[List[np.ndarray]] = None
+        self._n = 0
+
+    def _ensure(self, extra: int, proto: Sequence[Any]) -> None:
+        need = self._n + extra
+        if self._cols is None:
+            cap = _pad_pow2(max(need, 1))
+            self._jk = np.empty(cap, np.int64)
+            self._cols = [np.zeros(cap, np.asarray(p).dtype)
+                          for p in proto]
+            return
+        if need <= len(self._jk):
+            return
+        new = _pad_pow2(need)
+        self._jk = np.resize(self._jk, new)
+        self._cols = [np.resize(c, new) for c in self._cols]
+
+    def _rows_of(self, slots: Sequence[int]) -> List[Tuple]:
+        return [(int(self._cols[0][s]),
+                 tuple(c[s] for c in self._cols[1:-1]),
+                 int(self._cols[-1][s])) for s in slots]
+
+    def __len__(self) -> int:
+        return len(self._slot)
+
+    def __bool__(self) -> bool:
+        return bool(self._slot)
+
+    def __contains__(self, k) -> bool:
+        return k in self._slot
+
+    def __iter__(self):
+        return iter(self._slot)
+
+    def keys(self):
+        return self._slot.keys()
+
+    def items(self):
+        for k, slots in self._slot.items():
+            yield k, self._rows_of(slots)
+
+    def __getitem__(self, k) -> List[Tuple]:
+        return self._rows_of(self._slot[k])
+
+    def get(self, k, default=None):
+        slots = self._slot.get(k)
+        return default if slots is None else self._rows_of(slots)
+
+    def __setitem__(self, k, rows: List[Tuple]) -> None:
+        if k in self._slot:
+            self._remove([k])
+        if rows:
+            self.extend_many(
+                np.full(len(rows), int(k), np.int64),
+                np.array([r[0] for r in rows], np.int64),
+                [np.array([r[1][c] for r in rows])
+                 for c in range(len(rows[0][1]))],
+                np.array([r[2] for r in rows], np.int64))
+        else:
+            self._slot[k] = []
+
+    def setdefault(self, k, default):
+        if k not in self._slot:
+            self[k] = default
+        return self[k]
+
+    def pop(self, k, *default):
+        slots = self._slot.get(k)
+        if slots is None:
+            if default:
+                return default[0]
+            raise KeyError(k)
+        rows = self._rows_of(slots)
+        self._remove([k])
+        return rows
+
+    def _remove(self, ks: Sequence[int]) -> None:
+        drop: List[int] = []
+        for k in ks:
+            drop.extend(self._slot.pop(k, []))
+        if not drop:
+            return
+        keep = np.ones(self._n, bool)
+        keep[np.asarray(drop, np.int64)] = False
+        m = int(keep.sum())
+        self._jk[:m] = self._jk[:self._n][keep]
+        for c in self._cols:
+            c[:m] = c[:self._n][keep]
+        self._n = m
+        slot: Dict[int, List[int]] = {}
+        for i, jk in enumerate(self._jk[:m].tolist()):
+            slot.setdefault(int(jk), []).append(i)
+        # keep explicitly-empty keys (setdefault contract)
+        for k, v in self._slot.items():
+            if not v and k not in slot:
+                slot[k] = []
+        self._slot = slot
+
+    # -- bulk --------------------------------------------------------------
+    def extend_many(self, jks: np.ndarray, pks: np.ndarray,
+                    cols: Sequence[np.ndarray],
+                    touch: np.ndarray) -> None:
+        m = len(jks)
+        if not m:
+            return
+        payload = [pks] + list(cols) + [touch]
+        self._ensure(m, [c[:1] for c in payload])
+        n = self._n
+        self._jk[n:n + m] = jks
+        for dst, src in zip(self._cols, payload):
+            dst[n:n + m] = src
+        for j, k in enumerate(jks.tolist()):
+            self._slot.setdefault(int(k), []).append(n + j)
+        self._n = n + m
+
+    def take_groups(self, keys: Sequence[int]
+                    ) -> Tuple[np.ndarray, np.ndarray,
+                               List[np.ndarray], np.ndarray]:
+        """Remove every row of `keys` and return (jk, pk, val columns,
+        touch) concatenated in the given key order (rows of one key in
+        insertion order) — one fancy-index slice per column."""
+        slots: List[int] = []
+        for k in keys:
+            slots.extend(self._slot.get(int(k), []))
+        idx = np.asarray(slots, np.int64)
+        if self._cols is None or not len(idx):
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    [], np.empty(0, np.int64))
+        jk = self._jk[idx].copy()
+        pk = self._cols[0][idx].copy()
+        vals = [c[idx].copy() for c in self._cols[1:-1]]
+        tch = self._cols[-1][idx].copy()
+        self._remove(list(keys))
+        return jk, pk, vals, tch
+
+
 class ColdStore:
-    """Per-node(-side) host tier: one dict per shard (packed key ->
-    payload row) plus an Xor8 negative cache over the shard's demoted
-    key set. The filter is REBUILT on demotion (the key set just
+    """Per-node(-side) host tier: one key-indexed numpy column arena
+    per shard (packed key -> payload row; `_ArenaMap` for agg/MV
+    single-row values, `_ArenaMultiMap` for join multi-row sides) plus
+    an Xor8 negative cache over the shard's demoted key set. Demotion
+    batches append with one slice per column and promotion gathers with
+    one fancy-index per column — no per-key Python dict walk on either
+    tier move. The filter is REBUILT on demotion (the key set just
     changed) and left stale-superset on promotion (a stale positive
-    costs one dict miss; a false negative is impossible). `Xor8.build`
+    costs one index miss; a false negative is impossible). `Xor8.build`
     may return None (construction failure) — the store then degrades
-    to always-probe: every candidate pays the dict lookup, correctness
+    to always-probe: every candidate pays the index lookup, correctness
     unchanged."""
 
-    def __init__(self, n_shards: int):
-        self.rows: List[Dict[int, Tuple]] = [dict()
-                                             for _ in range(n_shards)]
+    def __init__(self, n_shards: int, kind: str = "agg"):
+        self.kind = kind                   # "agg" | "mv" | "join"
+        self.rows: List[Any] = [self._new_map()
+                                for _ in range(n_shards)]
         self.filters: List[Optional[Any]] = [None] * n_shards
         self.filter_live: List[bool] = [False] * n_shards
+
+    def _new_map(self):
+        if self.kind == "join":
+            return _ArenaMultiMap()
+        return _ArenaMap(agg=self.kind == "agg")
+
+    # ---- vectorized tier moves (plain-mapping fallbacks keep the
+    # dict-swapping tests and dict-shaped snapshots working) -----------
+    def put_agg_rows(self, shard: int, keys: np.ndarray,
+                     val_cols: Sequence[np.ndarray],
+                     touch: np.ndarray) -> None:
+        m = self.rows[shard]
+        if isinstance(m, _ArenaMap):
+            m.put_many(np.asarray(keys, np.int64),
+                       list(val_cols) + [np.asarray(touch, np.int64)])
+        else:
+            for j, k in enumerate(np.asarray(keys).tolist()):
+                m[int(k)] = (tuple(c[j] for c in val_cols),
+                             int(touch[j]))
+
+    def take_agg_rows(self, shard: int, keys: np.ndarray
+                      ) -> Tuple[List[np.ndarray], np.ndarray]:
+        """All keys must be present (they came from `probe`)."""
+        m = self.rows[shard]
+        keys = np.asarray(keys, np.int64)
+        if isinstance(m, _ArenaMap):
+            _f, cols = m.take_many(keys)
+            return cols[:-1], cols[-1]
+        rows = [m.pop(int(k)) for k in keys]
+        ncols = len(rows[0][0]) if rows else 0
+        return ([np.array([r[0][c] for r in rows])
+                 for c in range(ncols)],
+                np.array([r[1] for r in rows], np.int64))
+
+    def put_flat_rows(self, shard: int, keys: np.ndarray,
+                      cols: Sequence[np.ndarray]) -> None:
+        m = self.rows[shard]
+        if isinstance(m, _ArenaMap):
+            m.put_many(np.asarray(keys, np.int64), list(cols))
+        else:
+            for j, k in enumerate(np.asarray(keys).tolist()):
+                m[int(k)] = tuple(c[j] for c in cols)
+
+    def take_flat_rows(self, shard: int, keys: np.ndarray
+                       ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """(found mask, columns of the found rows in `keys` order) —
+        absent keys are skipped (the lockstep MV store holds a SUBSET
+        of its agg's demoted keys)."""
+        m = self.rows[shard]
+        keys = np.asarray(keys, np.int64)
+        if isinstance(m, _ArenaMap):
+            return m.take_many(keys)
+        found = np.array([int(k) in m for k in keys], bool)
+        rows = [m.pop(int(k)) for k in keys[found]]
+        ncols = len(rows[0]) if rows else 0
+        return found, [np.array([r[c] for r in rows])
+                       for c in range(ncols)]
+
+    def flat_columns(self, shard: int
+                     ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """Zero-copy view of one shard's (keys, payload columns) — the
+        SELECT-time cache-fill gather of demoted MV rows."""
+        m = self.rows[shard]
+        if isinstance(m, _ArenaMap):
+            n = m._n
+            if not n or m._cols is None:
+                return np.empty(0, np.int64), []
+            return m._keys[:n], [c[:n] for c in m._cols]
+        ks = list(m.keys())
+        rows = [m[k] for k in ks]
+        ncols = len(rows[0]) if rows else 0
+        return (np.asarray(ks, np.int64),
+                [np.array([r[c] for r in rows]) for c in range(ncols)])
+
+    def extend_join_rows(self, shard: int, jks: np.ndarray,
+                         pks: np.ndarray,
+                         val_cols: Sequence[np.ndarray],
+                         touch: np.ndarray) -> None:
+        m = self.rows[shard]
+        if isinstance(m, _ArenaMultiMap):
+            m.extend_many(np.asarray(jks, np.int64),
+                          np.asarray(pks, np.int64), list(val_cols),
+                          np.asarray(touch, np.int64))
+        else:
+            for j in range(len(jks)):
+                m.setdefault(int(jks[j]), []).append(
+                    (int(pks[j]), tuple(c[j] for c in val_cols),
+                     int(touch[j])))
+
+    def take_join_rows(self, shard: int, keys: Sequence[int]
+                       ) -> Tuple[np.ndarray, np.ndarray,
+                                  List[np.ndarray], np.ndarray]:
+        m = self.rows[shard]
+        if isinstance(m, _ArenaMultiMap):
+            return m.take_groups(keys)
+        rows: List[Tuple] = []
+        for k in keys:
+            rows.extend((int(k),) + r for r in m.pop(int(k)))
+        if not rows:
+            return (np.empty(0, np.int64), np.empty(0, np.int64),
+                    [], np.empty(0, np.int64))
+        nvals = len(rows[0][2])
+        return (np.array([r[0] for r in rows], np.int64),
+                np.array([r[1] for r in rows], np.int64),
+                [np.array([r[2][c] for r in rows])
+                 for c in range(nvals)],
+                np.array([r[3] for r in rows], np.int64))
 
     def __len__(self) -> int:
         return sum(len(d) for d in self.rows)
@@ -262,7 +680,13 @@ class ColdStore:
 
     def restore(self, snap) -> None:
         rows, filters, live = snap
-        self.rows = [dict(d) for d in rows]
+        new = []
+        for d in rows:
+            m = self._new_map()
+            for k, v in d.items():
+                m[k] = v
+            new.append(m)
+        self.rows = new
         self.filters = list(filters)
         self.filter_live = list(live)
 
@@ -306,13 +730,16 @@ class TieringManager:
         self.stores: Dict[Tuple[int, Any], ColdStore] = {}
         for p in self.plans:
             if p.kind == "agg":
-                self.stores[(p.node_idx, -1)] = ColdStore(self.n_shards)
+                self.stores[(p.node_idx, -1)] = ColdStore(self.n_shards,
+                                                          "agg")
                 if p.mv_idx is not None:
                     self.stores[(p.node_idx, "mv")] = \
-                        ColdStore(self.n_shards)
+                        ColdStore(self.n_shards, "mv")
             else:
-                self.stores[(p.node_idx, 0)] = ColdStore(self.n_shards)
-                self.stores[(p.node_idx, 1)] = ColdStore(self.n_shards)
+                self.stores[(p.node_idx, 0)] = ColdStore(self.n_shards,
+                                                         "join")
+                self.stores[(p.node_idx, 1)] = ColdStore(self.n_shards,
+                                                         "join")
         # journal: ordered (counter, node_idx, side, [keys]) of ENACTED
         # demotions; the file is the restart-durable mirror
         self.journal: List[Tuple[int, int, Any, List[int]]] = []
@@ -333,7 +760,7 @@ class TieringManager:
 
     def reset_stores(self) -> None:
         for key, s in self.stores.items():
-            self.stores[key] = ColdStore(self.n_shards)
+            self.stores[key] = ColdStore(self.n_shards, s.kind)
         self.pending.clear()
 
     def snapshot(self):

@@ -18,6 +18,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core.vnode import VNODE_COUNT
 
 SHARD_AXIS = "shard"
+# Serving replicas: a second, named mesh axis. State PartitionSpecs only
+# ever name SHARD_AXIS, and jax replicates over any mesh axis a spec
+# does not mention — so the same P("shard") specs shard vnode blocks
+# over the data axis and mirror them across replicas with zero operator
+# changes. Collectives (all_to_all/psum/pmax) also name only SHARD_AXIS,
+# which scopes them to the per-replica data group.
+REPLICA_AXIS = "replica"
 
 # jax moved shard_map out of experimental at 0.5; support both
 try:
@@ -27,31 +34,57 @@ except AttributeError:                     # jax < 0.5
 
 
 def make_mesh(n_devices: Optional[int] = None,
-              devices: Optional[Sequence] = None) -> Mesh:
-    """1-D mesh over the shard axis. Multi-host meshes come from passing the
-    global device list; the shape is (n,) either way — streaming dataflow
-    parallelism is one-dimensional (vnodes), unlike ML TP x DP grids.
+              devices: Optional[Sequence] = None,
+              replicas: int = 1) -> Mesh:
+    """Mesh over the shard axis, optionally times a replica axis.
+
+    `replicas=1` builds the exact 1-D `(n,)` mesh the engine has always
+    used — same devices, same axis tuple — so every existing program
+    lowers byte-for-byte identically. `replicas=r > 1` asks for
+    `n_devices * r` devices and shapes them `(n_devices, r)` with axes
+    `(shard, replica)`: device [d, k] holds data-shard d of replica k.
 
     When the default platform has fewer devices than requested (one real TPU
     chip but an 8-shard dry run), fall back to the CPU backend, which serves
     virtual devices under --xla_force_host_platform_device_count."""
+    replicas = max(1, int(replicas))
+    want = None if n_devices is None else int(n_devices) * replicas
     if devices is None:
         devices = jax.devices()
-        if n_devices is not None:
-            if len(devices) < n_devices:
+        if want is not None:
+            if len(devices) < want:
                 try:
                     cpu = jax.devices("cpu")
                 except RuntimeError:
                     cpu = []
-                if len(cpu) >= n_devices:
+                if len(cpu) >= want:
                     devices = cpu
-            if len(devices) < n_devices:
+            if len(devices) < want:
                 raise ValueError(
-                    f"need {n_devices} devices but only {len(devices)} exist "
+                    f"need {want} devices but only {len(devices)} exist "
                     "(set XLA_FLAGS=--xla_force_host_platform_device_count=N "
                     "before jax initializes to get virtual CPU devices)")
-            devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+            devices = devices[:want]
+    devices = np.asarray(devices)
+    if replicas == 1:
+        return Mesh(devices, (SHARD_AXIS,))
+    if devices.size % replicas:
+        raise ValueError(
+            f"{devices.size} devices do not divide into {replicas} replicas")
+    return Mesh(devices.reshape(devices.size // replicas, replicas),
+                (SHARD_AXIS, REPLICA_AXIS))
+
+
+def data_shards(mesh: Mesh) -> int:
+    """Size of the vnode-partition (data) axis. Equals `devices.size` on
+    the classic 1-D mesh; on a replicated 2-D mesh it is the per-replica
+    shard count — the number every capacity/exchange/stat shape keys on."""
+    return int(mesh.shape[SHARD_AXIS])
+
+
+def mesh_replicas(mesh: Mesh) -> int:
+    """Replica-axis size (1 on the classic 1-D mesh)."""
+    return int(mesh.shape.get(REPLICA_AXIS, 1))
 
 
 def vnode_block_bounds(n_shards: int, vnode_count: int = VNODE_COUNT

@@ -475,14 +475,20 @@ class _Conn:
                 # epoch loop. Counted BEFORE the lock so queued waiters
                 # consume admission slots too.
                 gate = getattr(self.db, "select_gate", None)
-                held = gate.enter() if gate is not None else False
+                sid = id(self)
+                held = gate.enter(session=sid) if gate is not None \
+                    else False
                 try:
                     with self.lock:
-                        rows = self.db._run_batch_select(stmt)
+                        # serving=True: a SELECT that reads only fused
+                        # MVs skips the per-statement flush and serves
+                        # from the epoch-versioned read cache
+                        rows = self.db._run_batch_select(stmt,
+                                                         serving=True)
                         desc = getattr(self.db, "last_description", [])
                 finally:
                     if held:
-                        gate.leave()
+                        gate.leave(session=sid)
                 if not suppress_desc:
                     self._row_description(desc)
                 self._data_rows(rows, [d.kind for _, d in desc])
@@ -670,16 +676,19 @@ class _Conn:
             stmt = stmts[0]
             if isinstance(stmt, (A.Select, A.SetOp)):
                 gate = getattr(self.db, "select_gate", None)
+                sid = id(self)
                 # SQLSTATE 53000 past the bound; False = gate disabled
-                held = gate.enter() if gate is not None else False
+                held = gate.enter(session=sid) if gate is not None \
+                    else False
                 try:
                     with self.lock:
-                        portal["rows"] = self.db._run_batch_select(stmt)
+                        portal["rows"] = self.db._run_batch_select(
+                            stmt, serving=True)
                         portal["desc"] = getattr(self.db,
                                                  "last_description", [])
                 finally:
                     if held:
-                        gate.leave()
+                        gate.leave(session=sid)
             else:
                 with self.lock:
                     result = self.db._execute(stmt)

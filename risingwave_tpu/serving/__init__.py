@@ -1,0 +1,9 @@
+"""Read-serving tier: host-side epoch-versioned MV snapshot caches.
+
+The write path (fused epoch programs over the device mesh) publishes
+state once per checkpoint; this package makes the READ path scale
+independently of it — see `read_cache.MVReadCache`.
+"""
+from .read_cache import MVReadCache
+
+__all__ = ["MVReadCache"]

@@ -224,6 +224,13 @@ class Database:
             list(ShedLog.PK)))
         self._overload = OverloadManager()
         self.select_gate = SelectGate()
+        # serving tier (serving/read_cache.py): host-side epoch-versioned
+        # MV snapshots — pgwire SELECTs over fused MVs serve from here,
+        # one device pull per (MV, epoch) no matter how many readers.
+        # Starts cold (restart/recovery included): the first read after
+        # any commit repopulates.
+        from ..serving import MVReadCache
+        self.read_cache = MVReadCache()
         self._replaying = False
         self._recover_catalog()
 
@@ -235,8 +242,13 @@ class Database:
         ms = getattr(self.device, "mesh_shards", 1) or 1
         if self.device.mesh is None and ms > 1:
             # mesh-sharded FUSED programs: state layouts are per-shard,
-            # so a reopen must shard identically
+            # so a reopen must shard identically. Replicas MIRROR state
+            # (layouts unchanged) but the marker still records them —
+            # reopen policy checks must be exact, not merely compatible.
             mode += ":fshard%d" % ms
+            reps = getattr(self.device, "replicas", 1) or 1
+            if reps > 1:
+                mode += ":rep%d" % reps
         return mode + (":minmax" if self.device.minmax else "")
 
     @staticmethod
@@ -261,9 +273,13 @@ class Database:
             parts = parts[:-1]
         if parts[0] == "single":
             ms = 1
+            reps = 1
             if len(parts) > 1 and parts[1].startswith("fshard"):
                 ms = int(parts[1][len("fshard"):])
-            return DeviceConfig(minmax=minmax, mesh_shards=ms)
+            if len(parts) > 2 and parts[2].startswith("rep"):
+                reps = int(parts[2][len("rep"):])
+            return DeviceConfig(minmax=minmax, mesh_shards=ms,
+                                replicas=reps)
         from ..parallel import make_mesh
         return DeviceConfig(mesh=make_mesh(int(parts[1])), minmax=minmax)
 
@@ -1041,6 +1057,7 @@ class Database:
         self._iters.pop(stmt.name, None)
         self._freshness.forget(stmt.name)
         self._overload.forget(stmt.name)
+        self.read_cache.invalidate(stmt.name)
         dropped_job = self._fused.pop(stmt.name, None)
         if dropped_job is not None:
             if getattr(dropped_job, "ingest", None) is not None:
@@ -1566,7 +1583,10 @@ class Database:
             obj = self.catalog.get(name)
             job = (obj.runtime or {}).get("fused_job")
             if job is not None:
-                rows = job.mv_rows_now()   # sync + pull the CURRENT device MV
+                # sync + pull the CURRENT device MV, through the serving
+                # cache (a fresh snapshot is a host-memory hit; misses
+                # coalesce onto one device pull)
+                rows = self._serve_mv_rows(name, job)
             elif obj.runtime.get("state_table") is None:
                 raise ValueError(
                     f"source {name!r} is not directly queryable (sources "
@@ -1619,7 +1639,60 @@ class Database:
             out = out[: q.limit]
         return [r[:n_vis] for r in out]
 
-    def _run_batch_select(self, q) -> List[Tuple]:
+    def _serve_mv_rows(self, name: str, job) -> List[Tuple]:
+        """Fused-MV rows through the serving cache: a snapshot stamped
+        at the job's current epoch counter is a host-memory hit;
+        misses fill through `mv_rows_versioned` (torn-pull-safe) with
+        concurrent readers coalesced onto the single device pull."""
+        from ..config import ROBUSTNESS
+        if not ROBUSTNESS.serving_cache:
+            return job.mv_rows_now()
+        # the version stamp (`job.counter`) is an EVENT count; the knob
+        # is in fused epochs — convert so `rw_serving_staleness_epochs=2`
+        # tolerates two dispatched epochs, whatever their event budget
+        staleness = max(0, int(ROBUSTNESS.serving_staleness_epochs)) \
+            * max(1, int(getattr(job.program, "epoch_events", 1) or 1))
+        _, rows = self.read_cache.get(
+            name, int(job.counter), staleness, job.mv_rows_versioned)
+        return rows
+
+    def _serving_mvs(self, ref) -> Optional[List[str]]:
+        """Names of the fused MVs a FROM tree reads, or None when any
+        base relation is NOT a fused MV (host tables, sources, system
+        tables, table functions: all ineligible for cache serving)."""
+        if isinstance(ref, A.NamedTable):
+            obj = self.catalog.objects.get(ref.name)
+            rt = obj.runtime if obj is not None else None
+            job = rt.get("fused_job") if isinstance(rt, dict) else None
+            return [ref.name] if job is not None else None
+        if isinstance(ref, A.Join):
+            left = self._serving_mvs(ref.left)
+            right = self._serving_mvs(ref.right)
+            return left + right \
+                if left is not None and right is not None else None
+        if isinstance(ref, (A.WindowTable, A.TemporalTable)):
+            return self._serving_mvs(ref.inner)
+        if isinstance(ref, A.SubqueryTable):
+            return self._serving_mvs(ref.query.from_) \
+                if ref.query.from_ is not None else None
+        return None
+
+    def _serving_skip_flush(self, q, serving: bool) -> bool:
+        """Whether a pgwire SELECT may skip the per-statement flush and
+        serve from the read cache. Only the serving front door opts in
+        (`serving=True`); embedded `Database.query` keeps the flush so
+        its SELECT-advances-the-stream semantics are untouched. The
+        SELECT must read only fused MVs, and at least one checkpoint
+        must have committed (a cold engine still flushes once)."""
+        from ..config import ROBUSTNESS
+        if not serving or not ROBUSTNESS.serving_cache:
+            return False
+        if getattr(q, "from_", None) is None:
+            return False
+        return self.epoch_committed > 0 \
+            and self._serving_mvs(q.from_) is not None
+
+    def _run_batch_select(self, q, serving: bool = False) -> List[Tuple]:
         # SELECT without FROM: evaluate constant expressions
         if isinstance(q, A.SetOp):
             return self._run_batch_setop(q)
@@ -1629,7 +1702,8 @@ class Database:
                 (it.alias or "?column?", _const_dtype(v))
                 for it, v in zip(q.items, row)]
             return [row]
-        self.flush(1)
+        if not self._serving_skip_flush(q, serving):
+            self.flush(1)
         inj = BarrierInjector()
         subscribe = self._batch_subscribe(inj)
         # plan without limit/order; ORDER BY columns ride along as hidden

@@ -154,6 +154,16 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("filter_probes", T.INT64), ("filter_hits", T.INT64),
                   ("filter_fallbacks", T.INT64)),
         lambda db: _state_tiering(db)),
+    # serving-tier read cache (serving/read_cache.py): one row per
+    # cached fused MV — the snapshot's epoch stamp and row count plus
+    # the hit/miss/coalesced/fill counters that prove the one-pull-per-
+    # (MV, epoch) invariant is holding in production
+    "rw_serving_cache": (
+        Schema.of(("mv", T.VARCHAR), ("cache_epoch", T.INT64),
+                  ("cached_rows", T.INT64), ("hits", T.INT64),
+                  ("misses", T.INT64), ("coalesced", T.INT64),
+                  ("fills", T.INT64)),
+        lambda db: list(db.read_cache.report())),
     # poison-pill dead-letter queue (fault-tolerance v3): one row per
     # input record the supervisor sidelined after bounded respawns kept
     # dying on the same retained window. The full audit trail of the

@@ -358,43 +358,68 @@ class ShedLog:
 
 
 class SelectGate:
-    """Concurrency bound on front-door SELECTs. `enter()` raises
-    `AdmissionRejected` (SQLSTATE 53000) when `RW_SELECT_CONCURRENCY`
-    statements are already in flight — a clean, immediate refusal
-    instead of an unbounded queue on the coordinator lock; it returns
-    True when the caller holds a slot (pair with `leave()`) and False
-    when the gate is disabled (`RW_SELECT_CONCURRENCY <= 0`, the repo's
-    knob-off convention). The embedding process's own `Database.query`
-    API is never gated (the operator's local tooling must always
-    work)."""
+    """Concurrency bound on front-door SELECTs, with per-session
+    fairness. `enter()` raises `AdmissionRejected` (SQLSTATE 53000)
+    when `RW_SELECT_CONCURRENCY` statements are already in flight OR
+    the calling session already holds `RW_SELECT_PER_SESSION` slots —
+    token accounting, so one chatty pgwire session exhausts its own
+    slice long before it can starve the shared budget (the PR 14
+    "per-process, not per-session" residual). A clean, immediate
+    refusal instead of an unbounded queue on the coordinator lock;
+    `enter()` returns True when the caller holds a slot (pair with
+    `leave()`) and False when the gate is disabled
+    (`RW_SELECT_CONCURRENCY <= 0`, the repo's knob-off convention —
+    `RW_SELECT_PER_SESSION <= 0` likewise disables only the per-session
+    cap). The embedding process's own `Database.query` API is never
+    gated (the operator's local tooling must always work)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self.active = 0
         self.rejected = 0
+        self.session_active: dict = {}
 
-    def enter(self) -> bool:
+    def _reject(self, why: str) -> None:
+        self.rejected += 1
+        from .metrics import REGISTRY
+        REGISTRY.counter(
+            "select_admission_rejected_total",
+            "front-door SELECTs refused at the concurrency "
+            "bound (SQLSTATE 53000)").inc()
+        raise AdmissionRejected(why)
+
+    def enter(self, session=None) -> bool:
         limit = ROBUSTNESS.select_concurrency
         if limit <= 0:
             return False
+        per = ROBUSTNESS.select_per_session
         with self._lock:
             if self.active >= limit:
-                self.rejected += 1
-                from .metrics import REGISTRY
-                REGISTRY.counter(
-                    "select_admission_rejected_total",
-                    "front-door SELECTs refused at the concurrency "
-                    "bound (SQLSTATE 53000)").inc()
-                raise AdmissionRejected(
+                self._reject(
                     f"too many concurrent SELECTs "
                     f"(RW_SELECT_CONCURRENCY={limit}); retry when "
                     "in-flight queries drain")
+            if session is not None and per > 0 \
+                    and self.session_active.get(session, 0) >= per:
+                self._reject(
+                    f"session holds its full SELECT slice "
+                    f"(RW_SELECT_PER_SESSION={per}); retry when this "
+                    "session's in-flight queries drain")
             self.active += 1
+            if session is not None:
+                self.session_active[session] = \
+                    self.session_active.get(session, 0) + 1
         return True
 
-    def leave(self) -> None:
+    def leave(self, session=None) -> None:
         with self._lock:
             self.active = max(0, self.active - 1)
+            if session is not None:
+                n = self.session_active.get(session, 0) - 1
+                if n > 0:
+                    self.session_active[session] = n
+                else:
+                    self.session_active.pop(session, None)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,507 @@
+"""Serving tier (ISSUE 19): epoch-versioned MV read cache, replica mesh
+axis, chip-parallel SELECT serving.
+
+The contract under test:
+
+* `serving/read_cache.py` — one device pull per (MV, epoch) regardless
+  of reader count (single-flight coalescing, asserted against
+  `shard_exec.PULL_STATS`), staleness-bounded serving
+  (`rw_serving_staleness_epochs`), cold start after restart/recovery.
+* `FusedJob.mv_rows_versioned` — a pull torn by a racing commit retries
+  until it brackets one consistent (epoch, rows).
+* `SelectGate` per-session token accounting — one chatty session
+  exhausts its own slice (SQLSTATE 53000) without starving others.
+* Replica mesh axis — `DeviceConfig.replicas=2` lowers the SAME fused
+  program onto a (shard, replica) 2-D mesh, state mirrored over the
+  replica axis, and is BIT-IDENTICAL (row order included) to the 1-D
+  replicas=1 mesh on q1/q3/q5-shaped plans; reads round-robin over
+  replica columns.
+
+The conftest forces 8 virtual CPU devices, so the 2-D runs use
+shards=4 x replicas=2.
+"""
+import threading
+import time
+
+import pytest
+
+from risingwave_tpu.config import ROBUSTNESS, DeviceConfig
+from risingwave_tpu.device import shard_exec
+from risingwave_tpu.serving import MVReadCache
+from risingwave_tpu.sql import Database
+from risingwave_tpu.utils import failpoint as fp
+from risingwave_tpu.utils.overload import AdmissionRejected, SelectGate
+
+# one event bound for every run in this file: the traced programs embed
+# max_events, so a single N means each mesh config compiles its program
+# set ONCE for the whole module (tier-1 budget)
+N = 8192
+CHUNK = 32          # fused epoch = 64 * CHUNK = 2048 events
+TICKS = N // 2048 + 3
+
+BID_SRC = ("CREATE SOURCE bid (auction BIGINT, bidder BIGINT, price BIGINT,"
+           " channel VARCHAR, url VARCHAR, date_time TIMESTAMP,"
+           " extra VARCHAR) WITH (connector='nexmark',"
+           " nexmark.table='bid', nexmark.max.events='{n}',"
+           " nexmark.chunk.size='{c}')")
+AUCTION_SRC = ("CREATE SOURCE auction (id BIGINT, item_name VARCHAR,"
+               " description VARCHAR, initial_bid BIGINT, reserve BIGINT,"
+               " date_time TIMESTAMP, expires TIMESTAMP, seller BIGINT,"
+               " category BIGINT, extra VARCHAR) WITH (connector='nexmark',"
+               " nexmark.table='auction', nexmark.max.events='{n}',"
+               " nexmark.chunk.size='{c}')")
+
+Q1_MV = ("CREATE MATERIALIZED VIEW q1a AS SELECT bidder,"
+         " count(*) AS n, sum(price) AS dol, max(price) AS top"
+         " FROM bid GROUP BY bidder")
+Q3_MV = ("CREATE MATERIALIZED VIEW q3a AS SELECT b.auction, b.price,"
+         " a.seller, a.category FROM bid b JOIN auction a"
+         " ON b.auction = a.id WHERE b.price > 500")
+Q5_MV = """CREATE MATERIALIZED VIEW q5 AS
+SELECT AuctionBids.auction, AuctionBids.num FROM (
+    SELECT bid.auction, count(*) AS num, window_start AS starttime
+    FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+    GROUP BY window_start, bid.auction
+) AS AuctionBids
+JOIN (
+    SELECT max(CountBids.num) AS maxn, CountBids.starttime_c
+    FROM (
+        SELECT count(*) AS num, window_start AS starttime_c
+        FROM HOP(bid, date_time, INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+        GROUP BY bid.auction, window_start
+    ) AS CountBids
+    GROUP BY CountBids.starttime_c
+) AS MaxBids
+ON AuctionBids.starttime = MaxBids.starttime_c
+   AND AuctionBids.num >= MaxBids.maxn"""
+
+_KNOBS = ("select_concurrency", "select_per_session", "serving_cache",
+          "serving_staleness_epochs")
+
+
+@pytest.fixture(autouse=True)
+def _restore_knobs():
+    saved = {k: getattr(ROBUSTNESS, k) for k in _KNOBS}
+    fp.reset()
+    shard_exec.reset_pull_stats()
+    yield
+    fp.reset()
+    for k, v in saved.items():
+        setattr(ROBUSTNESS, k, v)
+
+
+def _fused(mv_sql, name, shards=1, srcs=(BID_SRC,), n=N, ticks=None,
+           replicas=1, capacity=512, data_dir=None, sync=True):
+    db = Database(device=DeviceConfig(capacity=capacity,
+                                      mesh_shards=shards,
+                                      replicas=replicas),
+                  data_dir=data_dir)
+    for s in srcs:
+        db.run(s.format(n=n, c=CHUNK))
+    db.run(mv_sql)
+    job = db.catalog.get(name).runtime["fused_job"]
+    assert job is not None, f"{name} must fuse"
+    for _ in range(ticks if ticks is not None else n // 2048 + 3):
+        db.tick()
+    if sync:
+        job.sync()
+    return db, job
+
+
+# ---------------------------------------------------------------------------
+# MVReadCache unit semantics (no device)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_fill_hit_and_staleness_bound():
+    c = MVReadCache()
+    pulls = []
+
+    def fill_at(e):
+        def fill():
+            pulls.append(e)
+            return e, [("rows", e)]
+        return fill
+
+    # cold: miss -> fill at epoch 5
+    assert c.get("mv", 5, 0, fill_at(5)) == (5, [("rows", 5)])
+    # same committed epoch: pure hit, no new pull
+    assert c.get("mv", 5, 0, fill_at(5)) == (5, [("rows", 5)])
+    assert pulls == [5]
+    # commit advances to 7: staleness 0 refills ...
+    assert c.get("mv", 7, 0, fill_at(7)) == (7, [("rows", 7)])
+    assert pulls == [5, 7]
+    # ... staleness 2 would have served the epoch-5 snapshot at 7
+    c2 = MVReadCache()
+    c2.get("mv", 5, 0, fill_at(5))
+    assert c2.get("mv", 7, 2, fill_at(7)) == (5, [("rows", 5)])
+    # but not at 8 (5 < 8 - 2)
+    assert c2.get("mv", 8, 2, fill_at(8)) == (8, [("rows", 8)])
+    # peek never fills
+    assert c2.peek("mv", 8) == [("rows", 8)]
+    assert c2.peek("mv", 9) is None
+    assert c2.peek("other", 0) is None
+    # invalidate -> cold again
+    c2.invalidate("mv")
+    assert c2.peek("mv", 0) is None
+
+
+def test_cache_single_flight_coalesces_concurrent_readers():
+    c = MVReadCache()
+    fills = []
+    gate = threading.Event()
+
+    def slow_fill():
+        fills.append(1)
+        gate.wait(5.0)          # hold all other readers on the cond
+        return 3, [("v",)]
+
+    results = []
+
+    def reader():
+        results.append(c.get("mv", 3, 0, slow_fill))
+
+    threads = [threading.Thread(target=reader) for _ in range(16)]
+    for t in threads:
+        t.start()
+    # let every reader reach the cache before the fill completes
+    deadline = time.time() + 5.0
+    while len(fills) < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.2)
+    gate.set()
+    for t in threads:
+        t.join(10.0)
+    assert len(fills) == 1, "single-flight: exactly one fill"
+    assert results == [(3, [("v",)])] * 16
+    st = c.stats()
+    assert st["fills"] == 1 and st["misses"] == 1
+    assert st["hits"] == 15 and st["coalesced"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a SELECT storm costs one device pull per (MV, epoch)
+# ---------------------------------------------------------------------------
+
+
+def test_select_storm_one_device_pull_per_mv_epoch():
+    """64 readers between two checkpoints -> exactly ONE device pull;
+    the next committed epoch costs exactly one more (the acceptance
+    invariant, counted at the `merge_keyed_pull` device_get)."""
+    db, job = _fused(Q1_MV, "q1a", ticks=2)
+    assert job.counter > 0
+    db.read_cache.invalidate()
+    shard_exec.reset_pull_stats()
+
+    rows_out = []
+
+    def storm():
+        errs = []
+
+        def reader():
+            try:
+                rows_out.append(db._serve_mv_rows("q1a", job))
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+        ts = [threading.Thread(target=reader) for _ in range(64)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60.0)
+        assert not errs
+
+    storm()
+    assert shard_exec.PULL_STATS["device_pulls"] == 1, \
+        "64-reader storm must coalesce onto one device pull"
+    assert len(rows_out) == 64
+    assert all(r == rows_out[0] for r in rows_out)
+    st = db.read_cache.stats()
+    assert st["fills"] == 1 and st["hits"] + st["misses"] == 64
+
+    # drive one more epoch; the counter moves, the old snapshot goes
+    # unservable at staleness 0, and a second storm costs exactly one
+    # more pull
+    c0 = job.counter
+    db.tick()
+    job.sync()
+    assert job.counter > c0
+    shard_exec.reset_pull_stats()
+    rows_out.clear()
+    storm()
+    assert shard_exec.PULL_STATS["device_pulls"] == 1
+    assert all(r == rows_out[0] for r in rows_out)
+
+
+def test_staleness_bound_serves_without_pull():
+    db, job = _fused(Q1_MV, "q1a", ticks=2)
+    # fill the cache at the current counter
+    served = db._serve_mv_rows("q1a", job)
+    c0 = job.counter
+    db.tick()                   # next fused epoch dispatches
+    job.sync()
+    delta = int(job.counter) - int(c0)
+    assert delta >= 1
+    # bounded staleness covers the advance: host-memory hit, zero pulls
+    # (the knob is in fused epochs; delta is in events)
+    e = int(job.program.epoch_events)
+    ROBUSTNESS.serving_staleness_epochs = -(-delta // e)
+    shard_exec.reset_pull_stats()
+    assert db._serve_mv_rows("q1a", job) == served
+    assert shard_exec.PULL_STATS["device_pulls"] == 0
+    # always-fresh refills with exactly one pull
+    ROBUSTNESS.serving_staleness_epochs = 0
+    shard_exec.reset_pull_stats()
+    db._serve_mv_rows("q1a", job)
+    assert shard_exec.PULL_STATS["device_pulls"] == 1
+    assert db.read_cache.report()[0][1] == job.counter
+
+
+def test_serving_cache_knob_off_bypasses_cache():
+    ROBUSTNESS.serving_cache = False
+    db, job = _fused(Q1_MV, "q1a")
+    want = job.mv_rows_now()
+    shard_exec.reset_pull_stats()
+    assert db._serve_mv_rows("q1a", job) == want
+    assert db._serve_mv_rows("q1a", job) == want
+    # no cache: every read pulls
+    assert shard_exec.PULL_STATS["device_pulls"] == 2
+    assert db.read_cache.report() == []
+
+
+def test_drop_mv_invalidates_cache_entry():
+    db, job = _fused(Q1_MV, "q1a")
+    db._serve_mv_rows("q1a", job)
+    assert db.read_cache.report()[0][0] == "q1a"
+    db.run("DROP MATERIALIZED VIEW q1a")
+    assert db.read_cache.report() == []
+
+
+# ---------------------------------------------------------------------------
+# torn-read regression: commit lands mid-pull
+# ---------------------------------------------------------------------------
+
+
+def test_mv_rows_versioned_retries_torn_pull():
+    """A commit injected mid-pull (the counter moves while rows are in
+    flight) must NOT surface the torn snapshot: `mv_rows_versioned`
+    retries until one pull is bracketed by a stable (counter,
+    committed) pair."""
+    db, job = _fused(Q1_MV, "q1a")
+    want = job.mv_rows_now()
+    orig = job.mv_rows_now
+    calls = {"n": 0}
+
+    def torn_once():
+        calls["n"] += 1
+        rows = orig()
+        if calls["n"] == 1:
+            # simulate the racing dispatch+commit landing mid-pull
+            job.counter += 1
+            job.committed += 1
+            return [("torn", -1, -1, -1)]
+        return rows
+
+    job.mv_rows_now = torn_once
+    try:
+        epoch, rows = job.mv_rows_versioned()
+    finally:
+        job.mv_rows_now = orig
+        job.counter -= 1
+        job.committed -= 1
+    assert calls["n"] == 2, "torn pull must retry exactly once here"
+    assert rows == want, "the torn snapshot must never be returned"
+    assert epoch == job.counter + 1
+
+
+# ---------------------------------------------------------------------------
+# per-session SELECT fairness (token accounting, SQLSTATE 53000)
+# ---------------------------------------------------------------------------
+
+
+def test_select_gate_per_session_slice():
+    ROBUSTNESS.select_concurrency = 4
+    ROBUSTNESS.select_per_session = 1
+    g = SelectGate()
+    assert g.enter(session="a") is True
+    # the chatty session exhausts ITS slice ...
+    with pytest.raises(AdmissionRejected) as ei:
+        g.enter(session="a")
+    assert ei.value.sqlstate == "53000"
+    assert "RW_SELECT_PER_SESSION" in str(ei.value)
+    # ... while another session still admits under the shared budget
+    assert g.enter(session="b") is True
+    assert g.rejected == 1
+    g.leave(session="a")
+    assert g.enter(session="a") is True     # slot returned
+    g.leave(session="a")
+    g.leave(session="b")
+    assert g.active == 0 and g.session_active == {}
+
+
+def test_select_gate_global_bound_and_knob_off():
+    ROBUSTNESS.select_concurrency = 1
+    ROBUSTNESS.select_per_session = 8
+    g = SelectGate()
+    assert g.enter(session="a") is True
+    with pytest.raises(AdmissionRejected) as ei:
+        g.enter(session="b")                # global budget, not a's slice
+    assert "RW_SELECT_CONCURRENCY" in str(ei.value)
+    g.leave(session="a")
+    # per-session cap <= 0 disables only the per-session slice
+    ROBUSTNESS.select_concurrency = 4
+    ROBUSTNESS.select_per_session = 0
+    for _ in range(3):
+        assert g.enter(session="a") is True
+    for _ in range(3):
+        g.leave(session="a")
+    # concurrency <= 0 disables the gate entirely (enter() -> False)
+    ROBUSTNESS.select_concurrency = 0
+    assert g.enter(session="a") is False
+    assert g.enter() is False
+
+
+# ---------------------------------------------------------------------------
+# serving chaos: recovery, restart, policy switch
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", ["fused.dispatch", "fused.device_sync"])
+def test_serving_cache_across_inplace_recovery(point):
+    """A fused.* fault mid-run heals in place; cached serving after the
+    recovery is bit-identical to an undisturbed run."""
+    db0, job0 = _fused(Q1_MV, "q1a")
+    want = db0._serve_mv_rows("q1a", job0)
+    fp.arm(point, 1.0, 0, 1)
+    try:
+        db, job = _fused(Q1_MV, "q1a")
+    finally:
+        fp.reset()
+    assert job.recoveries == 1, point
+    assert db._serve_mv_rows("q1a", job) == want, point
+    # and the cache now holds the healed snapshot
+    assert db.read_cache.peek("q1a", int(job.counter)) == want
+
+
+def test_coordinator_restart_cache_rebuilds_cold(tmp_path):
+    """Restart: the cache is NOT persisted — a reopened coordinator
+    starts cold and the first read repopulates from the device."""
+    d = str(tmp_path / "data")
+    db, job = _fused(Q1_MV, "q1a", data_dir=d)
+    want = sorted(db._serve_mv_rows("q1a", job))
+    assert db.read_cache.stats()["fills"] == 1
+    del db
+
+    db2 = Database(data_dir=d, device=DeviceConfig(capacity=512))
+    assert db2.read_cache.report() == [], "restart must start cold"
+    job2 = db2.catalog.get("q1a").runtime["fused_job"]
+    shard_exec.reset_pull_stats()
+    got = sorted(db2._serve_mv_rows("q1a", job2))
+    assert got == want
+    st = db2.read_cache.stats()
+    assert st["fills"] == 1 and st["misses"] == 1
+    assert shard_exec.PULL_STATS["device_pulls"] == 1
+    # second read: host-memory hit, no new pull
+    assert sorted(db2._serve_mv_rows("q1a", job2)) == want
+    assert shard_exec.PULL_STATS["device_pulls"] == 1
+
+
+SKEW_BID_SRC = BID_SRC.replace("nexmark.chunk.size='{c}')",
+                               "nexmark.chunk.size='{c}',"
+                               " nexmark.key.dist='zipf:4')")
+
+
+@pytest.mark.mesh
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_serving_across_vnode_rebalance_policy_switch(monkeypatch):
+    """A vnode-rebalance policy switch mid-stream (skewed keys, low
+    threshold) must not wedge the serving path: post-adoption cached
+    reads match a direct pull."""
+    monkeypatch.setenv("RW_SKEW_STATS", "1")
+    monkeypatch.setenv("RW_VNODE_REBALANCE", "1")
+    db = Database(device=DeviceConfig(capacity=2048, mesh_shards=4,
+                                      compile_buckets=0,
+                                      rebalance_threshold=1.2))
+    db.run(SKEW_BID_SRC.format(n=N, c=CHUNK))
+    db.run(Q1_MV)
+    job = db.catalog.get("q1a").runtime["fused_job"]
+    assert job is not None
+    for _ in range(TICKS):
+        db.tick()
+    job.sync()
+    for _ in range(60):         # staged policy adopts at a checkpoint
+        if job._pending_policy is None:
+            break
+        time.sleep(0.1)
+        db.tick()
+    db.tick()
+    assert job.rebalances >= 1, "skew policy never adopted"
+    want = job.mv_rows_now()
+    db.read_cache.invalidate()  # recovery/rebalance convention: cold
+    assert db._serve_mv_rows("q1a", job) == want
+    assert db.read_cache.peek("q1a", int(job.counter)) == want
+
+
+# ---------------------------------------------------------------------------
+# replica mesh axis: 2-D (shard x replica) bit-identity vs 1-D
+# ---------------------------------------------------------------------------
+
+
+def _rows(mv_sql, name, shards, replicas, srcs=(BID_SRC,)):
+    from risingwave_tpu.parallel.mesh import (REPLICA_AXIS, SHARD_AXIS,
+                                              data_shards, mesh_replicas)
+    db, job = _fused(mv_sql, name, shards=shards, srcs=srcs,
+                     replicas=replicas)
+    mesh = job.program.mesh
+    assert mesh is not None
+    assert data_shards(mesh) == shards
+    if replicas > 1:
+        assert mesh.axis_names == (SHARD_AXIS, REPLICA_AXIS)
+        assert mesh_replicas(mesh) == replicas
+        assert mesh.devices.size == shards * replicas
+    else:
+        # replicas=1 lowers to the EXACT old 1-D mesh
+        assert mesh.axis_names == (SHARD_AXIS,)
+        assert mesh.devices.size == shards
+    rows = db.query(f"SELECT * FROM {name}")
+    return rows, job
+
+
+@pytest.mark.mesh
+@pytest.mark.parametrize("mv_sql,name,srcs", [
+    (Q1_MV, "q1a", (BID_SRC,)),
+    # q3/q5 compile two extra fused program sets each — out of the
+    # tier-1 budget, still covered by the slow/mesh lane
+    pytest.param(Q3_MV, "q3a", (BID_SRC, AUCTION_SRC),
+                 marks=pytest.mark.slow),
+], ids=["q1", "q3"])
+def test_replica_mesh_bit_identity(mv_sql, name, srcs):
+    """The same fused program over a (4, 2) named mesh — state sharded
+    over `shard`, mirrored over `replica` — is bit-identical (row order
+    included) to the 1-D 4-shard mesh."""
+    want, _ = _rows(mv_sql, name, 4, 1, srcs)
+    got, _ = _rows(mv_sql, name, 4, 2, srcs)
+    assert got == want
+
+
+@pytest.mark.mesh
+@pytest.mark.slow
+def test_replica_mesh_bit_identity_q5():
+    want, _ = _rows(Q5_MV, "q5", 4, 1)
+    got, _ = _rows(Q5_MV, "q5", 4, 2)
+    assert got == want
+
+
+@pytest.mark.mesh
+def test_replica_reads_round_robin_over_replica_columns():
+    """With replicas=2 the gathered MV snapshot is addressable on every
+    device; successive pulls alternate replica columns (chip-parallel
+    read serving), tracked in PULL_STATS['replica_pulls']."""
+    db, job = _fused(Q1_MV, "q1a", shards=4, replicas=2)
+    shard_exec.reset_pull_stats()
+    a = job.mv_rows_now()
+    b = job.mv_rows_now()
+    assert a == b
+    reps = shard_exec.PULL_STATS["replica_pulls"]
+    assert set(reps) == {0, 1}, f"round-robin over replicas, got {reps}"
+    assert shard_exec.PULL_STATS["device_pulls"] == 2
