@@ -8,12 +8,19 @@ PY ?= python
 CHAOS_LEDGER ?= /tmp/rw_chaos.ledger
 PYTEST_FLAGS ?= -q -p no:cacheprovider -p no:xdist -p no:randomly
 
-.PHONY: tier1 chaos chaos-replay bench-smoke
+.PHONY: tier1 obs chaos chaos-replay bench-smoke
 
 # the tier-1 gate (ROADMAP "Tier-1 verify" without the log plumbing)
 tier1:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) \
 		-m 'not slow' --continue-on-collection-errors
+
+# observability lane: the telemetry-marked tests (flow histograms,
+# pressure attribution, flight recorder, trace export) — the chrome-
+# export validation rides inside them, and conftest's sessionfinish
+# hook fails the run on any metrics-registry lint problem
+obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ $(PYTEST_FLAGS) -m telemetry
 
 # quick bench sanity (tiny scales, <2 min; includes the Zipfian skew_q4
 # sweep): results print as one JSON line, nothing is recorded

@@ -150,6 +150,14 @@ class DeviceConfig:
     # shape hash, like skew_stats). RW_STATE_TIERING=0/1 overrides;
     # RW_TIER_HIGH_WATER / RW_TIER_LOW_WATER tune the marks.
     state_tiering: bool = True
+    # flow telemetry (device/skew_stats.py): keyed fused nodes count
+    # this epoch's ROUTED rows per vnode bucket inside the traced step —
+    # the traffic histogram occupancy-driven rebalancing is blind to
+    # (hot flow over cold state). Slots ride the stat_sums split (sum
+    # across epochs, psum across shards — exact totals). Arming extends
+    # the traced step, so it is part of the plan-shape hash exactly
+    # like skew_stats; RW_FLOW_STATS=0/1 overrides without code changes.
+    flow_stats: bool = True
 
 
 @dataclass

@@ -79,6 +79,17 @@ Commands:
                               vnode-occupancy sparkline — read from the
                               skew_stats.json mirror, so it works on a
                               DEAD data dir (--json for the raw rows)
+    blackbox [ACTION]         flight-recorder postmortems: `list` the
+                              dumped bundles of a data dir, `show NAME`
+                              one bundle's records, or `dump` a fresh
+                              bundle from the on-disk telemetry ring
+                              mirror (blackbox_ring.jsonl) — the dump
+                              path never opens a Database, so it works
+                              on a DEAD or wedged directory: the last
+                              ~4 MB of ladder moves, pressure ticks,
+                              epochs, checkpoints, sheds, rebalances,
+                              recoveries and supervisor events, exactly
+                              as the process saw them before it died
     dlq [JOB]                 poison-pill dead-letter queue: list the
                               quarantined input rows (default — reads
                               the durable table directly, works on a
@@ -443,7 +454,72 @@ def cmd_serving(args) -> int:
         for r in rows:
             print("  ".join(f"{str(v):>10s}" for v in r))
     print(f"device pulls (process total): {PULL_STATS['device_pulls']}")
+    reps = PULL_STATS["replica_pulls"]
+    if reps:
+        # the read-load split over the replica mesh axis — a healthy
+        # replicated deployment spreads pulls round-robin, not all on
+        # the write path's replica 0
+        print("  by replica: " + "  ".join(
+            f"r{rep}={n}" for rep, n in sorted(reps.items())))
     return 0
+
+
+def cmd_blackbox(args) -> int:
+    """Flight-recorder postmortems (`utils/blackbox.py`). `dump` reads
+    the blackbox_ring.jsonl mirror straight off the directory — no
+    Database, no jax, works on the data dir of a DEAD process (torn
+    tail lines from the crash are tolerated) — and writes a
+    self-describing bundle under <data-dir>/blackbox/. `list`/`show`
+    browse the bundles already there (auto-dumped on escalations,
+    in-place recoveries, quarantines and wedge reaps, or by `dump`)."""
+    from ..utils.blackbox import dump_from_dir, list_bundles, read_bundle
+    if args.action == "dump":
+        try:
+            path = dump_from_dir(args.data_dir, reason=args.reason)
+        except (OSError, ValueError) as e:
+            print(f"blackbox dump failed: {e}", file=sys.stderr)
+            return 1
+        if path is None:
+            print(f"no telemetry ring in {args.data_dir} (the process "
+                  "never attached a recorder, or the ring file was "
+                  "removed) — nothing to dump")
+            return 1
+        print(f"dumped -> {path}")
+        return 0
+    try:
+        bundles = list_bundles(args.data_dir)
+    except OSError as e:
+        print(f"cannot read {args.data_dir}: {e}", file=sys.stderr)
+        return 1
+    if args.action == "list" or args.action is None:
+        if not bundles:
+            print("no blackbox bundles (nothing triggered a dump; "
+                  "`blackbox dump` takes one from the live ring mirror)")
+            return 0
+        print(f"{'bundle':44s} {'reason':24s} {'records':>7s}  kinds")
+        for name, m in bundles:
+            print(f"{name:44s} {m.get('reason', '?'):24s} "
+                  f"{m.get('records', 0):7d}  "
+                  f"{','.join(m.get('kinds', []))}")
+        return 0
+    if args.action == "show":
+        if args.bundle is None:
+            raise SystemExit("blackbox show needs a bundle name "
+                             "(see `blackbox list`)")
+        names = [n for n, _m in bundles]
+        if args.bundle not in names:
+            raise SystemExit(f"no bundle {args.bundle!r} "
+                             f"(have: {', '.join(names) or 'none'})")
+        try:
+            recs = read_bundle(args.data_dir, args.bundle)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"cannot read bundle {args.bundle!r}: {e}")
+        for rec in recs:
+            print(json.dumps(rec, sort_keys=True))
+        print(f"-- {len(recs)} records", file=sys.stderr)
+        return 0
+    raise SystemExit(f"unknown blackbox action {args.action!r} "
+                     "(supported: list, show, dump)")
 
 
 def cmd_skew(args) -> int:
@@ -697,6 +773,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     sp = sub.add_parser("serving")
     sp.add_argument("--data-dir", required=True)
     sp.set_defaults(fn=cmd_serving)
+    sp = sub.add_parser("blackbox")
+    sp.add_argument("action", nargs="?", default=None,
+                    help="list (default) | show BUNDLE | dump")
+    sp.add_argument("bundle", nargs="?", default=None,
+                    help="bundle name for `show`")
+    sp.add_argument("--data-dir", required=True)
+    sp.add_argument("--reason", default="manual",
+                    help="reason tag stamped on a `dump` bundle")
+    sp.set_defaults(fn=cmd_blackbox)
     sp = sub.add_parser("compile-status")
     sp.add_argument("job", nargs="?", default=None)
     sp.add_argument("--data-dir", required=True)

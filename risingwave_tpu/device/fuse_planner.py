@@ -567,6 +567,14 @@ def try_fuse(execu, ns, device_cfg, name: str,
             # (skew extends the traced step — see AggNode._sig)
             for node in f.nodes:
                 node.enable_skew()
+        flow_on = _env_bool("RW_FLOW_STATS",
+                            getattr(device_cfg, "flow_stats", True))
+        if flow_on:
+            # arm traffic-per-vnode telemetry — same ordering contract
+            # as skew (before tiering/exchange, before the plan hash);
+            # the tv* slots join stat_sums so sharded_apply psums them
+            for node in f.nodes:
+                node.enable_flow()
         tier_on = _env_bool("RW_STATE_TIERING",
                             getattr(device_cfg, "state_tiering", True))
         if tier_on:

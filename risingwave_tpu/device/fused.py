@@ -405,6 +405,11 @@ class Node:
     # residency/coldness scalars on the stats vector when armed
     # (enable_tiering). False everywhere else.
     tier: bool = False
+    # flow telemetry (device/skew_stats.py): keyed nodes compute a
+    # 16-bucket per-epoch routed-row (traffic) histogram inside their
+    # traced step when armed (enable_flow); the slots accumulate by SUM
+    # across epochs and shards. False everywhere else.
+    flow: bool = False
 
     def init_state(self):
         return None
@@ -414,6 +419,13 @@ class Node:
         BEFORE the program is built: the skew scalars extend both the
         stat layout and the traced step, so arming is part of the
         node's structural signature). No-op for un-keyed nodes."""
+
+    def enable_flow(self) -> None:
+        """Arm traffic-per-vnode telemetry for this node
+        (planner-called, once, BEFORE the program is built — the
+        traffic scalars extend the stat layout and the traced step, so
+        arming is part of the structural signature, exactly like
+        enable_skew). No-op for un-keyed nodes."""
 
     def enable_tiering(self) -> None:
         """Arm recency tracking for this node (planner-called, once,
@@ -967,6 +979,16 @@ class AggNode(Node):
             self.skew = True
             self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
 
+    def enable_flow(self):
+        # traffic slots are row-flow counters: SUM across epochs, psum
+        # across shards (exact — each input row lands in exactly one
+        # bucket on exactly one shard after the exchange routes it)
+        from .skew_stats import TRAFFIC_STAT_NAMES
+        if not self.flow:
+            self.flow = True
+            self.stat_names = tuple(self.stat_names) + TRAFFIC_STAT_NAMES
+            self.stat_sums = tuple(self.stat_sums) + TRAFFIC_STAT_NAMES
+
     def enable_tiering(self):
         # tres = live groups, tcold = live groups untouched >= TIER_TTL
         # epochs. MAX-accumulated (not in stat_sums) so the job sees the
@@ -1143,6 +1165,10 @@ class AggNode(Node):
         # to previous releases.
         if self.skew:
             sig = sig + ("skew",)
+        # flow telemetry extends the traced step and the stats layout
+        # the same way — unarmed signatures stay byte-identical
+        if self.flow:
+            sig = sig + ("flow",)
         # same contract for tiering: the touch column wraps the state
         # pytree and two stats extend the layout
         if self.tier:
@@ -1217,6 +1243,13 @@ class AggNode(Node):
                 sk = vnode_occupancy(new_main.keys, EMPTY_KEY) \
                     + weighted_topk(ch["keys"], ch["in_counts"],
                                     EMPTY_KEY)
+            if self.flow:
+                # traffic weighted by the combined rows' RAW-row counts,
+                # so totals match the uncombined run exactly (the 1-vs-N
+                # shard sum invariant survives pre-combine)
+                from .skew_stats import vnode_traffic
+                sk = sk + vnode_traffic(keys, live,
+                                        weights=jnp.abs(cnt))
         else:
             gcols = [d.cols[i] for i in self.group_idx]
             packbad = self.pack.check(gcols, d.mask & (d.sign != 0))
@@ -1244,6 +1277,11 @@ class AggNode(Node):
                 from .sorted_state import EMPTY_KEY
                 sk = vnode_occupancy(new_state.main.keys, EMPTY_KEY) \
                     + epoch_topk(keys, d.mask & (d.sign != 0), EMPTY_KEY)
+            if self.flow:
+                # this epoch's ROUTED rows per vnode bucket (sum slots:
+                # psum across shards, sum across epochs — exact totals)
+                from .skew_stats import vnode_traffic
+                sk = sk + vnode_traffic(keys, d.mask & (d.sign != 0))
         if not self.emit_out:
             # terminal agg: only the MV apply reads the change set — keep
             # just what it needs; the delta stream is never materialized
@@ -1338,6 +1376,14 @@ class JoinNode(Node):
         if not self.skew:
             self.skew = True
             self.stat_names = tuple(self.stat_names) + SKEW_STAT_NAMES
+
+    def enable_flow(self):
+        # see AggNode.enable_flow; traffic spans BOTH input deltas
+        from .skew_stats import TRAFFIC_STAT_NAMES
+        if not self.flow:
+            self.flow = True
+            self.stat_names = tuple(self.stat_names) + TRAFFIC_STAT_NAMES
+            self.stat_sums = tuple(self.stat_sums) + TRAFFIC_STAT_NAMES
 
     def enable_tiering(self):
         # see AggNode.enable_tiering; tres/tcold span BOTH build sides
@@ -1452,6 +1498,8 @@ class JoinNode(Node):
         # see AggNode._sig: armed skew telemetry changes the trace
         if self.skew:
             sig = sig + ("skew",)
+        if self.flow:
+            sig = sig + ("flow",)
         if self.tier:
             sig = sig + ("tier",)
         return sig
@@ -1513,6 +1561,13 @@ class JoinNode(Node):
                                         bmk & (bsg != 0)])
             stats += [a + b for a, b in zip(occ_a, occ_b)] \
                 + epoch_topk(cat_keys, cat_live, EMPTY_KEY)
+        if self.flow:
+            # routed rows across BOTH input deltas per vnode bucket —
+            # the traffic this join's exchange actually moved this epoch
+            from .skew_stats import vnode_traffic
+            stats += vnode_traffic(
+                jnp.concatenate([ajk, bjk]),
+                jnp.concatenate([amk & (asg != 0), bmk & (bsg != 0)]))
         if tstate is None:
             return (new_a, new_b), out, stats, None
         # touch at JOIN-KEY granularity (every row of one jk shares the
@@ -2364,6 +2419,11 @@ class FusedJob:
         # the rw_fused_node_stats / node_report substrate
         self._last_stats = np.zeros(len(self.stats_acc), np.int64)
         self._stat_totals = np.zeros(len(self.stats_acc), np.int64)
+        # flow telemetry host side: per-node EWMA over checkpoint-window
+        # traffic deltas (burst-vs-sustained discrimination for
+        # skew_report's traffic_burst rows), fed at every checkpoint
+        # from the cumulative tv* totals
+        self._traffic_ewma: Dict[int, Any] = {}
 
     # ---- barrier protocol ----------------------------------------------
     @property
@@ -2559,6 +2619,13 @@ class FusedJob:
             "fused_recovery_seconds",
             "wall seconds one in-place fused recovery took").observe(
             _time.perf_counter() - t_rec)
+        from ..utils.blackbox import RECORDER
+        RECORDER.record("recovery", {
+            "job": self.name, "attempt": self._recovery_attempts,
+            "replayed_epochs": int(expect - target),
+            "error": type(err).__name__,
+            "wall_s": round(_time.perf_counter() - t_rec, 4)})
+        RECORDER.maybe_dump("in_place_recovery")
 
     # ---- sync / growth / replay ----------------------------------------
     def _dispatch_range(self, lo: int, hi: int) -> None:
@@ -3280,6 +3347,18 @@ class FusedJob:
             # arrays (the crash-window retention contract)
             self.ingest.trim(self.committed)
         self._recovery_attempts = 0
+        # flow telemetry: fold this window's traffic into the per-node
+        # EWMA rings (burst-vs-sustained), then leave a checkpoint
+        # breadcrumb in the flight recorder (tiering counters ride it
+        # when armed — evidence, not policy)
+        self._update_traffic_ewma()
+        from ..utils.blackbox import RECORDER
+        rec: Dict[str, Any] = {"job": self.name, "epoch": int(epoch),
+                               "events": int(self.counter)}
+        if self.tiering is not None:
+            rec["tiering"] = {k: int(v)
+                              for k, v in self.tiering.counters.items()}
+        RECORDER.record("checkpoint", rec)
         # skew defenses that change exchange routing adopt HERE — the
         # only point where committed == counter and the whole history is
         # deterministically replayable under the new policy
@@ -3671,6 +3750,13 @@ class FusedJob:
             "fused_rebalance_seconds",
             "wall seconds one skew-policy rebuild-replay took").observe(
             _time.perf_counter() - t0)
+        from ..utils.blackbox import RECORDER
+        RECORDER.record("rebalance", {
+            "job": self.name, "epoch": int(epoch),
+            "policy_seq": self._policy_seq,
+            "bounds": [int(b) for b in bounds],
+            "hot_nodes": sorted(int(i) for i in hot_map),
+            "wall_s": round(_time.perf_counter() - t0, 4)})
 
     def _persist_policy(self, epoch: int) -> None:
         """Write the routing policy into the job state table (versioned
@@ -3748,7 +3834,7 @@ class FusedJob:
         the dead-data-dir contract of epoch_profile.jsonl and
         compile_manifest.json, applied to skew evidence."""
         if not self.data_dir \
-                or not any(n.skew for n in self.program.nodes):
+                or not any(n.skew or n.flow for n in self.program.nodes):
             return
         import json
         import os
@@ -3862,6 +3948,22 @@ class FusedJob:
         self._stat_totals = np.where(sm, self._stat_totals + vec,
                                      np.maximum(self._stat_totals, vec))
 
+    def _update_traffic_ewma(self) -> None:
+        """Feed each flow-armed node's EWMA ring from the CUMULATIVE
+        tv* totals (the EWMA differences consecutive checkpoints
+        internally — sum slots only ever grow, so the delta is this
+        window's traffic). Checkpoint-cadence host work: one dict walk,
+        no device traffic."""
+        from .skew_stats import SK_BUCKETS, TrafficEwma
+        for i, node in enumerate(self.program.nodes):
+            if not node.flow:
+                continue
+            st = self.program.node_stats(i, self._stat_totals)
+            ew = self._traffic_ewma.get(i)
+            if ew is None:
+                ew = self._traffic_ewma[i] = TrafficEwma()
+            ew.update([st.get(f"tv{b}", 0) for b in range(SK_BUCKETS)])
+
     def _export_hbm_gauges(self) -> None:
         """rw_hbm_bytes{job,node,shards} + budget utilization: the HBM
         footprint the capacity lifecycle actually allocated, checkpoint-
@@ -3930,26 +4032,47 @@ class FusedJob:
         stats the regular syncs already pulled — zero extra device
         traffic."""
         from .skew_stats import (SK_BUCKETS, SK_TOPK, skew_ratio,
-                                 unpack_hot)
+                                 traffic_divergence, unpack_hot)
         out: List[Tuple] = []
         totals = self._stat_totals
         for i, node in enumerate(self.program.nodes):
-            if not node.skew:
+            if not (node.skew or node.flow):
                 continue
             st = self.program.node_stats(i, totals)
             tname = type(node).__name__
             occ = [st.get(f"skv{b}", 0) for b in range(SK_BUCKETS)]
-            total = sum(occ)
-            for b, c in enumerate(occ):
-                out.append((i, tname, "vnode_occ", b, None, c,
-                            c / total if total else 0.0))
-            out.append((i, tname, "skew_ratio", 0, None,
-                        int(sum(occ)), skew_ratio(occ)))
-            for r in range(SK_TOPK):
-                key, count = unpack_hot(st.get(f"skh{r}", 0))
-                if count > 0:
-                    out.append((i, tname, "hot_key", r, key, count, None))
-            if self.program.mesh is not None:
+            if node.skew:
+                total = sum(occ)
+                for b, c in enumerate(occ):
+                    out.append((i, tname, "vnode_occ", b, None, c,
+                                c / total if total else 0.0))
+                out.append((i, tname, "skew_ratio", 0, None,
+                            int(sum(occ)), skew_ratio(occ)))
+                for r in range(SK_TOPK):
+                    key, count = unpack_hot(st.get(f"skh{r}", 0))
+                    if count > 0:
+                        out.append((i, tname, "hot_key", r, key, count,
+                                    None))
+            if node.flow:
+                # flow telemetry: where rows WENT (sum totals), next to
+                # where state LIVES (occupancy high-water). The
+                # divergence row is the "hot flow over cold state"
+                # signal an occupancy-only view cannot produce.
+                tv = [st.get(f"tv{b}", 0) for b in range(SK_BUCKETS)]
+                ttot = sum(tv)
+                for b, c in enumerate(tv):
+                    out.append((i, tname, "vnode_traffic", b, None, c,
+                                c / ttot if ttot else 0.0))
+                out.append((i, tname, "traffic_skew", 0, None, int(ttot),
+                            skew_ratio(tv)))
+                if node.skew:
+                    out.append((i, tname, "traffic_div", 0, None,
+                                int(ttot), traffic_divergence(tv, occ)))
+                ew = self._traffic_ewma.get(i)
+                if ew is not None:
+                    out.append((i, tname, "traffic_burst", 0, None,
+                                int(ttot), ew.burst_ratio()))
+            if node.skew and self.program.mesh is not None:
                 # per-SHARD load implied by the histogram under the
                 # CURRENT routing bounds — the quantity vnode
                 # rebalancing actually evens out (skew_ratio above is

@@ -572,6 +572,18 @@ def _count_pull(rep: int = 0) -> None:
     PULL_STATS["device_pulls"] += 1
     PULL_STATS["replica_pulls"][rep] = \
         PULL_STATS["replica_pulls"].get(rep, 0) + 1
+    # mirrored into the metrics registry so the per-replica read-load
+    # split is scrapeable (and lands in rw_serving_cache / `risectl
+    # serving`), not only a process dict
+    from ..utils.metrics import REGISTRY
+    REGISTRY.counter(
+        "serving_device_pulls_total",
+        "host transfers of MV state for SELECT serving").inc()
+    REGISTRY.counter(
+        "serving_replica_pulls_total",
+        "serving-tier device pulls by replica column (read-load "
+        "balance over the replica mesh axis)",
+        labels=("replica",)).labels(str(rep)).inc()
 
 
 def replica_device_get(mesh, tree):

@@ -35,7 +35,7 @@ epoch — measured inside the profiler-overhead acceptance bound).
 """
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import Any, List, Tuple
 
 from ..core.vnode import VNODE_COUNT
 
@@ -55,6 +55,16 @@ SKEW_STAT_NAMES: Tuple[str, ...] = tuple(
     [f"skv{i}" for i in range(SK_BUCKETS)]
     + [f"skh{i}" for i in range(SK_TOPK)])
 
+# flow telemetry (Node.enable_flow): per-epoch ROUTED-ROW counts per
+# vnode bucket — same bucket map as the occupancy histogram, but
+# accumulated by SUM across epochs AND shards (the slots ride the
+# nodes' `stat_sums`, so `sharded_apply` psums them; an 8-shard run's
+# totals equal the 1-shard run's exactly). Occupancy says where state
+# LIVES; traffic says where rows GO — their divergence is the "hot flow
+# over cold state" signal occupancy-driven rebalancing cannot see.
+TRAFFIC_STAT_NAMES: Tuple[str, ...] = tuple(
+    f"tv{i}" for i in range(SK_BUCKETS))
+
 
 def vnode_occupancy(keys, empty_key) -> List:
     """Per-bucket live-key counts of a (padded, EMPTY_KEY-filled) device
@@ -68,6 +78,24 @@ def vnode_occupancy(keys, empty_key) -> List:
                                             dtype=jnp.int64)[:, None]) \
         & live[None, :]
     counts = jnp.sum(onehot, axis=1, dtype=jnp.int64)
+    return [counts[i] for i in range(SK_BUCKETS)]
+
+
+def vnode_traffic(keys, live, weights=None) -> List:
+    """Per-bucket ROUTED-ROW counts of one epoch's input delta:
+    [SK_BUCKETS] int64 scalars. `live` masks padding/retraction rows;
+    `weights` (pre-combined agg path) carries exact per-key raw-row
+    counts so the totals stay identical to the uncombined run. One
+    O(epoch) bucket pass — no sort."""
+    import jax.numpy as jnp
+    from ..core.vnode import compute_vnodes_jnp
+    vn = compute_vnodes_jnp(keys, VNODE_COUNT)
+    bucket = (vn.astype(jnp.int64) * SK_BUCKETS) // VNODE_COUNT
+    w = jnp.where(live, weights.astype(jnp.int64), 0) \
+        if weights is not None else jnp.where(live, 1, 0)
+    onehot = (bucket[None, :] == jnp.arange(SK_BUCKETS,
+                                            dtype=jnp.int64)[:, None])
+    counts = jnp.sum(onehot * w[None, :], axis=1, dtype=jnp.int64)
     return [counts[i] for i in range(SK_BUCKETS)]
 
 
@@ -243,3 +271,57 @@ def skew_ratio(bucket_counts) -> float:
         return 0.0
     mean = total / float(len(bucket_counts))
     return max(bucket_counts) / mean
+
+
+def traffic_divergence(traffic, occupancy) -> float:
+    """Half the L1 distance between the normalized traffic and occupancy
+    histograms, in [0, 1]: 0 = rows go exactly where state lives, 1 =
+    all traffic lands in buckets holding no state. This is the "hot
+    flow over cold state" signal — an occupancy-driven rebalancer is
+    blind to exactly the mass this measures."""
+    tt, to = sum(traffic), sum(occupancy)
+    if tt <= 0 or to <= 0:
+        return 0.0
+    return 0.5 * sum(abs(t / tt - o / to)
+                     for t, o in zip(traffic, occupancy))
+
+
+class TrafficEwma:
+    """Per-node EWMA ring over per-checkpoint traffic histograms: the
+    burst-vs-sustained discriminator. Each checkpoint feeds the
+    window's per-bucket DELTA; the EWMA tracks the sustained per-window
+    rate, and `burst_ratio` compares the latest window against it — a
+    one-off spike reads high then decays, a sustained hot flow
+    converges toward 1.0 while the EWMA itself stays skewed."""
+
+    def __init__(self, alpha: float = 0.3, ring: int = 16):
+        from collections import deque
+        self.alpha = float(alpha)
+        self.ewma: List[float] = [0.0] * SK_BUCKETS
+        self.ring: Any = deque(maxlen=ring)   # recent window deltas
+        self._last_total: List[int] = [0] * SK_BUCKETS
+
+    def update(self, cumulative) -> List[int]:
+        """Feed the CUMULATIVE per-bucket totals (the sum-combined stat
+        slots at a checkpoint); returns this window's delta."""
+        cur = [int(c) for c in cumulative]
+        delta = [max(0, c - p) for c, p in zip(cur, self._last_total)]
+        self._last_total = cur
+        a = self.alpha
+        self.ewma = [a * d + (1.0 - a) * e
+                     for d, e in zip(delta, self.ewma)]
+        self.ring.append(delta)
+        return delta
+
+    def burst_ratio(self) -> float:
+        """max over buckets of (latest window) / (EWMA): >> 1 means the
+        latest window's hot bucket is NOT yet reflected in the
+        sustained rate — a burst; ~1 means the flow is sustained."""
+        if not self.ring:
+            return 0.0
+        latest = self.ring[-1]
+        worst = 0.0
+        for d, e in zip(latest, self.ewma):
+            if d > 0:
+                worst = max(worst, d / e if e > 0 else float(d))
+        return worst

@@ -322,6 +322,12 @@ class FragmentSupervisor:
                 REGISTRY.counter(
                     "supervisor_wedged_reaped_total",
                     "wedged workers SIGKILLed by the supervisor").inc()
+                from ..utils.blackbox import RECORDER
+                RECORDER.record("wedge_reap", {
+                    "job": getattr(s, "job_name", "") or "",
+                    "slot": i, "pid": w.proc.pid,
+                    "hb_age_s": round(time.time() - s.heartbeats[i], 2)})
+                RECORDER.maybe_dump("wedge_reap")
                 w.proc.kill()
             if dead or wedged:
                 victims.append(i)
@@ -338,6 +344,11 @@ class FragmentSupervisor:
         REGISTRY.counter("supervisor_escalations_total",
                          "supervised fragments handed to full recovery",
                          labels=("reason",)).labels(reason).inc()
+        from ..utils.blackbox import RECORDER
+        RECORDER.record("escalation", {
+            "job": getattr(self.rset, "job_name", "") or "",
+            "reason": reason, "msg": msg})
+        RECORDER.maybe_dump(f"escalation_{reason}")
         err = RemoteWorkerDied(
             msg + " (escalating: restart the job — DDL replay rebuilds "
             "and replays the fragments)")
@@ -580,6 +591,11 @@ class FragmentSupervisor:
             "supervisor_quarantined_total",
             "input records sidelined into rw_dead_letter by the "
             "poison-pill detector", labels=("job",)).labels(job).inc(n)
+        from ..utils.blackbox import RECORDER
+        RECORDER.record("quarantine", {
+            "job": job, "slot": i, "records": n,
+            "fingerprint": fpmt, "commit_epoch": int(commit_epoch)})
+        RECORDER.maybe_dump("quarantine")
         # quarantine IS progress: the slot starts a fresh respawn budget
         # and a fresh poison history
         self.attempts[i] = 1

@@ -28,12 +28,13 @@ window from host memory:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
 class _Entry:
     __slots__ = ("epoch", "rows", "filling", "hits", "misses",
-                 "coalesced", "fills")
+                 "coalesced", "fills", "fill_ts")
 
     def __init__(self) -> None:
         self.epoch = -1
@@ -43,6 +44,10 @@ class _Entry:
         self.misses = 0
         self.coalesced = 0
         self.fills = 0
+        # wall clock of the last fill: a snapshot reflects commits up
+        # to this moment — the served-staleness anchor rw_mv_freshness
+        # reports for cache-lagged reads
+        self.fill_ts: Optional[float] = None
 
 
 class MVReadCache:
@@ -100,6 +105,7 @@ class MVReadCache:
             with cond:
                 if epoch >= ent.epoch:
                     ent.epoch, ent.rows = int(epoch), rows
+                    ent.fill_ts = time.time()
                 ent.fills += 1
             return int(epoch), rows
         finally:
@@ -118,6 +124,12 @@ class MVReadCache:
             else:
                 self._entries.pop(name, None)
                 self._conds.pop(name, None)
+
+    def fill_time(self, name: str) -> Optional[float]:
+        """Wall clock of `name`'s last snapshot fill (None when cold)."""
+        with self._lock:
+            ent = self._entries.get(name)
+        return ent.fill_ts if ent is not None else None
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

@@ -169,6 +169,13 @@ class Database:
         # dir for offline hang diagnosis (risectl trace)
         from ..utils.trace import BarrierTracer
         self.tracer = BarrierTracer(data_dir)
+        # flight recorder (utils/blackbox.py): point the process-wide
+        # telemetry ring's on-disk mirror at this data dir so a crash or
+        # wedge leaves its last seconds readable by `risectl blackbox`
+        from ..utils.blackbox import RECORDER
+        RECORDER.attach(data_dir)
+        RECORDER.record("boot", {"device": repr(device),
+                                 "data_dir": data_dir})
         # source->MV freshness (utils/freshness.py): every MV commit
         # records ingest->commit wall; surfaced as rw_mv_freshness + the
         # mv_freshness_seconds histogram
@@ -1451,6 +1458,9 @@ class Database:
         at the next checkpoint — the rw_dead_letter pattern)."""
         self._shed_log.record(source, epoch, rows, "admission",
                               self.injector.epoch.curr)
+        from ..utils.blackbox import RECORDER
+        RECORDER.record("shed", {"source": source, "epoch": int(epoch),
+                                 "rows": int(rows)})
 
     def _heartbeat_workers(self) -> None:
         """Proactive worker liveness sweep, once per barrier tick (the
@@ -1652,8 +1662,14 @@ class Database:
         # tolerates two dispatched epochs, whatever their event budget
         staleness = max(0, int(ROBUSTNESS.serving_staleness_epochs)) \
             * max(1, int(getattr(job.program, "epoch_events", 1) or 1))
-        _, rows = self.read_cache.get(
+        served_epoch, rows = self.read_cache.get(
             name, int(job.counter), staleness, job.mv_rows_versioned)
+        # SERVED staleness: when the cache answered from an older epoch
+        # (within the staleness bound), rw_mv_freshness must report the
+        # lag the reader actually experienced, not the store's head
+        self._freshness.note_served(name, int(served_epoch),
+                                    int(job.counter),
+                                    self.read_cache.fill_time(name))
         return rows
 
     def _serving_mvs(self, ref) -> Optional[List[str]]:

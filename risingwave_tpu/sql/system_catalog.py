@@ -164,6 +164,27 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
                   ("misses", T.INT64), ("coalesced", T.INT64),
                   ("fills", T.INT64)),
         lambda db: list(db.read_cache.report())),
+    # serving-tier device-pull accounting (shard_exec.PULL_STATS): how
+    # many host transfers SELECT serving has cost, split by the replica
+    # column that served each one — the read-load balance over the
+    # replica mesh axis. replica=-1 is the process total.
+    "rw_serving_pulls": (
+        Schema.of(("replica", T.INT64), ("pulls", T.INT64)),
+        lambda db: _serving_pulls(db)),
+    # flow telemetry (device/skew_stats.py): the traffic-per-vnode view
+    # of rw_key_skew — per flow-armed node, this job-lifetime's ROUTED
+    # rows per vnode bucket (metric='vnode_traffic', share = the
+    # bucket's fraction of total traffic), the traffic max/mean ratio
+    # ('traffic_skew'), the traffic-vs-occupancy divergence
+    # ('traffic_div', half the L1 distance of the normalized histograms
+    # — the "hot flow over cold state" signal) and the burst-vs-
+    # sustained ratio from the per-node EWMA ring ('traffic_burst').
+    "rw_vnode_traffic": (
+        Schema.of(("job", T.VARCHAR), ("node", T.INT64),
+                  ("type", T.VARCHAR), ("metric", T.VARCHAR),
+                  ("ordinal", T.INT64), ("value", T.INT64),
+                  ("share", T.FLOAT64)),
+        lambda db: _vnode_traffic(db)),
     # poison-pill dead-letter queue (fault-tolerance v3): one row per
     # input record the supervisor sidelined after bounded respawns kept
     # dying on the same retained window. The full audit trail of the
@@ -182,13 +203,28 @@ SYSTEM_TABLES: Dict[str, Tuple[Schema, Callable[[Any], List[Tuple]]]] = {
     # (seq>0, newest last) — state walks normal -> throttled -> degraded
     # -> shedding and back with hysteresis; `stretch` is the live epoch-
     # cadence multiplier, `pressure` the [0,1] credit-starvation signal
-    # the transition acted on.
+    # the transition acted on, `dominant_source` the labeled evidence
+    # ("stall:<kind>" / "sink:<name>" / "queue:<set>") that drove it —
+    # every rung now says WHY it was taken.
     "rw_overload": (
         Schema.of(("job", T.VARCHAR), ("seq", T.INT64),
                   ("state", T.VARCHAR), ("prev_state", T.VARCHAR),
                   ("pressure", T.FLOAT64), ("stretch", T.INT64),
-                  ("since_ts", T.FLOAT64), ("ts", T.FLOAT64)),
+                  ("since_ts", T.FLOAT64), ("ts", T.FLOAT64),
+                  ("dominant_source", T.VARCHAR)),
         lambda db: db._overload.rows()),
+    # pressure attribution (utils/overload.py): the labeled evidence
+    # rows behind the overload_pressure scalar — per-seam stall
+    # fractions ('stall'), per-sink spool ratios ('sink'), per-worker-
+    # set exchange queue ratios ('queue'), plus one 'combined' row
+    # holding the recombined scalar. pressure_of IS
+    # combine_contributions(these rows), so SQL can verify the
+    # decomposition recombines exactly; `dominant` flags the argmax the
+    # ladder transitions were stamped with.
+    "rw_pressure_attrib": (
+        Schema.of(("family", T.VARCHAR), ("source", T.VARCHAR),
+                  ("value", T.FLOAT64), ("dominant", T.BOOLEAN)),
+        lambda db: db._overload.attribution_rows()),
     # per-source admission control: token-bucket state + the offered/
     # admitted/deferred poll counters whose difference is the source's
     # admission lag (backpressure debt pushed back to the connector)
@@ -226,6 +262,27 @@ def _epoch_profile(db) -> List[Tuple]:
 def _key_skew(db) -> List[Tuple]:
     return [(name,) + row for name, job in db._fused.items()
             for row in job.skew_report()]
+
+
+_TRAFFIC_METRICS = ("vnode_traffic", "traffic_skew", "traffic_div",
+                    "traffic_burst")
+
+
+def _vnode_traffic(db) -> List[Tuple]:
+    # the traffic slice of skew_report, minus the (always-NULL here)
+    # hot-key column
+    return [(name, node, tname, metric, ordinal, value, share)
+            for name, job in db._fused.items()
+            for node, tname, metric, ordinal, _key, value, share
+            in job.skew_report()
+            if metric in _TRAFFIC_METRICS]
+
+
+def _serving_pulls(db) -> List[Tuple]:
+    from ..device.shard_exec import PULL_STATS
+    rows = [(int(rep), int(n))
+            for rep, n in sorted(PULL_STATS["replica_pulls"].items())]
+    return rows + [(-1, int(PULL_STATS["device_pulls"]))]
 
 
 def _state_tiering(db) -> List[Tuple]:

@@ -1,10 +1,12 @@
 """Unified trace export: one Perfetto-loadable timeline per data dir.
 
-`risectl trace export --format chrome` merges the three observability
-logs a run leaves behind — `barrier_trace.jsonl` (inject / per-job
-collect / per-worker align / commit), `epoch_profile.jsonl` (fused-job
-epoch phase splits + compile events), and the heartbeat samples the
-coordinator drains record — into Chrome trace-event JSON
+`risectl trace export --format chrome` merges the observability logs a
+run leaves behind — `barrier_trace.jsonl` (inject / per-job collect /
+per-worker align / commit), `epoch_profile.jsonl` (fused-job epoch
+phase splits + compile events), `blackbox_ring.jsonl` (the flight
+recorder's control-plane events: ladder transitions, shed windows,
+rebalance adoptions, recoveries, demotions), and the heartbeat samples
+the coordinator drains record — into Chrome trace-event JSON
 (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
 that opens directly in ui.perfetto.dev or chrome://tracing. A whole
 warmup or chaos run becomes ONE picture: barrier cadence on the
@@ -29,7 +31,8 @@ import json
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-from .profile import PROFILE_FILE
+from .blackbox import RING_FILE
+from .profile import PROFILE_FILE, decode_epoch
 from .trace import TRACE_FILE
 
 # chrome trace events use MICROSECONDS
@@ -83,9 +86,9 @@ def _instant(name: str, cat: str, ts: float, pid: str, tid: str,
 
 
 # the epoch-profile phase order IS the wall-clock order inside an epoch
-# ("host_pack" kept for records written by pre-split releases — it was
-# the union of today's disjoint pack + h2d)
-_PHASE_ORDER = ("pack", "h2d", "host_pack", "promote_h2d", "dispatch",
+# (old-schema records are normalized by profile.decode_epoch before
+# this order is applied — version dispatch, not per-field sniffing)
+_PHASE_ORDER = ("pack", "h2d", "promote_h2d", "dispatch",
                 "exchange", "device_sync", "demote_d2h", "commit")
 
 
@@ -174,8 +177,9 @@ def export_chrome(data_dir: str) -> Dict[str, Any]:
             # phase slices stacked on a sibling track, laid out in the
             # in-epoch wall order (splits sum to <= wall by contract)
             cursor = t0
+            ph_ms = decode_epoch(rec)
             for ph in _PHASE_ORDER:
-                dur = rec.get("ph_ms", {}).get(ph, 0.0) / 1e3
+                dur = ph_ms.get(ph, 0.0) / 1e3
                 if dur <= 0:
                     continue
                 events.append(_complete(ph, "phase", cursor, dur,
@@ -188,6 +192,50 @@ def export_chrome(data_dir: str) -> Dict[str, Any]:
                 "compile", ts - dur, dur, f"fused:{job}", "compiles",
                 {k: rec[k] for k in ("bucket", "aot", "cache_hit")
                  if k in rec}))
+
+    # ---- flight recorder ring: control-plane instants ------------------
+    # ladder transitions, shed windows, rebalance adoptions, recoveries,
+    # supervision events and tiering demotions land as instant markers on
+    # a `control` process — overlaying WHY the engine changed behavior on
+    # top of WHAT the barriers and epochs were doing at that moment
+    tier_seen: Dict[str, int] = {}
+    for rec in _read_jsonl(os.path.join(data_dir, RING_FILE)):
+        ts = rec.get("ts")
+        kind = rec.get("kind")
+        if ts is None:
+            skipped += 1
+            continue
+        args = {k: v for k, v in rec.items()
+                if k not in ("ts", "seq", "kind")}
+        job = rec.get("job", "?")
+        if kind == "ladder":
+            events.append(_instant(
+                f"ladder {rec.get('prev')}->{rec.get('state')} [{job}]",
+                "control", ts, "control", "overload", args))
+        elif kind == "shed":
+            events.append(_instant(
+                f"shed {rec.get('source')} rows={rec.get('rows')}",
+                "control", ts, "control", "shed", args))
+        elif kind == "rebalance":
+            events.append(_instant(
+                f"rebalance {job} seq={rec.get('policy_seq')}",
+                "control", ts, "control", "rebalance", args))
+        elif kind == "recovery":
+            events.append(_instant(
+                f"recovery {job} attempt={rec.get('attempt')}",
+                "control", ts, "control", "recovery", args))
+        elif kind in ("quarantine", "wedge_reap", "escalation"):
+            events.append(_instant(f"{kind} [{job}]", "control", ts,
+                                   "control", "supervisor", args))
+        elif kind == "checkpoint" and isinstance(rec.get("tiering"),
+                                                 dict):
+            dem = int(rec["tiering"].get("demote_events", 0))
+            if dem > tier_seen.get(job, 0):
+                events.append(_instant(
+                    f"demotion {job}", "control", ts, "control",
+                    "tiering",
+                    {"demote_events": dem - tier_seen.get(job, 0)}))
+            tier_seen[job] = dem
 
     # Perfetto needs per-track monotonic timestamps; a global sort is
     # the simplest way to guarantee it for every (pid, tid)

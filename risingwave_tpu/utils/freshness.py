@@ -54,6 +54,13 @@ class FreshnessTracker:
     def __init__(self):
         self._rings: Dict[str, deque] = {}
         self._lock = threading.Lock()
+        # mv -> (served_epoch, ingest_ts of that epoch's commit): set
+        # when a staleness-bounded SELECT was SERVED from a cache
+        # snapshot OLDER than the last commit — the staleness the reader
+        # actually experienced, which `rows()` must report instead of
+        # the head-of-ring number (cleared the next time a serve is
+        # up to date)
+        self._served: Dict[str, Tuple[int, float]] = {}
 
     def commit(self, mv: str, epoch: int, ingest_ts: float,
                commit_ts: Optional[float] = None) -> float:
@@ -72,9 +79,35 @@ class FreshnessTracker:
                 fresh)
         return fresh
 
+    def note_served(self, mv: str, served_epoch: int,
+                    committed_epoch: int,
+                    as_of_ts: Optional[float]) -> None:
+        """A SELECT was answered from the serving cache at
+        `served_epoch` while the job stood at `committed_epoch` (both
+        in the CALLER's epoch unit — they are only compared to each
+        other). When the serve lagged, anchor the MV's reported
+        staleness on the ingest stamp of the last commit at or before
+        `as_of_ts` (the snapshot's fill wall clock: the data reflects
+        nothing later) — `rows()` would otherwise claim head-of-ring
+        freshness for data the cache served several epochs stale."""
+        with self._lock:
+            ring = self._rings.get(mv)
+            if not ring:
+                return
+            if served_epoch >= committed_epoch or as_of_ts is None:
+                self._served.pop(mv, None)     # up-to-date serve
+                return
+            anchor = ring[0][1]   # older than the ring remembers: floor
+            for _ep, ing, commit, _fresh in ring:
+                if commit > as_of_ts:
+                    break
+                anchor = ing
+            self._served[mv] = (int(served_epoch), anchor)
+
     def forget(self, mv: str) -> None:
         with self._lock:
             self._rings.pop(mv, None)
+            self._served.pop(mv, None)
 
     def history(self, mv: str) -> List[Tuple]:
         """(epoch, ingest_ts, commit_ts, freshness_s) commits, oldest
@@ -87,17 +120,25 @@ class FreshnessTracker:
         commit_ts, freshness_s, staleness_s, p50_s, p99_s, commits).
         `staleness_s` is recomputed at read time against the LAST
         committed ingest stamp — an MV nothing commits into reads as
-        ever-staler, exactly what an operator needs to see."""
+        ever-staler, exactly what an operator needs to see. When the
+        last SELECT was served from a cache epoch that LAGGED the last
+        commit (`note_served`), the staleness anchors on the served
+        epoch's ingest instead: the number reports what readers get,
+        not what the store holds."""
         now = now if now is not None else time.time()
         with self._lock:
             snap = {mv: list(ring) for mv, ring in self._rings.items()}
+            served = dict(self._served)
         out: List[Tuple] = []
         for mv in sorted(snap):
             ring = snap[mv]
             epoch, ingest, commit, fresh = ring[-1]
+            anchor = ingest
+            if mv in served:
+                anchor = min(anchor, served[mv][1])
             fr = sorted(r[3] for r in ring)
             out.append((mv, epoch, ingest, commit, fresh,
-                        max(0.0, now - ingest),
+                        max(0.0, now - anchor),
                         _quantile(fr, 0.50), _quantile(fr, 0.99),
                         len(ring)))
         return out

@@ -411,4 +411,23 @@ def lint_registry(reg: MetricsRegistry) -> List[str]:
     return problems
 
 
+def dead_telemetry(reg: MetricsRegistry) -> List[str]:
+    """LABELED metrics that never received a single `labels(...)` call:
+    the family was registered but no child exists, so it exposes nothing
+    and no dashboard can ever see it — usually a label-plumbing refactor
+    that left the registration behind. Unlabeled metrics are exempt
+    (their single child is created lazily on first inc/set/observe, and
+    a legitimately-zero counter is not dead). Advisory, not a failure:
+    the CI sessionfinish prints these as warnings — a suite subset
+    (`pytest tests/test_foo.py`) legitimately leaves most families
+    untouched."""
+    dead: List[str] = []
+    for name, m in sorted(reg._metrics.items()):
+        if m.label_names and not m._children:
+            dead.append(f"metric {name}: labeled "
+                        f"{list(m.label_names)} but no label set was ever "
+                        "instantiated (dead telemetry?)")
+    return dead
+
+
 REGISTRY = MetricsRegistry()

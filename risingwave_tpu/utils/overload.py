@@ -81,13 +81,16 @@ class PressureBoard:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._events: deque = deque(maxlen=8192)   # (monotonic ts, seconds)
+        # (monotonic ts, kind, seconds) — the kind is KEPT so the board
+        # can attribute its fraction to the seam that stalled, not just
+        # report that something did
+        self._events: deque = deque(maxlen=8192)
 
     def note(self, kind: str, seconds: float) -> None:
         if seconds <= 0.0:
             return
         with self._lock:
-            self._events.append((time.monotonic(), seconds))
+            self._events.append((time.monotonic(), kind, seconds))
         from .metrics import REGISTRY
         REGISTRY.counter(
             "credit_stall_seconds_total",
@@ -95,16 +98,27 @@ class PressureBoard:
             "queue capacity, by seam", labels=("kind",)
         ).labels(kind).inc(seconds)
 
-    def fraction(self, window_s: float) -> float:
+    def by_kind(self, window_s: float) -> Dict[str, float]:
+        """Stalled seconds per seam kind within the window (pruning the
+        deque of far-stale entries as `fraction` always did). The global
+        fraction is DEFINED from this breakdown — see `fraction` — so
+        the per-kind attribution recombines to the scalar exactly."""
         now = time.monotonic()
         lo = now - max(1e-6, window_s)
+        out: Dict[str, float] = {}
         with self._lock:
             # prune far-stale entries so the deque never holds history
             # older than a few windows
             horizon = now - 8 * max(1e-6, window_s)
             while self._events and self._events[0][0] < horizon:
                 self._events.popleft()
-            stalled = sum(s for ts, s in self._events if ts >= lo)
+            for ts, kind, s in self._events:
+                if ts >= lo:
+                    out[kind] = out.get(kind, 0.0) + s
+        return out
+
+    def fraction(self, window_s: float) -> float:
+        stalled = sum(self.by_kind(window_s).values())
         return min(1.0, stalled / max(1e-6, window_s))
 
     def reset(self) -> None:
@@ -117,6 +131,32 @@ class PressureBoard:
 # coordinator's LADDER only acts on coordinator-side stalls plus the
 # queue depths it can read directly)
 PRESSURE = PressureBoard()
+
+
+def combine_contributions(rows: List[Tuple[str, str, float]]) -> float:
+    """THE combine: labeled evidence rows -> the overload scalar.
+
+    Stall contributions add (they are disjoint slices of the same wall
+    clock, capped at 1.0 — exactly `PressureBoard.fraction`); sink and
+    queue ratios are alternative bottleneck indicators, so the worst
+    one wins. `OverloadManager.pressure_of` is implemented as
+    `combine_contributions(attribution(db))`, which is what makes the
+    rw_pressure_attrib rows recombine to `overload_pressure` by
+    construction rather than by convention."""
+    stall = min(1.0, sum(v for fam, _s, v in rows if fam == "stall"))
+    rest = max((v for fam, _s, v in rows if fam != "stall"), default=0.0)
+    return max(stall, rest)
+
+
+def dominant_contribution(rows: List[Tuple[str, str, float]]) -> str:
+    """`family:source` of the largest single contribution (ties break
+    toward the earlier row; empty string when nothing contributed) —
+    the ladder stamps this on every transition."""
+    best, label = 0.0, ""
+    for fam, src, v in rows:
+        if v > best:
+            best, label = v, f"{fam}:{src}"
+    return label
 
 
 # ---------------------------------------------------------------------------
@@ -142,9 +182,13 @@ class OverloadController:
         self.since = time.time()
         self._above: Optional[float] = None
         self._below: Optional[float] = None
-        # transition ring: (seq, ts, prev_state, new_state, pressure)
+        # transition ring: (seq, ts, prev_state, new_state, pressure,
+        # dominant_source) — the source names WHICH evidence drove the
+        # pressure at transition time ("stall:exchange_credit",
+        # "sink:s1", ...), so rw_overload answers WHY a rung was taken
         self.transitions: deque = deque(maxlen=64)
         self._seq = 0
+        self.dominant_source = ""
 
     @property
     def state(self) -> str:
@@ -160,10 +204,12 @@ class OverloadController:
     def admit_factor(self) -> float:
         return ADMIT_FACTOR[self.rung]
 
-    def observe(self, pressure: float, now: Optional[float] = None) -> str:
+    def observe(self, pressure: float, now: Optional[float] = None,
+                source: str = "") -> str:
         cfg = ROBUSTNESS
         now = time.time() if now is None else now
         self.pressure = pressure
+        self.dominant_source = source
         if not cfg.overload_ladder:
             if self.rung:
                 self._move(0, pressure, now)
@@ -205,7 +251,19 @@ class OverloadController:
         self.since = now
         self._seq += 1
         self.transitions.append((self._seq, now, prev, self.state,
-                                 pressure))
+                                 pressure, self.dominant_source))
+        try:
+            from .blackbox import RECORDER
+            RECORDER.record("ladder", {
+                "job": self.job, "prev": prev, "state": self.state,
+                "pressure": round(pressure, 4),
+                "source": self.dominant_source})
+            if rung > LADDER.index(prev) and rung >= _STRETCH_RUNG:
+                # escalation into result-affecting territory: freeze the
+                # evidence that led here (rate-limited in the recorder)
+                RECORDER.maybe_dump(f"escalation_{self.state}")
+        except Exception:
+            pass
         from .metrics import REGISTRY
         REGISTRY.counter(
             "overload_transitions_total",
@@ -218,12 +276,14 @@ class OverloadController:
 
     def rows(self, now: float) -> List[Tuple]:
         """rw_overload rows for this job: seq=0 is the CURRENT state,
-        higher seqs the transition history (newest last)."""
+        higher seqs the transition history (newest last). The trailing
+        dominant_source column says which evidence drove the pressure
+        ("stall:<kind>" / "sink:<name>" / "queue:<set>")."""
         out = [(self.job, 0, self.state, "", self.pressure,
-                self.stretch, self.since, now)]
-        for seq, ts, prev, new, p in self.transitions:
+                self.stretch, self.since, now, self.dominant_source)]
+        for seq, ts, prev, new, p, src in self.transitions:
             out.append((self.job, seq, new, prev, p,
-                        0, ts, ts))
+                        0, ts, ts, src))
         return out
 
 
@@ -446,6 +506,9 @@ class OverloadManager:
         self.controllers: Dict[str, OverloadController] = {}
         self.buckets: Dict[str, AdmissionBucket] = {}
         self.last_pressure = 0.0
+        # last tick's labeled evidence + its argmax (rw_pressure_attrib)
+        self.last_attribution: List[Tuple[str, str, float]] = []
+        self.last_dominant = ""
 
     def controller(self, job: str) -> OverloadController:
         c = self.controllers.get(job)
@@ -464,41 +527,68 @@ class OverloadManager:
         self.buckets.pop(name, None)
 
     # ---- evidence -------------------------------------------------------
-    def _sink_pressure(self, db) -> float:
-        worst = 0.0
+    # Every input to the overload scalar is collected as a LABELED
+    # contribution (family, source, value); `pressure_of` is then
+    # DEFINED as `combine_contributions(attribution(db))`, so the
+    # attribution recombines to the global pressure by construction —
+    # there is no second code path to drift out of agreement.
+
+    def attribution(self, db) -> List[Tuple[str, str, float]]:
+        """(family, source, value) contribution rows. Families:
+
+        * ``stall``  — per-seam credit-stall SECONDS over the window,
+          as a fraction of the window (uncapped; the cap lands in the
+          combine so the per-kind split still sums to the board's
+          scalar);
+        * ``sink``   — per-sink spool ratio (1.0 when stalled);
+        * ``queue``  — per-remote-worker-set exchange queue ratio.
+        """
+        window = max(1e-6, ROBUSTNESS.overload_window_s)
+        rows: List[Tuple[str, str, float]] = [
+            ("stall", kind, s / window)
+            for kind, s in sorted(PRESSURE.by_kind(window).items())]
         for obj in db.catalog.objects.values():
             rt = obj.runtime if isinstance(obj.runtime, dict) else None
             se = rt.get("sink_exec") if rt else None
             if se is None:
                 continue
             if getattr(se, "stalled", False):
-                worst = 1.0
+                rows.append(("sink", obj.name, 1.0))
             else:
-                worst = max(worst, min(1.0, se.pending_rows()
-                                       / max(1, ROBUSTNESS.sink_spool_rows)))
-        return worst
-
-    def _queue_pressure(self, db) -> float:
-        worst = 0.0
-        for _name, r in db._remote_sets():
+                rows.append(("sink", obj.name,
+                             min(1.0, se.pending_rows()
+                                 / max(1, ROBUSTNESS.sink_spool_rows))))
+        for name, r in db._remote_sets():
             qp = getattr(r, "queue_pressure", None)
             if qp is not None:
-                worst = max(worst, qp())
-        return worst
+                rows.append(("queue", name, qp()))
+        return rows
 
     def pressure_of(self, db) -> float:
-        base = PRESSURE.fraction(ROBUSTNESS.overload_window_s)
-        return max(base, self._sink_pressure(db), self._queue_pressure(db))
+        return combine_contributions(self.attribution(db))
 
     # ---- the closed loop ------------------------------------------------
     def tick(self, db) -> None:
         now = time.time()
-        p = self.pressure_of(db)
+        attrib = self.attribution(db)
+        p = combine_contributions(attrib)
+        dominant = dominant_contribution(attrib)
         self.last_pressure = p
+        self.last_attribution = attrib
+        self.last_dominant = dominant
         from .metrics import REGISTRY
         REGISTRY.gauge("overload_pressure",
                        "combined credit-starvation pressure in [0,1]"
                        ).set(p)
+        if p > 0.0:
+            try:
+                from .blackbox import RECORDER
+                RECORDER.record("pressure", {
+                    "p": round(p, 4), "dominant": dominant,
+                    "contrib": [[f, s, round(v, 4)]
+                                for f, s, v in attrib if v > 0.0]})
+            except Exception:
+                pass
         # every live streaming job gets a ladder controller
         jobs = set(db._fused)
         for obj in db.catalog.objects.values():
@@ -510,7 +600,7 @@ class OverloadManager:
         worst = 0
         for j in sorted(jobs):
             ctrl = self.controller(j)
-            ctrl.observe(p, now)
+            ctrl.observe(p, now, source=dominant)
             worst = max(worst, ctrl.rung)
             job = db._fused.get(j)
             if job is not None:
@@ -541,3 +631,16 @@ class OverloadManager:
 
     def admission_rows(self) -> List[Tuple]:
         return [b.row() for _n, b in sorted(self.buckets.items())]
+
+    def attribution_rows(self) -> List[Tuple]:
+        """rw_pressure_attrib rows: last tick's labeled contributions
+        plus one `combined` row holding the recombined scalar — SQL can
+        check the invariant (`combined` == combine of the rest) and the
+        `dominant` flag marks the argmax the ladder was stamped with."""
+        rows: List[Tuple] = []
+        for fam, src, v in self.last_attribution:
+            rows.append((fam, src, float(v),
+                         f"{fam}:{src}" == self.last_dominant))
+        rows.append(("combined", self.last_dominant,
+                     float(self.last_pressure), False))
+        return rows

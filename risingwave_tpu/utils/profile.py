@@ -57,6 +57,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 PROFILE_FILE = "epoch_profile.jsonl"
 _MAX_FILE_BYTES = 4 << 20
+# record schema version stamped on every epoch record. Readers dispatch
+# on it (`decode_epoch`) instead of sniffing individual fields:
+#   1 (implicit — records with no `schema` field): pre-pack/h2d-split
+#     releases; `host_pack` held the combined staging wall and `shards`
+#     may be absent.
+#   2: current shape (pack/h2d split, `shards` always present).
+PROFILE_SCHEMA = 2
 PHASES = ("pack", "h2d", "promote_h2d", "dispatch", "exchange",
           "device_sync", "demote_d2h", "commit")
 # a per-node step call slower than this is recorded as a compile/retrace
@@ -128,14 +135,25 @@ class JobProfiler:
         # "ts" = epoch END wall clock: the unified trace export
         # (utils/export.py) places the span at [ts - wall, ts] on the
         # coordinator timeline
-        rec = {"ev": "epoch", "job": self.job, "seq": cur["seq"],
-               "events": cur["events"], "shards": self.shards,
-               "ts": time.time(), "wall_ms": wall * 1e3,
+        rec = {"ev": "epoch", "schema": PROFILE_SCHEMA, "job": self.job,
+               "seq": cur["seq"], "events": cur["events"],
+               "shards": self.shards, "ts": time.time(),
+               "wall_ms": wall * 1e3,
                "ph_ms": {k: v * 1e3 for k, v in cur["ph"].items()}}
         self.ring.append(rec)
         with self._ev_lock:
             self._buf.append(rec)
         self.epochs += 1
+        try:
+            from .blackbox import RECORDER
+            RECORDER.record("epoch", {
+                "job": self.job, "seq": rec["seq"],
+                "events": rec["events"], "shards": self.shards,
+                "wall_ms": round(rec["wall_ms"], 3),
+                "ph_ms": {k: round(v, 3)
+                          for k, v in rec["ph_ms"].items()}})
+        except Exception:
+            pass             # the flight recorder must never fail an epoch
 
     # ---- compile / retrace events ---------------------------------------
     def compile_event(self, label: str, seconds: float,
@@ -191,25 +209,18 @@ class JobProfiler:
     def rows(self) -> List[Tuple]:
         """rw_epoch_profile rows: (job, seq, events, shards, pack_ms,
         h2d_ms, promote_h2d_ms, dispatch_ms, exchange_ms,
-        device_sync_ms, demote_d2h_ms, commit_ms, wall_ms). Records
-        written by a pre-split release carry `host_pack`; it reads back
-        as `pack` (h2d was 0 by construction there — no staged
-        transfers existed). promote_h2d / demote_d2h are the state
+        device_sync_ms, demote_d2h_ms, commit_ms, wall_ms). Old-schema
+        records are normalized by `decode_epoch` (version dispatch, not
+        per-field sniffing). promote_h2d / demote_d2h are the state
         tier's surgery phases (device/tiering.py) — zero when tiering
         is off."""
         out = []
         for r in self.ring:
-            ph = r["ph_ms"]
+            ph = decode_epoch(r)
             out.append((self.job, r["seq"], r["events"],
-                        r.get("shards", 1),
-                        ph.get("pack", ph.get("host_pack", 0.0)),
-                        ph.get("h2d", 0.0),
-                        ph.get("promote_h2d", 0.0),
-                        ph.get("dispatch", 0.0),
-                        ph.get("exchange", 0.0),
-                        ph.get("device_sync", 0.0),
-                        ph.get("demote_d2h", 0.0), ph.get("commit", 0.0),
-                        r["wall_ms"]))
+                        r.get("shards", 1))
+                       + tuple(ph.get(p, 0.0) for p in PHASES)
+                       + (r["wall_ms"],))
         return out
 
     def summary(self, top: int = 5) -> Dict[str, Any]:
@@ -231,6 +242,21 @@ class JobProfiler:
                  "ph_ms": {k: round(v, 3) for k, v in r["ph_ms"].items()}}
                 for r in slow],
         }
+
+
+def decode_epoch(rec: Dict[str, Any]) -> Dict[str, float]:
+    """Schema-dispatched phase map of one epoch record. Every reader of
+    epoch records (rw_epoch_profile, risectl profile, the unified trace
+    export) normalizes through here, so a format change is one new
+    branch on the VERSION — not a field-presence heuristic copied into
+    each reader. Schema 1 (records with no `schema` field): `host_pack`
+    was the combined pack+h2d staging wall — folded into `pack` (h2d
+    was 0 by construction there; no staged transfers existed)."""
+    ph = dict(rec.get("ph_ms", {}))
+    if int(rec.get("schema", 1)) < 2:
+        if "host_pack" in ph:
+            ph["pack"] = ph.get("pack", 0.0) + ph.pop("host_pack")
+    return ph
 
 
 # ---------------------------------------------------------------------------
@@ -363,7 +389,7 @@ def summarize_file(path: str, job: Optional[str] = None,
             if rec.get("ev") == "epoch":
                 agg["epochs"] += 1
                 agg["events"] += rec.get("events", 0)
-                for k, v in rec.get("ph_ms", {}).items():
+                for k, v in decode_epoch(rec).items():
                     agg["phase_ms"][k] = agg["phase_ms"].get(k, 0.0) + v
                 agg["_all"].append(rec)
             elif rec.get("ev") == "compile":
@@ -378,7 +404,8 @@ def summarize_file(path: str, job: Optional[str] = None,
         agg["slowest_epochs"] = [
             {"seq": r["seq"], "events": r.get("events"),
              "wall_ms": round(r["wall_ms"], 3),
-             "ph_ms": {k: round(v, 3) for k, v in r["ph_ms"].items()}}
+             "ph_ms": {k: round(v, 3)
+                       for k, v in decode_epoch(r).items()}}
             for r in slow]
         out[j] = agg
     return out

@@ -16,6 +16,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # have. Pin it OFF suite-wide; the dedicated skew tests
 # (test_observability2.py) force it back on per test.
 os.environ.setdefault("RW_SKEW_STATS", "0")
+# Flow telemetry (traffic-per-vnode histograms) rides the same traced
+# step and costs the same extra CPU-platform compile; pinned OFF
+# suite-wide, forced on per test by tests/test_flow_telemetry.py.
+# Production default stays ON (DeviceConfig.flow_stats).
+os.environ.setdefault("RW_FLOW_STATS", "0")
 # Same budget call for the agg pre-combine stage (an extra traced
 # program per fused agg): pinned OFF suite-wide, forced on per test by
 # the dedicated skew-defense tests (test_skew_ops.py). Production
@@ -93,14 +98,22 @@ def pytest_sessionfinish(session, exitstatus):
         join_prewarm_threads(timeout=30.0)
     except ImportError:
         pass
-    from risingwave_tpu.utils.metrics import REGISTRY, lint_registry
+    from risingwave_tpu.utils.metrics import (REGISTRY, dead_telemetry,
+                                              lint_registry)
+    rep = session.config.pluginmanager.get_plugin("terminalreporter")
+
+    def _say(msg, red):
+        if rep is not None:
+            rep.write_line(msg, red=red, yellow=not red)
+        else:
+            print(msg)
+
     problems = lint_registry(REGISTRY)
     if problems:
-        rep = session.config.pluginmanager.get_plugin("terminalreporter")
         for p in problems:
-            msg = f"metrics lint: {p}"
-            if rep is not None:
-                rep.write_line(msg, red=True)
-            else:
-                print(msg)
+            _say(f"metrics lint: {p}", red=True)
         session.exitstatus = 1
+    # advisory only: a labeled family no test ever touched is either dead
+    # plumbing or just outside this run's subset — warn, don't fail
+    for d in dead_telemetry(REGISTRY):
+        _say(f"metrics lint (warn): {d}", red=False)
